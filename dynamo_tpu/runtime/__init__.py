@@ -1,0 +1,24 @@
+"""Distributed runtime core (reference: lib/runtime/)."""
+
+from .engine import (
+    AsyncEngine,
+    AsyncEngineContext,
+    Context,
+    ResponseStream,
+    collect,
+    engine_from_generator,
+)
+from .pipeline import MapOperator, Operator, ServiceBackend, build_pipeline
+
+__all__ = [
+    "AsyncEngine",
+    "AsyncEngineContext",
+    "Context",
+    "ResponseStream",
+    "collect",
+    "engine_from_generator",
+    "MapOperator",
+    "Operator",
+    "ServiceBackend",
+    "build_pipeline",
+]

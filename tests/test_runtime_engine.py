@@ -1,0 +1,185 @@
+"""Runtime core tests: AsyncEngine, context cancellation, pipeline composition.
+
+Mirrors the reference's in-process runtime integration tests
+(lib/runtime/tests/pipeline.rs) — synthetic lambda engines, no network.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import (
+    Context,
+    MapOperator,
+    Operator,
+    ResponseStream,
+    build_pipeline,
+    collect,
+    engine_from_generator,
+)
+
+
+def make_counter_engine():
+    """Engine yielding 0..n-1 for request n."""
+
+    async def gen(request: Context):
+        for i in range(request.data):
+            yield i
+
+    return engine_from_generator(gen)
+
+
+@pytest.mark.asyncio
+async def test_engine_basic_stream():
+    engine = make_counter_engine()
+    stream = await engine.generate(Context(4))
+    assert await collect(stream) == [0, 1, 2, 3]
+
+
+@pytest.mark.asyncio
+async def test_context_id_propagation():
+    async def gen(request: Context):
+        yield request.id
+
+    engine = engine_from_generator(gen)
+    stream = await engine.generate(Context.with_id(None, "req-42"))
+    assert stream.id == "req-42"
+    assert await collect(stream) == ["req-42"]
+
+
+@pytest.mark.asyncio
+async def test_stop_generating_halts_producer():
+    produced = []
+
+    async def gen(request: Context):
+        for i in range(1000):
+            if request.is_stopped:
+                return
+            produced.append(i)
+            yield i
+            await asyncio.sleep(0)
+
+    engine = engine_from_generator(gen)
+    req = Context(None)
+    stream = await engine.generate(req)
+    out = []
+    async for item in stream:
+        out.append(item)
+        if len(out) == 3:
+            req.stop_generating()
+    assert out == [0, 1, 2]
+    assert len(produced) <= 4
+
+
+@pytest.mark.asyncio
+async def test_kill_drops_inflight_items():
+    async def gen(request: Context):
+        for i in range(10):
+            yield i
+
+    engine = engine_from_generator(gen)
+    req = Context(None)
+    stream = await engine.generate(req)
+    out = []
+    async for item in stream:
+        out.append(item)
+        if item == 2:
+            req.ctx.kill()
+    assert out == [0, 1, 2]
+
+
+@pytest.mark.asyncio
+async def test_child_context_cascade():
+    from dynamo_tpu.runtime import AsyncEngineContext
+
+    parent = AsyncEngineContext()
+    child = AsyncEngineContext()
+    parent.link_child(child)
+    parent.stop_generating()
+    assert child.is_stopped
+    # linking to an already-stopped parent stops immediately
+    late = AsyncEngineContext()
+    parent.link_child(late)
+    assert late.is_stopped
+
+
+@pytest.mark.asyncio
+async def test_consumer_abandon_propagates_stop():
+    """Explicit aclose() (e.g. HTTP handler teardown) stops upstream."""
+    req = Context(None)
+
+    async def gen(request: Context):
+        for i in range(1000):
+            yield i
+            await asyncio.sleep(0)
+
+    engine = engine_from_generator(gen)
+    stream = await engine.generate(req)
+    assert await stream.__anext__() == 0
+    await stream.aclose()
+    assert req.is_stopped
+
+
+@pytest.mark.asyncio
+async def test_consumer_cancellation_propagates_stop():
+    """Cancelling the consuming task (client disconnect) stops upstream."""
+    req = Context(None)
+    started = asyncio.Event()
+
+    async def gen(request: Context):
+        yield 0
+        started.set()
+        await asyncio.sleep(30)
+        yield 1
+
+    engine = engine_from_generator(gen)
+    stream = await engine.generate(req)
+
+    async def consume():
+        async for _ in stream:
+            pass
+
+    task = asyncio.create_task(consume())
+    await started.wait()
+    task.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await task
+    assert req.is_stopped
+
+
+@pytest.mark.asyncio
+async def test_map_operator_pipeline():
+    engine = make_counter_engine()
+    double_in = MapOperator(lambda n: n * 2, None)
+    add_ten_out = MapOperator(lambda n: n, lambda item: item + 10)
+    pipeline = build_pipeline([add_ten_out, double_in], engine)
+    stream = await pipeline.generate(Context(2))
+    assert await collect(stream) == [10, 11, 12, 13]
+
+
+@pytest.mark.asyncio
+async def test_bidirectional_operator_shares_state():
+    """One operator transforms request down and stream up with shared state."""
+
+    class Tagger(Operator):
+        async def generate(self, request, next):
+            tag = f"[{request.data}]"
+            stream = await next.generate(request.map(lambda s: s.upper()))
+            return stream.map(lambda item: tag + item)
+
+    async def gen(request: Context):
+        yield request.data
+        yield request.data + "!"
+
+    pipeline = build_pipeline([Tagger()], engine_from_generator(gen))
+    stream = await pipeline.generate(Context("hi"))
+    assert await collect(stream) == ["[hi]HI", "[hi]HI!"]
+
+
+@pytest.mark.asyncio
+async def test_pipeline_is_an_engine_and_nests():
+    inner = build_pipeline([MapOperator(lambda n: n + 1, None)], make_counter_engine())
+    outer = build_pipeline([MapOperator(lambda n: n * 2, None)], inner)
+    stream = await outer.generate(Context(1))
+    # 1 → *2 → +1 → count(3)
+    assert await collect(stream) == [0, 1, 2]
