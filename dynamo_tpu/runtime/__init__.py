@@ -9,8 +9,35 @@ from .engine import (
     engine_from_generator,
 )
 from .pipeline import MapOperator, Operator, ServiceBackend, build_pipeline
+from .client import Client, NoInstancesError, RouterMode
+from .component import (
+    Component,
+    DistributedRuntime,
+    Endpoint,
+    Namespace,
+    endpoint_path,
+    parse_endpoint_path,
+)
+from .transports.hub import HubClient, HubServer, InprocHub, WatchEvent
+from .transports.service import RemoteEngine, RemoteEngineError, ServiceServer
 
 __all__ = [
+    "Client",
+    "NoInstancesError",
+    "RouterMode",
+    "Component",
+    "DistributedRuntime",
+    "Endpoint",
+    "Namespace",
+    "endpoint_path",
+    "parse_endpoint_path",
+    "HubClient",
+    "HubServer",
+    "InprocHub",
+    "WatchEvent",
+    "RemoteEngine",
+    "RemoteEngineError",
+    "ServiceServer",
     "AsyncEngine",
     "AsyncEngineContext",
     "Context",

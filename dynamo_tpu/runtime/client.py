@@ -1,0 +1,134 @@
+"""Routed client: discovery-watching AsyncEngine with pluggable routing.
+
+Reference semantics: lib/runtime/src/component/client.rs — the client watches
+the instance prefix, maintains the live instance set (shrinking on lease
+expiry), and routes each request Random/RoundRobin/Direct.  KV-aware routing
+plugs in above this layer (the KV router picks a worker_id, then calls
+``direct``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import random
+from typing import Any, Dict, List, Optional
+
+from .engine import AsyncEngine, Context, ResponseStream
+from .transports.service import RemoteEngine
+
+
+class RouterMode(enum.Enum):
+    RANDOM = "random"
+    ROUND_ROBIN = "round_robin"
+    DIRECT = "direct"
+
+
+class NoInstancesError(RuntimeError):
+    """No live instances registered for the endpoint."""
+
+
+class Client(AsyncEngine):
+    """AsyncEngine over the live instances of one endpoint."""
+
+    def __init__(self, hub, instance_prefix: str, router_mode: RouterMode = RouterMode.ROUND_ROBIN):
+        self.hub = hub
+        self.instance_prefix = instance_prefix
+        self.router_mode = router_mode
+        self._instances: Dict[int, Dict[str, Any]] = {}
+        self._rr_index = 0
+        self._watcher = None
+        self._watch_task: Optional[asyncio.Task] = None
+        self._ready = asyncio.Event()
+        self._static_engine: Optional[RemoteEngine] = None
+
+    @classmethod
+    def static(cls, address: str, path: str) -> "Client":
+        client = cls(hub=None, instance_prefix="")
+        client._static_engine = RemoteEngine(address, path)
+        client._ready.set()
+        return client
+
+    async def start(self) -> "Client":
+        if self._static_engine is not None or self._watch_task is not None:
+            return self
+        self._watcher = await self.hub.watch_prefix(self.instance_prefix)
+        self._watch_task = asyncio.create_task(self._watch_loop())
+        # The hub terminates the snapshot with a sync marker; wait for it so
+        # the first generate() sees every already-registered instance.
+        await self._watcher.synced.wait()
+        return self
+
+    async def _watch_loop(self) -> None:
+        try:
+            async for event in self._watcher:
+                worker_id = int(event.key.rsplit("/", 1)[-1])
+                if event.type == "put":
+                    self._instances[worker_id] = event.value
+                else:
+                    self._instances.pop(worker_id, None)
+                if self._instances:
+                    self._ready.set()
+                else:
+                    self._ready.clear()
+        except asyncio.CancelledError:
+            pass
+
+    async def close(self) -> None:
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+            self._watch_task = None
+        if self._watcher is not None:
+            await self._watcher.aclose()
+
+    # -- instance access ----------------------------------------------------
+
+    @property
+    def instance_ids(self) -> List[int]:
+        return list(self._instances.keys())
+
+    def instance(self, worker_id: int) -> Optional[Dict[str, Any]]:
+        return self._instances.get(worker_id)
+
+    async def wait_for_instances(self, timeout: float = 10.0) -> None:
+        await asyncio.wait_for(self._ready.wait(), timeout)
+
+    # -- routing ------------------------------------------------------------
+
+    def _pick(self, worker_id: Optional[int], mode: RouterMode) -> Dict[str, Any]:
+        if not self._instances:
+            raise NoInstancesError(f"no instances under {self.instance_prefix!r}")
+        if worker_id is not None:
+            info = self._instances.get(worker_id)
+            if info is None:
+                raise NoInstancesError(f"instance {worker_id} not found")
+            return info
+        ids = sorted(self._instances.keys())
+        if mode == RouterMode.RANDOM:
+            return self._instances[random.choice(ids)]
+        self._rr_index = (self._rr_index + 1) % len(ids)
+        return self._instances[ids[self._rr_index]]
+
+    def _engine_for(self, info: Dict[str, Any]) -> RemoteEngine:
+        return RemoteEngine(info["address"], info["path"])
+
+    async def generate(
+        self,
+        request: Context,
+        worker_id: Optional[int] = None,
+        mode: Optional[RouterMode] = None,
+    ) -> ResponseStream:
+        if self._static_engine is not None:
+            return await self._static_engine.generate(request)
+        info = self._pick(worker_id, mode if mode is not None else self.router_mode)
+        return await self._engine_for(info).generate(request)
+
+    # Convenience verbs mirroring the reference bindings (_core.pyi):
+    async def random(self, request: Context) -> ResponseStream:
+        return await self.generate(request, mode=RouterMode.RANDOM)
+
+    async def round_robin(self, request: Context) -> ResponseStream:
+        return await self.generate(request, mode=RouterMode.ROUND_ROBIN)
+
+    async def direct(self, request: Context, worker_id: int) -> ResponseStream:
+        return await self.generate(request, worker_id=worker_id)

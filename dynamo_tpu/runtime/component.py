@@ -1,0 +1,223 @@
+"""Distributed component model: Runtime → Namespace → Component → Endpoint.
+
+Reference semantics: lib/runtime/src/component.rs:16-42 (naming hierarchy),
+component/endpoint.rs:376-460 (endpoint registration under a lease),
+lib/runtime/src/distributed.rs (DistributedRuntime = runtime + transports).
+
+Registration scheme (hub KV): ``instances/{ns}/{comp}/{ep}/{worker_id}`` →
+``{address, path, worker_id, metadata}`` attached to the worker's lease, so a
+dead worker's registrations vanish when its lease expires and every watcher
+(clients, HTTP frontend model list, KV indexer) observes the delete — the
+reference's etcd-lease liveness design (SURVEY §5 failure detection).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+from .client import Client, RouterMode
+from .engine import AsyncEngine, engine_from_generator
+from .transports.hub import HubClient, InprocHub
+from .transports.service import ServiceServer
+
+INSTANCE_PREFIX = "instances"
+
+
+def instance_key(ns: str, comp: str, ep: str, worker_id: int) -> str:
+    return f"{INSTANCE_PREFIX}/{ns}/{comp}/{ep}/{worker_id}"
+
+
+def endpoint_path(ns: str, comp: str, ep: str) -> str:
+    """The service-plane path an engine is served at (``dyn://ns.comp.ep``)."""
+    return f"{ns}.{comp}.{ep}"
+
+
+def parse_endpoint_path(path: str) -> tuple:
+    """Parse ``dyn://ns.comp.ep`` or ``ns.comp.ep`` (reference protocols.rs:49)."""
+    if path.startswith("dyn://"):
+        path = path[len("dyn://") :]
+    parts = path.split(".")
+    if len(parts) != 3:
+        raise ValueError(f"endpoint path must be ns.component.endpoint, got {path!r}")
+    return parts[0], parts[1], parts[2]
+
+
+class DistributedRuntime:
+    """Per-process distributed runtime: hub connection + one service server.
+
+    Construct via ``DistributedRuntime.detached()`` (in-process hub; the
+    reference's static mode) or ``DistributedRuntime.connect(address)`` (TCP
+    hub).  Every process gets a ``worker_id`` and a primary lease; all
+    endpoint registrations default to that lease.
+    """
+
+    DEFAULT_LEASE_TTL = 5.0
+
+    def __init__(self, hub, host: str = "127.0.0.1"):
+        self.hub = hub
+        self.worker_id: int = uuid.uuid4().int & ((1 << 63) - 1)
+        self.primary_lease: Optional[int] = None
+        self._host = host
+        self._service_server: Optional[ServiceServer] = None
+        self._shutdown_event = asyncio.Event()
+
+    @classmethod
+    async def detached(cls) -> "DistributedRuntime":
+        hub = await InprocHub().start()
+        return await cls(hub)._init()
+
+    @classmethod
+    async def connect(cls, address: str, host: str = "127.0.0.1") -> "DistributedRuntime":
+        hub = await HubClient(address).connect()
+        return await cls(hub, host=host)._init()
+
+    async def _init(self) -> "DistributedRuntime":
+        self.primary_lease = await self.hub.lease_grant(self.DEFAULT_LEASE_TTL)
+        return self
+
+    async def service_server(self) -> ServiceServer:
+        if self._service_server is None:
+            self._service_server = await ServiceServer(host=self._host).start()
+        return self._service_server
+
+    def namespace(self, name: str) -> "Namespace":
+        return Namespace(self, name)
+
+    def shutdown(self) -> None:
+        self._shutdown_event.set()
+
+    async def wait_for_shutdown(self) -> None:
+        await self._shutdown_event.wait()
+
+    async def close(self) -> None:
+        self.shutdown()
+        if self._service_server is not None:
+            await self._service_server.close()
+        if self.primary_lease is not None:
+            try:
+                await self.hub.lease_revoke(self.primary_lease)
+            except (ConnectionError, RuntimeError):
+                pass
+        await self.hub.close()
+
+
+class Namespace:
+    def __init__(self, runtime: DistributedRuntime, name: str):
+        self.runtime = runtime
+        self.name = name
+
+    def component(self, name: str) -> "Component":
+        return Component(self, name)
+
+    # Event plane scoped to the namespace (reference traits/events.rs:30-79)
+    def subject(self, topic: str) -> str:
+        return f"{self.name}.{topic}"
+
+    async def publish(self, topic: str, payload: Any) -> None:
+        await self.runtime.hub.publish(self.subject(topic), payload)
+
+    async def subscribe(self, topic: str):
+        return await self.runtime.hub.subscribe(self.subject(topic))
+
+
+class Component:
+    def __init__(self, namespace: Namespace, name: str):
+        self.namespace = namespace
+        self.name = name
+
+    @property
+    def runtime(self) -> DistributedRuntime:
+        return self.namespace.runtime
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self, name)
+
+    async def create_service(self) -> "Component":
+        """API-parity no-op: services materialize on first endpoint serve."""
+        return self
+
+    def subject(self, topic: str) -> str:
+        return f"{self.namespace.name}.{self.name}.{topic}"
+
+    async def publish(self, topic: str, payload: Any) -> None:
+        await self.runtime.hub.publish(self.subject(topic), payload)
+
+    async def subscribe(self, topic: str):
+        return await self.runtime.hub.subscribe(self.subject(topic))
+
+
+class Endpoint:
+    def __init__(self, component: Component, name: str):
+        self.component = component
+        self.name = name
+
+    @property
+    def runtime(self) -> DistributedRuntime:
+        return self.component.runtime
+
+    @property
+    def path(self) -> str:
+        return endpoint_path(self.component.namespace.name, self.component.name, self.name)
+
+    def instance_key(self, worker_id: int) -> str:
+        return instance_key(
+            self.component.namespace.name, self.component.name, self.name, worker_id
+        )
+
+    @property
+    def instance_prefix(self) -> str:
+        return (
+            f"{INSTANCE_PREFIX}/{self.component.namespace.name}/"
+            f"{self.component.name}/{self.name}/"
+        )
+
+    async def serve_endpoint(
+        self,
+        engine,
+        lease: Optional[int] = None,
+        metadata: Optional[Dict[str, Any]] = None,
+    ) -> "ServedEndpoint":
+        """Serve an AsyncEngine (or async-generator handler) at this endpoint.
+
+        Registers the instance in the hub KV under a lease (defaults to the
+        process primary lease) and on the process service server.  Reference:
+        EndpointConfigBuilder::start, component/endpoint.rs:376-460.
+        """
+        runtime = self.runtime
+        if not isinstance(engine, AsyncEngine):
+            engine = engine_from_generator(engine)
+        server = await runtime.service_server()
+        server.register(self.path, engine)
+        lease_id = lease if lease is not None else runtime.primary_lease
+        info = {
+            "address": server.address,
+            "path": self.path,
+            "worker_id": runtime.worker_id,
+            "metadata": metadata or {},
+        }
+        await runtime.hub.kv_put(self.instance_key(runtime.worker_id), info, lease_id)
+        return ServedEndpoint(self, server)
+
+    async def client(self, router_mode: RouterMode = RouterMode.ROUND_ROBIN) -> Client:
+        client = Client(self.runtime.hub, self.instance_prefix, router_mode=router_mode)
+        await client.start()
+        return client
+
+    def static_client(self, address: str) -> Client:
+        """Client pinned to one known address — no discovery (static mode)."""
+        return Client.static(address, self.path)
+
+
+class ServedEndpoint:
+    """Handle for a served endpoint: supports deregistration."""
+
+    def __init__(self, endpoint: Endpoint, server: ServiceServer):
+        self.endpoint = endpoint
+        self._server = server
+
+    async def stop(self) -> None:
+        runtime = self.endpoint.runtime
+        self._server.unregister(self.endpoint.path)
+        await runtime.hub.kv_delete(self.endpoint.instance_key(runtime.worker_id))

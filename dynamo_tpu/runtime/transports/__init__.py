@@ -1,0 +1,22 @@
+"""Transports: frame codec, control-plane hub, TCP service plane.
+
+Reference equivalents: lib/runtime/src/transports/{etcd,nats,zmq}.rs and
+lib/runtime/src/pipeline/network/**.  This build collapses etcd+NATS into a
+single self-contained hub process (discovery KV w/ leases + pub/sub + queues)
+and replaces the NATS-request/TCP-callback split with direct TCP
+request+streamed-response on one connection.
+"""
+
+from .codec import Frame, FrameType, read_frame, write_frame
+from .hub import HubClient, HubServer, InprocHub, WatchEvent
+
+__all__ = [
+    "Frame",
+    "FrameType",
+    "read_frame",
+    "write_frame",
+    "HubClient",
+    "HubServer",
+    "InprocHub",
+    "WatchEvent",
+]

@@ -1,0 +1,81 @@
+"""Frame codec for the TCP service plane.
+
+Reference semantics: lib/runtime/src/pipeline/network/codec/two_part.rs —
+length-prefixed two-part (header + data) framing.  Here every frame is
+
+    [1 byte type][4 bytes big-endian payload length][payload]
+
+and a request is two frames (REQ_HEADER carrying the control message,
+REQ_DATA carrying the serialized request), mirroring ``TwoPartMessage``.
+Responses stream as RESP_* frames on the same connection; CANCEL/KILL flow
+client→server mid-stream (the reference's ZMQ "Harmony" control messages,
+transports/zmq.rs:44-52).
+
+Payload encoding is msgpack (falls back to JSON if a payload is not
+msgpack-serializable).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import json
+import struct
+from dataclasses import dataclass
+from typing import Any
+
+import msgpack
+
+MAX_FRAME = 256 * 1024 * 1024  # 256 MiB guard against corrupt length prefixes
+_HDR = struct.Struct(">BI")
+
+
+class FrameType(enum.IntEnum):
+    REQ_HEADER = 1  # control message: {id, endpoint, request_type}
+    REQ_DATA = 2  # request payload
+    RESP_PROLOGUE = 3  # {ok: bool, error: str|None} — reference's ResponseStreamPrologue
+    RESP_ITEM = 4  # one streamed response item
+    RESP_COMPLETE = 5  # end of stream
+    RESP_ERROR = 6  # mid-stream error (terminates stream)
+    CANCEL = 7  # client → server: stop_generating()
+    KILL = 8  # client → server: kill()
+    HEARTBEAT = 9
+
+
+@dataclass(frozen=True)
+class Frame:
+    type: FrameType
+    payload: bytes
+
+    def unpack(self) -> Any:
+        return decode(self.payload)
+
+
+def encode(obj: Any) -> bytes:
+    try:
+        return msgpack.packb(obj, use_bin_type=True)
+    except (TypeError, ValueError):
+        return b"\x00json" + json.dumps(obj).encode()
+
+
+def decode(buf: bytes) -> Any:
+    if buf[:5] == b"\x00json":
+        return json.loads(buf[5:])
+    return msgpack.unpackb(buf, raw=False)
+
+
+async def write_frame(
+    writer: asyncio.StreamWriter, ftype: FrameType, obj: Any = None, *, raw: bytes | None = None
+) -> None:
+    payload = raw if raw is not None else encode(obj)
+    writer.write(_HDR.pack(int(ftype), len(payload)) + payload)
+    await writer.drain()
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Frame:
+    hdr = await reader.readexactly(_HDR.size)
+    ftype, length = _HDR.unpack(hdr)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame length {length} exceeds MAX_FRAME")
+    payload = await reader.readexactly(length) if length else b""
+    return Frame(FrameType(ftype), payload)

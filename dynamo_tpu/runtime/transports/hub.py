@@ -1,0 +1,782 @@
+"""The hub: self-contained control plane for discovery, events, and queues.
+
+The reference outsources these planes to external infrastructure — etcd
+(lease-based discovery KV with prefix watches, lib/runtime/src/transports/
+etcd.rs:41-330) and NATS core/JetStream (pub-sub event plane + work queues,
+transports/nats.rs).  This build provides one self-contained hub speaking a
+newline-delimited-JSON protocol over TCP, so a full distributed deployment
+needs zero external services.  Three faces:
+
+- ``HubState``   — the in-memory state machine (KV + leases + subs + queues).
+- ``HubServer``  — asyncio TCP server exposing it (the ``docker-compose``
+  etcd+NATS replacement; run via ``python -m dynamo_tpu.cli hub``).
+- ``HubClient``  — asyncio client; same async interface as ``InprocHub``.
+- ``InprocHub``  — direct in-process binding for single-process serving and
+  tests (the reference's "static mode", lib/runtime/src/distributed.rs).
+
+Semantics preserved from the reference:
+- KV entries may be attached to a **lease**; lease expiry deletes the keys and
+  notifies prefix watchers (liveness = lease keep-alive; etcd/lease.rs:19-51).
+- ``watch_prefix`` emits the current snapshot as Put events, then live deltas
+  (etcd.rs:246-330 ``kv_get_and_watch_prefix``).
+- Queues are at-least-once: popped items must be acked; a consumer
+  disconnecting with unacked items requeues them (JetStream prefill queue,
+  examples/llm/utils/nats_queue.py).
+- Subjects support NATS-style wildcards: ``*`` one token, ``>`` tail.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Set, Tuple
+
+
+# --------------------------------------------------------------------------
+# Events / small types
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    """Put/Delete delta on a watched prefix (reference ``WatchEvent``)."""
+
+    type: str  # "put" | "delete"
+    key: str
+    value: Any = None
+
+
+def subject_matches(pattern: str, subject: str) -> bool:
+    """NATS-style subject matching: ``*`` = one token, ``>`` = remainder."""
+    pt, st = pattern.split("."), subject.split(".")
+    for i, p in enumerate(pt):
+        if p == ">":
+            return len(st) > i  # '>' matches one or more remaining tokens
+        if i >= len(st):
+            return False
+        if p != "*" and p != st[i]:
+            return False
+    return len(pt) == len(st)
+
+
+# --------------------------------------------------------------------------
+# State machine
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _Lease:
+    id: int
+    ttl: float
+    expires_at: float
+    keys: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class _QueueItem:
+    item: Any
+    ack_token: str
+
+
+class HubState:
+    """In-memory KV + lease + pub/sub + queue state with watcher fanout.
+
+    All mutation goes through async methods on the owning event loop, so no
+    locks are needed (single-threaded asyncio, the same reasoning as the
+    reference's dedicated indexer thread).
+    """
+
+    def __init__(self):
+        self._kv: Dict[str, Any] = {}
+        self._kv_lease: Dict[str, int] = {}
+        self._leases: Dict[int, _Lease] = {}
+        self._lease_ids = itertools.count(1)
+        self._revision = 0
+        # watch id → (prefix, asyncio.Queue of WatchEvent)
+        self._watches: Dict[str, Tuple[str, asyncio.Queue]] = {}
+        # sub id → (pattern, queue of (subject, payload))
+        self._subs: Dict[str, Tuple[str, asyncio.Queue]] = {}
+        # queue name → deque of _QueueItem
+        self._queues: Dict[str, deque] = {}
+        # queue name → waiters (futures)
+        self._q_waiters: Dict[str, deque] = {}
+        # ack token → (queue name, item) for in-flight redelivery
+        self._inflight: Dict[str, Tuple[str, Any]] = {}
+        self._expiry_task: Optional[asyncio.Task] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start_expiry_loop(self) -> None:
+        if self._expiry_task is None or self._expiry_task.done():
+            self._expiry_task = asyncio.get_running_loop().create_task(
+                self._expire_leases_loop()
+            )
+
+    async def close(self) -> None:
+        if self._expiry_task is not None:
+            self._expiry_task.cancel()
+            try:
+                await self._expiry_task
+            except asyncio.CancelledError:
+                pass
+            self._expiry_task = None
+
+    async def _expire_leases_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.2)
+            now = time.monotonic()
+            expired = [l for l in self._leases.values() if l.expires_at <= now]
+            for lease in expired:
+                await self.lease_revoke(lease.id)
+
+    # -- KV -----------------------------------------------------------------
+
+    async def kv_put(self, key: str, value: Any, lease_id: Optional[int] = None) -> None:
+        self._revision += 1
+        self._kv[key] = value
+        if lease_id is not None:
+            if lease_id not in self._leases:
+                raise KeyError(f"unknown lease {lease_id}")
+            self._kv_lease[key] = lease_id
+            self._leases[lease_id].keys.add(key)
+        else:
+            self._kv_lease.pop(key, None)
+        self._notify(WatchEvent("put", key, value))
+
+    async def kv_get(self, key: str) -> Any:
+        return self._kv.get(key)
+
+    async def kv_get_prefix(self, prefix: str) -> Dict[str, Any]:
+        return {k: v for k, v in self._kv.items() if k.startswith(prefix)}
+
+    async def kv_delete(self, key: str) -> bool:
+        if key not in self._kv:
+            return False
+        self._kv.pop(key)
+        lease_id = self._kv_lease.pop(key, None)
+        if lease_id is not None and lease_id in self._leases:
+            self._leases[lease_id].keys.discard(key)
+        self._notify(WatchEvent("delete", key))
+        return True
+
+    def _notify(self, event: WatchEvent) -> None:
+        for prefix, q in self._watches.values():
+            if event.key.startswith(prefix):
+                q.put_nowait(event)
+
+    # -- watches ------------------------------------------------------------
+
+    async def watch_create(self, prefix: str) -> Tuple[str, asyncio.Queue]:
+        wid = uuid.uuid4().hex
+        q: asyncio.Queue = asyncio.Queue()
+        # snapshot first (kv_get_and_watch_prefix semantics), then a sync
+        # marker so watchers know the snapshot is complete
+        for k, v in self._kv.items():
+            if k.startswith(prefix):
+                q.put_nowait(WatchEvent("put", k, v))
+        q.put_nowait(WatchEvent("sync", ""))
+        self._watches[wid] = (prefix, q)
+        return wid, q
+
+    async def watch_cancel(self, wid: str) -> None:
+        self._watches.pop(wid, None)
+
+    # -- leases -------------------------------------------------------------
+
+    async def lease_grant(self, ttl: float) -> int:
+        lid = next(self._lease_ids)
+        self._leases[lid] = _Lease(lid, ttl, time.monotonic() + ttl)
+        return lid
+
+    async def lease_keepalive(self, lease_id: int) -> bool:
+        lease = self._leases.get(lease_id)
+        if lease is None:
+            return False
+        lease.expires_at = time.monotonic() + lease.ttl
+        return True
+
+    async def lease_revoke(self, lease_id: int) -> None:
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return
+        for key in list(lease.keys):
+            await self.kv_delete(key)
+
+    # -- pub/sub ------------------------------------------------------------
+
+    async def subscribe(self, pattern: str) -> Tuple[str, asyncio.Queue]:
+        sid = uuid.uuid4().hex
+        q: asyncio.Queue = asyncio.Queue()
+        self._subs[sid] = (pattern, q)
+        return sid, q
+
+    async def unsubscribe(self, sid: str) -> None:
+        self._subs.pop(sid, None)
+
+    async def publish(self, subject: str, payload: Any) -> int:
+        n = 0
+        for pattern, q in self._subs.values():
+            if subject_matches(pattern, subject):
+                q.put_nowait((subject, payload))
+                n += 1
+        return n
+
+    # -- queues (at-least-once) --------------------------------------------
+
+    async def q_push(self, queue: str, item: Any) -> None:
+        waiters = self._q_waiters.setdefault(queue, deque())
+        while waiters:
+            fut = waiters.popleft()
+            if not fut.done():
+                token = uuid.uuid4().hex
+                self._inflight[token] = (queue, item)
+                fut.set_result(_QueueItem(item, token))
+                return
+        self._queues.setdefault(queue, deque()).append(
+            _QueueItem(item, uuid.uuid4().hex)
+        )
+
+    async def q_pop(self, queue: str) -> _QueueItem:
+        dq = self._queues.setdefault(queue, deque())
+        if dq:
+            qi = dq.popleft()
+            self._inflight[qi.ack_token] = (queue, qi.item)
+            return qi
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._q_waiters.setdefault(queue, deque()).append(fut)
+        return await fut
+
+    async def q_ack(self, token: str) -> bool:
+        return self._inflight.pop(token, None) is not None
+
+    async def q_nack(self, token: str) -> bool:
+        """Requeue an in-flight item (redelivery; consumer died/declined)."""
+        entry = self._inflight.pop(token, None)
+        if entry is None:
+            return False
+        queue, item = entry
+        await self.q_push(queue, item)
+        return True
+
+    async def q_len(self, queue: str) -> int:
+        return len(self._queues.get(queue, ()))
+
+
+# --------------------------------------------------------------------------
+# In-process binding
+# --------------------------------------------------------------------------
+
+
+class _QueueIter:
+    """Async iterator over a queue with a None close-sentinel and aclose."""
+
+    def __init__(self, queue: asyncio.Queue, cancel: Callable):
+        self._queue = queue
+        self._cancel = cancel
+        self._closed = False
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        if self._closed:
+            raise StopAsyncIteration
+        item = await self._queue.get()
+        if item is None:
+            raise StopAsyncIteration
+        return item
+
+    async def aclose(self) -> None:
+        if not self._closed:
+            self._closed = True
+            await self._cancel()
+
+
+class Watcher(_QueueIter):
+    """Async iterator of WatchEvents.
+
+    The snapshot is terminated by a ``sync`` marker event; it is not yielded —
+    instead it sets ``synced`` so callers can wait for a consistent initial
+    view before routing.
+    """
+
+    def __init__(self, queue: asyncio.Queue, cancel: Callable):
+        super().__init__(queue, cancel)
+        self.synced = asyncio.Event()
+
+    async def __anext__(self) -> WatchEvent:
+        while True:
+            ev = await super().__anext__()
+            if ev.type == "sync":
+                self.synced.set()
+                continue
+            return ev
+
+
+class Subscription(_QueueIter):
+    """Async iterator of (subject, payload) with unsubscribe."""
+
+
+class InprocHub:
+    """Direct in-process hub (single-process serving, tests, static mode).
+
+    Leases granted here are auto-kept-alive (the owning process being alive IS
+    the liveness signal), matching HubClient's keepalive behaviour, until
+    ``lease_revoke``/``close``.
+    """
+
+    def __init__(self):
+        self.state = HubState()
+        self._keepalive_tasks: Dict[int, asyncio.Task] = {}
+
+    async def start(self) -> "InprocHub":
+        self.state.start_expiry_loop()
+        return self
+
+    async def close(self) -> None:
+        for t in self._keepalive_tasks.values():
+            t.cancel()
+        self._keepalive_tasks.clear()
+        await self.state.close()
+
+    # KV
+    async def kv_put(self, key, value, lease_id=None):
+        await self.state.kv_put(key, value, lease_id)
+
+    async def kv_get(self, key):
+        return await self.state.kv_get(key)
+
+    async def kv_get_prefix(self, prefix):
+        return await self.state.kv_get_prefix(prefix)
+
+    async def kv_delete(self, key):
+        return await self.state.kv_delete(key)
+
+    async def watch_prefix(self, prefix) -> Watcher:
+        wid, q = await self.state.watch_create(prefix)
+
+        async def cancel():
+            await self.state.watch_cancel(wid)
+            q.put_nowait(None)
+
+        return Watcher(q, cancel)
+
+    # leases
+    async def lease_grant(self, ttl: float = 10.0) -> int:
+        lid = await self.state.lease_grant(ttl)
+        self._keepalive_tasks[lid] = asyncio.get_running_loop().create_task(
+            self._keepalive_loop(lid, ttl)
+        )
+        return lid
+
+    async def _keepalive_loop(self, lease_id: int, ttl: float) -> None:
+        try:
+            while await self.state.lease_keepalive(lease_id):
+                await asyncio.sleep(max(ttl / 3.0, 0.05))
+        except asyncio.CancelledError:
+            pass
+
+    async def lease_keepalive(self, lease_id: int) -> bool:
+        return await self.state.lease_keepalive(lease_id)
+
+    async def lease_revoke(self, lease_id: int) -> None:
+        task = self._keepalive_tasks.pop(lease_id, None)
+        if task:
+            task.cancel()
+        await self.state.lease_revoke(lease_id)
+
+    # pub/sub
+    async def publish(self, subject, payload) -> None:
+        await self.state.publish(subject, payload)
+
+    async def subscribe(self, pattern) -> Subscription:
+        sid, q = await self.state.subscribe(pattern)
+
+        async def cancel():
+            await self.state.unsubscribe(sid)
+            q.put_nowait(None)
+
+        return Subscription(q, cancel)
+
+    # queues
+    async def q_push(self, queue, item) -> None:
+        await self.state.q_push(queue, item)
+
+    async def q_pop(self, queue) -> Tuple[Any, str]:
+        qi = await self.state.q_pop(queue)
+        return qi.item, qi.ack_token
+
+    async def q_ack(self, token) -> bool:
+        return await self.state.q_ack(token)
+
+    async def q_nack(self, token) -> bool:
+        return await self.state.q_nack(token)
+
+    async def q_len(self, queue) -> int:
+        return await self.state.q_len(queue)
+
+
+# --------------------------------------------------------------------------
+# TCP server
+# --------------------------------------------------------------------------
+
+
+class HubServer:
+    """TCP front for HubState: newline-delimited JSON request/push protocol.
+
+    Client → server: ``{"rid": n, "op": "...", ...}``
+    Server → client: ``{"rid": n, "ok": true, ...}`` or pushes
+    ``{"push": "watch"|"msg"|null, "id": sub_or_watch_id, ...}``.
+
+    Per-connection bookkeeping mirrors broker session semantics: dropping the
+    connection cancels its watches/subscriptions, requeues its unacked queue
+    items, and stops keepalives for its leases (which then expire → liveness).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self.state = HubState()
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    async def start(self) -> "HubServer":
+        self.state.start_expiry_loop()
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        await self.state.close()
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        session_watches: Dict[str, asyncio.Task] = {}
+        session_subs: Dict[str, asyncio.Task] = {}
+        session_unacked: Set[str] = set()
+        session_pop_tasks: Set[asyncio.Task] = set()
+        write_lock = asyncio.Lock()
+
+        async def send(obj: Any) -> None:
+            async with write_lock:
+                writer.write(json.dumps(obj).encode() + b"\n")
+                await writer.drain()
+
+        async def pump_watch(wid: str, q: asyncio.Queue):
+            while True:
+                ev = await q.get()
+                await send(
+                    {"push": "watch", "id": wid, "type": ev.type, "key": ev.key, "value": ev.value}
+                )
+
+        async def pump_sub(sid: str, q: asyncio.Queue):
+            while True:
+                subject, payload = await q.get()
+                await send({"push": "msg", "id": sid, "subject": subject, "payload": payload})
+
+        async def do_pop(rid: int, queue: str):
+            qi = await self.state.q_pop(queue)
+            session_unacked.add(qi.ack_token)
+            await send({"rid": rid, "ok": True, "item": qi.item, "token": qi.ack_token})
+
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    await send({"rid": None, "ok": False, "error": "bad json"})
+                    continue
+                rid, op = msg.get("rid"), msg.get("op")
+                try:
+                    st = self.state
+                    if op == "kv_put":
+                        await st.kv_put(msg["key"], msg.get("value"), msg.get("lease"))
+                        await send({"rid": rid, "ok": True})
+                    elif op == "kv_get":
+                        await send({"rid": rid, "ok": True, "value": await st.kv_get(msg["key"])})
+                    elif op == "kv_get_prefix":
+                        await send(
+                            {"rid": rid, "ok": True, "kvs": await st.kv_get_prefix(msg["prefix"])}
+                        )
+                    elif op == "kv_delete":
+                        await send({"rid": rid, "ok": True, "deleted": await st.kv_delete(msg["key"])})
+                    elif op == "watch":
+                        wid, q = await st.watch_create(msg["prefix"])
+                        # respond before pumping: the client must map wid → queue
+                        # before the first push (snapshot) hits the socket
+                        await send({"rid": rid, "ok": True, "id": wid})
+                        session_watches[wid] = asyncio.create_task(pump_watch(wid, q))
+                    elif op == "watch_cancel":
+                        wid = msg["id"]
+                        task = session_watches.pop(wid, None)
+                        if task:
+                            task.cancel()
+                        await st.watch_cancel(wid)
+                        await send({"rid": rid, "ok": True})
+                    elif op == "lease_grant":
+                        lid = await st.lease_grant(msg.get("ttl", 10.0))
+                        await send({"rid": rid, "ok": True, "lease": lid})
+                    elif op == "lease_keepalive":
+                        ok = await st.lease_keepalive(msg["lease"])
+                        await send({"rid": rid, "ok": ok})
+                    elif op == "lease_revoke":
+                        await st.lease_revoke(msg["lease"])
+                        await send({"rid": rid, "ok": True})
+                    elif op == "publish":
+                        n = await st.publish(msg["subject"], msg.get("payload"))
+                        await send({"rid": rid, "ok": True, "delivered": n})
+                    elif op == "subscribe":
+                        sid, q = await st.subscribe(msg["pattern"])
+                        await send({"rid": rid, "ok": True, "id": sid})
+                        session_subs[sid] = asyncio.create_task(pump_sub(sid, q))
+                    elif op == "unsubscribe":
+                        sid = msg["id"]
+                        task = session_subs.pop(sid, None)
+                        if task:
+                            task.cancel()
+                        await st.unsubscribe(sid)
+                        await send({"rid": rid, "ok": True})
+                    elif op == "q_push":
+                        await st.q_push(msg["queue"], msg.get("item"))
+                        await send({"rid": rid, "ok": True})
+                    elif op == "q_pop":
+                        t = asyncio.create_task(do_pop(rid, msg["queue"]))
+                        session_pop_tasks.add(t)
+                        t.add_done_callback(session_pop_tasks.discard)
+                    elif op == "q_ack":
+                        session_unacked.discard(msg["token"])
+                        await send({"rid": rid, "ok": await st.q_ack(msg["token"])})
+                    elif op == "q_nack":
+                        session_unacked.discard(msg["token"])
+                        await send({"rid": rid, "ok": await st.q_nack(msg["token"])})
+                    elif op == "q_len":
+                        await send({"rid": rid, "ok": True, "len": await st.q_len(msg["queue"])})
+                    elif op == "ping":
+                        await send({"rid": rid, "ok": True})
+                    else:
+                        await send({"rid": rid, "ok": False, "error": f"unknown op {op}"})
+                except Exception as e:  # noqa: BLE001 — protocol surface
+                    await send({"rid": rid, "ok": False, "error": str(e)})
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            for task in list(session_watches.values()) + list(session_subs.values()):
+                task.cancel()
+            for task in session_pop_tasks:
+                task.cancel()
+            for wid in session_watches:
+                await self.state.watch_cancel(wid)
+            for sid in session_subs:
+                await self.state.unsubscribe(sid)
+            for token in list(session_unacked):
+                await self.state.q_nack(token)
+            writer.close()
+
+
+# --------------------------------------------------------------------------
+# TCP client
+# --------------------------------------------------------------------------
+
+
+class HubClient:
+    """Asyncio client for HubServer; same interface as InprocHub.
+
+    Leases granted through this client are kept alive automatically by a
+    background task (ttl/3 cadence) until ``lease_revoke``/``close`` — the
+    reference's etcd lease keep-alive loop (transports/etcd/lease.rs:51).
+    """
+
+    def __init__(self, address: str):
+        self.address = address
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._rids = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._watch_queues: Dict[str, asyncio.Queue] = {}
+        self._sub_queues: Dict[str, asyncio.Queue] = {}
+        # pushes that arrive before the requesting coroutine registers its
+        # queue (read_loop may outrun watch_prefix/subscribe resumption)
+        self._early_pushes: Dict[str, List[Any]] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+        self._keepalive_tasks: Dict[int, asyncio.Task] = {}
+        self._write_lock = asyncio.Lock()
+        self._closed = False
+
+    async def connect(self) -> "HubClient":
+        host, port = self.address.rsplit(":", 1)
+        self._reader, self._writer = await asyncio.open_connection(host, int(port))
+        self._reader_task = asyncio.create_task(self._read_loop())
+        return self
+
+    async def close(self) -> None:
+        self._closed = True
+        for t in self._keepalive_tasks.values():
+            t.cancel()
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer:
+            self._writer.close()
+        for q in self._watch_queues.values():
+            q.put_nowait(None)
+        for q in self._sub_queues.values():
+            q.put_nowait(None)
+
+    async def _read_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                line = await self._reader.readline()
+                if not line:
+                    break
+                msg = json.loads(line)
+                push = msg.get("push")
+                if push == "watch":
+                    item = WatchEvent(msg["type"], msg["key"], msg.get("value"))
+                    q = self._watch_queues.get(msg["id"])
+                    if q:
+                        q.put_nowait(item)
+                    else:
+                        self._early_pushes.setdefault(msg["id"], []).append(item)
+                elif push == "msg":
+                    item = (msg["subject"], msg.get("payload"))
+                    q = self._sub_queues.get(msg["id"])
+                    if q:
+                        q.put_nowait(item)
+                    else:
+                        self._early_pushes.setdefault(msg["id"], []).append(item)
+                else:
+                    fut = self._pending.pop(msg.get("rid"), None)
+                    if fut and not fut.done():
+                        fut.set_result(msg)
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+        finally:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("hub connection lost"))
+            self._pending.clear()
+
+    async def _request(self, op: str, **kw) -> Dict[str, Any]:
+        if self._writer is None:
+            raise ConnectionError("not connected")
+        rid = next(self._rids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        payload = {"rid": rid, "op": op, **kw}
+        async with self._write_lock:
+            self._writer.write(json.dumps(payload).encode() + b"\n")
+            await self._writer.drain()
+        msg = await fut
+        if not msg.get("ok") and op not in ("lease_keepalive", "q_ack", "q_nack"):
+            raise RuntimeError(msg.get("error", f"{op} failed"))
+        return msg
+
+    # KV
+    async def kv_put(self, key, value, lease_id=None):
+        await self._request("kv_put", key=key, value=value, lease=lease_id)
+
+    async def kv_get(self, key):
+        return (await self._request("kv_get", key=key)).get("value")
+
+    async def kv_get_prefix(self, prefix):
+        return (await self._request("kv_get_prefix", prefix=prefix)).get("kvs", {})
+
+    async def kv_delete(self, key):
+        return (await self._request("kv_delete", key=key)).get("deleted", False)
+
+    async def watch_prefix(self, prefix) -> Watcher:
+        resp = await self._request("watch", prefix=prefix)
+        wid = resp["id"]
+        q: asyncio.Queue = asyncio.Queue()
+        for item in self._early_pushes.pop(wid, []):
+            q.put_nowait(item)
+        self._watch_queues[wid] = q
+
+        async def cancel():
+            self._watch_queues.pop(wid, None)
+            if not self._closed:
+                try:
+                    await self._request("watch_cancel", id=wid)
+                except (ConnectionError, RuntimeError):
+                    pass
+            q.put_nowait(None)
+
+        return Watcher(q, cancel)
+
+    # leases
+    async def lease_grant(self, ttl: float = 10.0) -> int:
+        lid = (await self._request("lease_grant", ttl=ttl))["lease"]
+        self._keepalive_tasks[lid] = asyncio.create_task(self._keepalive_loop(lid, ttl))
+        return lid
+
+    async def _keepalive_loop(self, lease_id: int, ttl: float) -> None:
+        try:
+            while True:
+                await asyncio.sleep(max(ttl / 3.0, 0.05))
+                ok = (await self._request("lease_keepalive", lease=lease_id)).get("ok")
+                if not ok:
+                    return
+        except (asyncio.CancelledError, ConnectionError):
+            pass
+
+    async def lease_keepalive(self, lease_id: int) -> bool:
+        return (await self._request("lease_keepalive", lease=lease_id)).get("ok", False)
+
+    async def lease_revoke(self, lease_id: int) -> None:
+        task = self._keepalive_tasks.pop(lease_id, None)
+        if task:
+            task.cancel()
+        await self._request("lease_revoke", lease=lease_id)
+
+    # pub/sub
+    async def publish(self, subject, payload) -> None:
+        await self._request("publish", subject=subject, payload=payload)
+
+    async def subscribe(self, pattern) -> Subscription:
+        resp = await self._request("subscribe", pattern=pattern)
+        sid = resp["id"]
+        q: asyncio.Queue = asyncio.Queue()
+        for item in self._early_pushes.pop(sid, []):
+            q.put_nowait(item)
+        self._sub_queues[sid] = q
+
+        async def cancel():
+            self._sub_queues.pop(sid, None)
+            if not self._closed:
+                try:
+                    await self._request("unsubscribe", id=sid)
+                except (ConnectionError, RuntimeError):
+                    pass
+            q.put_nowait(None)
+
+        return Subscription(q, cancel)
+
+    # queues
+    async def q_push(self, queue, item) -> None:
+        await self._request("q_push", queue=queue, item=item)
+
+    async def q_pop(self, queue) -> Tuple[Any, str]:
+        resp = await self._request("q_pop", queue=queue)
+        return resp["item"], resp["token"]
+
+    async def q_ack(self, token) -> bool:
+        return (await self._request("q_ack", token=token)).get("ok", False)
+
+    async def q_nack(self, token) -> bool:
+        return (await self._request("q_nack", token=token)).get("ok", False)
+
+    async def q_len(self, queue) -> int:
+        return (await self._request("q_len", queue=queue))["len"]

@@ -1,0 +1,188 @@
+"""TCP service plane: serve AsyncEngines remotely, call them as AsyncEngines.
+
+Reference semantics: the request plane (NATS request → endpoint subject,
+pipeline/network/egress/push.rs:88-158) + response plane (direct TCP callback
+with prologue handshake and streamed frames, tcp/{server,client}.rs) — here
+collapsed onto ONE direct TCP connection per request: the client dials the
+worker, sends header+data (TwoPartMessage), reads a prologue then streamed
+items.  CANCEL/KILL frames flow client→worker mid-stream, giving remote
+cancellation the same semantics as in-process ``stop_generating``/``kill``
+(the reference gets this implicitly by dropping the response stream;
+explicit frames are stronger).
+
+A send failure on the worker side stops generation for that request
+(push_handler.rs:100-116 behaviour).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, AsyncIterator, Callable, Dict, Optional
+
+from ..engine import AsyncEngine, AsyncEngineContext, Context, ResponseStream
+from .codec import FrameType, read_frame, write_frame
+
+
+class RemoteEngineError(RuntimeError):
+    """Error raised by the remote engine (propagated through RESP_ERROR)."""
+
+
+class ServiceServer:
+    """Hosts AsyncEngines at string paths over TCP; one request per connection."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._endpoints: Dict[str, AsyncEngine] = {}
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._active: set = set()
+
+    def register(self, path: str, engine: AsyncEngine) -> None:
+        self._endpoints[path] = engine
+
+    def unregister(self, path: str) -> None:
+        self._endpoints.pop(path, None)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> "ServiceServer":
+        if self._server is None:
+            self._server = await asyncio.start_server(self._handle, self.host, self.port)
+            self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for task in list(self._active):
+            task.cancel()
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        task = asyncio.current_task()
+        self._active.add(task)
+        ctx: Optional[AsyncEngineContext] = None
+        control_task: Optional[asyncio.Task] = None
+        try:
+            header_frame = await read_frame(reader)
+            if header_frame.type != FrameType.REQ_HEADER:
+                return
+            header = header_frame.unpack()
+            data_frame = await read_frame(reader)
+            if data_frame.type != FrameType.REQ_DATA:
+                return
+
+            engine = self._endpoints.get(header.get("endpoint", ""))
+            if engine is None:
+                await write_frame(
+                    writer,
+                    FrameType.RESP_PROLOGUE,
+                    {"ok": False, "error": f"no such endpoint: {header.get('endpoint')}"},
+                )
+                return
+
+            ctx = AsyncEngineContext(header.get("id"))
+            request = Context(data_frame.unpack(), ctx)
+
+            async def control_loop():
+                # reads CANCEL/KILL from the client for the life of the stream
+                try:
+                    while True:
+                        frame = await read_frame(reader)
+                        if frame.type == FrameType.CANCEL:
+                            ctx.stop_generating()
+                        elif frame.type == FrameType.KILL:
+                            ctx.kill()
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    # client went away entirely
+                    ctx.stop_generating()
+
+            control_task = asyncio.create_task(control_loop())
+
+            try:
+                stream = await engine.generate(request)
+            except Exception as e:  # noqa: BLE001 — remote boundary
+                await write_frame(
+                    writer, FrameType.RESP_PROLOGUE, {"ok": False, "error": str(e)}
+                )
+                return
+
+            await write_frame(writer, FrameType.RESP_PROLOGUE, {"ok": True})
+            try:
+                async for item in stream:
+                    await write_frame(writer, FrameType.RESP_ITEM, item)
+                await write_frame(writer, FrameType.RESP_COMPLETE)
+            except (ConnectionResetError, BrokenPipeError):
+                ctx.stop_generating()
+            except Exception as e:  # noqa: BLE001 — stream error to client
+                try:
+                    await write_frame(writer, FrameType.RESP_ERROR, {"error": str(e)})
+                except (ConnectionResetError, BrokenPipeError):
+                    pass
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            if ctx is not None:
+                ctx.stop_generating()
+        finally:
+            if control_task is not None:
+                control_task.cancel()
+            writer.close()
+            self._active.discard(task)
+
+
+class RemoteEngine(AsyncEngine):
+    """AsyncEngine proxy for an endpoint served by a remote ServiceServer."""
+
+    def __init__(self, address: str, endpoint: str):
+        self.address = address
+        self.endpoint = endpoint
+
+    async def generate(self, request: Context) -> ResponseStream:
+        host, port = self.address.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        try:
+            await write_frame(
+                writer, FrameType.REQ_HEADER, {"id": request.id, "endpoint": self.endpoint}
+            )
+            await write_frame(writer, FrameType.REQ_DATA, request.data)
+            prologue_frame = await read_frame(reader)
+            prologue = prologue_frame.unpack()
+            if not prologue.get("ok"):
+                raise RemoteEngineError(prologue.get("error", "remote engine error"))
+        except BaseException:
+            writer.close()
+            raise
+
+        ctx = request.ctx
+
+        async def forward_cancel():
+            try:
+                await ctx.stopped()
+                await write_frame(
+                    writer, FrameType.KILL if ctx.is_killed else FrameType.CANCEL
+                )
+            except (asyncio.CancelledError, ConnectionResetError, BrokenPipeError):
+                pass
+
+        cancel_task = asyncio.create_task(forward_cancel())
+
+        async def items() -> AsyncIterator[Any]:
+            try:
+                while True:
+                    frame = await read_frame(reader)
+                    if frame.type == FrameType.RESP_ITEM:
+                        yield frame.unpack()
+                    elif frame.type == FrameType.RESP_COMPLETE:
+                        return
+                    elif frame.type == FrameType.RESP_ERROR:
+                        raise RemoteEngineError(frame.unpack().get("error", "remote error"))
+                    # ignore heartbeats/unknown
+            except asyncio.IncompleteReadError:
+                raise RemoteEngineError("remote connection closed mid-stream")
+            finally:
+                cancel_task.cancel()
+                writer.close()
+
+        return ResponseStream(items(), ctx)

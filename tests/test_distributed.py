@@ -1,0 +1,203 @@
+"""Distributed serving tests: endpoint serve/call over TCP, routing, failover.
+
+Mirrors the reference's remote-endpoint stack (SURVEY §3.2): worker serves an
+engine at dyn://ns.comp.ep; clients discover via the hub and stream responses
+over TCP, including remote cancellation.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import (
+    Context,
+    DistributedRuntime,
+    HubServer,
+    RemoteEngineError,
+    RouterMode,
+    collect,
+)
+
+
+async def serve_echo(runtime: DistributedRuntime, ns="test", comp="worker", ep="generate"):
+    async def echo(request: Context):
+        for tok in request.data["tokens"]:
+            yield {"token": tok, "worker": runtime.worker_id}
+
+    endpoint = runtime.namespace(ns).component(comp).endpoint(ep)
+    served = await endpoint.serve_endpoint(echo)
+    return endpoint, served
+
+
+@pytest.mark.asyncio
+async def test_serve_and_call_remote_endpoint():
+    hub_server = await HubServer().start()
+    worker_rt = await DistributedRuntime.connect(hub_server.address)
+    client_rt = await DistributedRuntime.connect(hub_server.address)
+    try:
+        await serve_echo(worker_rt)
+        endpoint = client_rt.namespace("test").component("worker").endpoint("generate")
+        client = await endpoint.client()
+        await client.wait_for_instances(2)
+        stream = await client.generate(Context({"tokens": [1, 2, 3]}))
+        items = await collect(stream)
+        assert [i["token"] for i in items] == [1, 2, 3]
+        await client.close()
+    finally:
+        await worker_rt.close()
+        await client_rt.close()
+        await hub_server.close()
+
+
+@pytest.mark.asyncio
+async def test_remote_cancellation_stops_worker():
+    hub_server = await HubServer().start()
+    worker_rt = await DistributedRuntime.connect(hub_server.address)
+    client_rt = await DistributedRuntime.connect(hub_server.address)
+    worker_saw_stop = asyncio.Event()
+    try:
+        async def slow(request: Context):
+            for i in range(1000):
+                if request.is_stopped:
+                    worker_saw_stop.set()
+                    return
+                yield {"i": i}
+                await asyncio.sleep(0.01)
+
+        ep = worker_rt.namespace("t").component("w").endpoint("gen")
+        await ep.serve_endpoint(slow)
+
+        client_ep = client_rt.namespace("t").component("w").endpoint("gen")
+        client = await client_ep.client()
+        await client.wait_for_instances(2)
+        req = Context({})
+        stream = await client.generate(req)
+        got = []
+        async for item in stream:
+            got.append(item)
+            if len(got) == 3:
+                req.stop_generating()
+                break
+        await asyncio.wait_for(worker_saw_stop.wait(), 3)
+        await client.close()
+    finally:
+        await worker_rt.close()
+        await client_rt.close()
+        await hub_server.close()
+
+
+@pytest.mark.asyncio
+async def test_round_robin_across_workers_and_failover():
+    hub_server = await HubServer().start()
+    w1 = await DistributedRuntime.connect(hub_server.address)
+    w2 = await DistributedRuntime.connect(hub_server.address)
+    client_rt = await DistributedRuntime.connect(hub_server.address)
+    try:
+        await serve_echo(w1)
+        await serve_echo(w2)
+        ep = client_rt.namespace("test").component("worker").endpoint("generate")
+        client = await ep.client(router_mode=RouterMode.ROUND_ROBIN)
+        await client.wait_for_instances(2)
+        while len(client.instance_ids) < 2:
+            await asyncio.sleep(0.02)
+
+        seen = set()
+        for _ in range(4):
+            items = await collect(await client.generate(Context({"tokens": [0]})))
+            seen.add(items[0]["worker"])
+        assert seen == {w1.worker_id, w2.worker_id}
+
+        # worker 1 dies → lease expires → instance set shrinks → traffic flows
+        await w1.close()
+        while w1.worker_id in client.instance_ids:
+            await asyncio.sleep(0.05)
+        for _ in range(3):
+            items = await collect(await client.generate(Context({"tokens": [0]})))
+            assert items[0]["worker"] == w2.worker_id
+        await client.close()
+    finally:
+        await w2.close()
+        await client_rt.close()
+        await hub_server.close()
+
+
+@pytest.mark.asyncio
+async def test_direct_routing_by_worker_id():
+    hub_server = await HubServer().start()
+    w1 = await DistributedRuntime.connect(hub_server.address)
+    w2 = await DistributedRuntime.connect(hub_server.address)
+    client_rt = await DistributedRuntime.connect(hub_server.address)
+    try:
+        await serve_echo(w1)
+        await serve_echo(w2)
+        ep = client_rt.namespace("test").component("worker").endpoint("generate")
+        client = await ep.client()
+        await client.wait_for_instances(2)
+        while len(client.instance_ids) < 2:
+            await asyncio.sleep(0.02)
+        for target in (w1.worker_id, w2.worker_id):
+            items = await collect(await client.direct(Context({"tokens": [9]}), target))
+            assert items[0]["worker"] == target
+        await client.close()
+    finally:
+        await w1.close()
+        await w2.close()
+        await client_rt.close()
+        await hub_server.close()
+
+
+@pytest.mark.asyncio
+async def test_remote_engine_error_propagates():
+    hub_server = await HubServer().start()
+    worker_rt = await DistributedRuntime.connect(hub_server.address)
+    client_rt = await DistributedRuntime.connect(hub_server.address)
+    try:
+        async def failing(request: Context):
+            yield {"ok": 1}
+            raise ValueError("engine exploded")
+
+        ep = worker_rt.namespace("t").component("w").endpoint("fail")
+        await ep.serve_endpoint(failing)
+        client_ep = client_rt.namespace("t").component("w").endpoint("fail")
+        client = await client_ep.client()
+        await client.wait_for_instances(2)
+        stream = await client.generate(Context({}))
+        with pytest.raises(RemoteEngineError, match="engine exploded"):
+            await collect(stream)
+        await client.close()
+    finally:
+        await worker_rt.close()
+        await client_rt.close()
+        await hub_server.close()
+
+
+@pytest.mark.asyncio
+async def test_unknown_endpoint_rejected_in_prologue():
+    hub_server = await HubServer().start()
+    worker_rt = await DistributedRuntime.connect(hub_server.address)
+    try:
+        await serve_echo(worker_rt)
+        server = await worker_rt.service_server()
+        from dynamo_tpu.runtime import RemoteEngine
+
+        bad = RemoteEngine(server.address, "no.such.endpoint")
+        with pytest.raises(RemoteEngineError, match="no such endpoint"):
+            await bad.generate(Context({}))
+    finally:
+        await worker_rt.close()
+        await hub_server.close()
+
+
+@pytest.mark.asyncio
+async def test_detached_runtime_inproc():
+    runtime = await DistributedRuntime.detached()
+    try:
+        await serve_echo(runtime)
+        ep = runtime.namespace("test").component("worker").endpoint("generate")
+        client = await ep.client()
+        await client.wait_for_instances(2)
+        items = await collect(await client.generate(Context({"tokens": [7]})))
+        assert items[0]["token"] == 7
+        await client.close()
+    finally:
+        await runtime.close()

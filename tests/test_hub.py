@@ -1,0 +1,185 @@
+"""Hub tests: KV/lease/watch, pub/sub wildcards, at-least-once queues.
+
+Mirrors the reference's transport tests + the python-binding integration
+fixture that launches real etcd/nats (test_kv_bindings.py:38-53) — here the
+hub is in-repo so the server runs in-process on a loopback port.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.transports.hub import (
+    HubClient,
+    HubServer,
+    InprocHub,
+    subject_matches,
+)
+
+
+def test_subject_matching():
+    assert subject_matches("a.b.c", "a.b.c")
+    assert not subject_matches("a.b.c", "a.b.d")
+    assert subject_matches("a.*.c", "a.x.c")
+    assert not subject_matches("a.*.c", "a.x.y")
+    assert subject_matches("a.>", "a.b.c.d")
+    assert not subject_matches("a.>", "a")
+    assert not subject_matches("a.b", "a.b.c")
+
+
+async def hub_pair():
+    server = await HubServer().start()
+    client = await HubClient(server.address).connect()
+    return server, client
+
+
+@pytest.mark.asyncio
+async def test_kv_roundtrip_tcp():
+    server, client = await hub_pair()
+    try:
+        await client.kv_put("models/llama", {"ctx": 8192})
+        assert await client.kv_get("models/llama") == {"ctx": 8192}
+        await client.kv_put("models/mixtral", {"ctx": 32768})
+        kvs = await client.kv_get_prefix("models/")
+        assert set(kvs) == {"models/llama", "models/mixtral"}
+        assert await client.kv_delete("models/llama") is True
+        assert await client.kv_get("models/llama") is None
+    finally:
+        await client.close()
+        await server.close()
+
+
+@pytest.mark.asyncio
+async def test_watch_snapshot_then_delta():
+    server, client = await hub_pair()
+    try:
+        await client.kv_put("w/a", 1)
+        watcher = await client.watch_prefix("w/")
+        ev = await asyncio.wait_for(watcher.__anext__(), 2)
+        assert (ev.type, ev.key, ev.value) == ("put", "w/a", 1)
+        await client.kv_put("w/b", 2)
+        ev = await asyncio.wait_for(watcher.__anext__(), 2)
+        assert (ev.type, ev.key) == ("put", "w/b")
+        await client.kv_delete("w/a")
+        ev = await asyncio.wait_for(watcher.__anext__(), 2)
+        assert (ev.type, ev.key) == ("delete", "w/a")
+        await watcher.aclose()
+    finally:
+        await client.close()
+        await server.close()
+
+
+@pytest.mark.asyncio
+async def test_lease_expiry_deletes_keys_and_notifies():
+    """Liveness: dead worker's keys vanish when its lease expires."""
+    server = await HubServer().start()
+    observer = await HubClient(server.address).connect()
+    worker = await HubClient(server.address).connect()
+    try:
+        watcher = await observer.watch_prefix("inst/")
+        lease = await worker.lease_grant(ttl=0.4)
+        await worker.kv_put("inst/w1", {"addr": "x"}, lease_id=lease)
+        ev = await asyncio.wait_for(watcher.__anext__(), 2)
+        assert ev.type == "put"
+        # kill the worker connection abruptly: keepalives stop, lease expires
+        await worker.close()
+        ev = await asyncio.wait_for(watcher.__anext__(), 5)
+        assert (ev.type, ev.key) == ("delete", "inst/w1")
+        assert await observer.kv_get("inst/w1") is None
+    finally:
+        await observer.close()
+        await server.close()
+
+
+@pytest.mark.asyncio
+async def test_lease_keepalive_sustains_past_ttl():
+    server, client = await hub_pair()
+    try:
+        lease = await client.lease_grant(ttl=0.4)
+        await client.kv_put("ka/x", 1, lease_id=lease)
+        await asyncio.sleep(1.2)  # > ttl; client keepalive loop sustains it
+        assert await client.kv_get("ka/x") == 1
+        await client.lease_revoke(lease)
+        assert await client.kv_get("ka/x") is None
+    finally:
+        await client.close()
+        await server.close()
+
+
+@pytest.mark.asyncio
+async def test_pubsub_wildcard_fanout():
+    server = await HubServer().start()
+    a = await HubClient(server.address).connect()
+    b = await HubClient(server.address).connect()
+    try:
+        sub_exact = await a.subscribe("ns.worker.kv_events")
+        sub_wild = await a.subscribe("ns.>")
+        await asyncio.sleep(0.05)
+        await b.publish("ns.worker.kv_events", {"event_id": 1})
+        subject, payload = await asyncio.wait_for(sub_exact.__anext__(), 2)
+        assert payload == {"event_id": 1}
+        subject, payload = await asyncio.wait_for(sub_wild.__anext__(), 2)
+        assert subject == "ns.worker.kv_events"
+        await sub_exact.aclose()
+        await sub_wild.aclose()
+    finally:
+        await a.close()
+        await b.close()
+        await server.close()
+
+
+@pytest.mark.asyncio
+async def test_queue_at_least_once_redelivery():
+    """Unacked items from a dead consumer are redelivered (JetStream-style)."""
+    server = await HubServer().start()
+    producer = await HubClient(server.address).connect()
+    consumer1 = await HubClient(server.address).connect()
+    consumer2 = await HubClient(server.address).connect()
+    try:
+        await producer.q_push("prefill", {"req": 1})
+        item, token = await consumer1.q_pop("prefill")
+        assert item == {"req": 1}
+        # consumer1 dies without acking → redelivery to consumer2
+        await consumer1.close()
+        item2, token2 = await asyncio.wait_for(consumer2.q_pop("prefill"), 2)
+        assert item2 == {"req": 1}
+        assert await consumer2.q_ack(token2)
+        assert await producer.q_len("prefill") == 0
+    finally:
+        await producer.close()
+        await consumer2.close()
+        await server.close()
+
+
+@pytest.mark.asyncio
+async def test_queue_blocking_pop_then_push():
+    server, client = await hub_pair()
+    try:
+        pop_task = asyncio.create_task(client.q_pop("jobs"))
+        await asyncio.sleep(0.05)
+        await client.q_push("jobs", "job-1")
+        item, token = await asyncio.wait_for(pop_task, 2)
+        assert item == "job-1"
+        await client.q_ack(token)
+    finally:
+        await client.close()
+        await server.close()
+
+
+@pytest.mark.asyncio
+async def test_inproc_hub_same_interface():
+    hub = await InprocHub().start()
+    try:
+        lease = await hub.lease_grant(ttl=5)
+        await hub.kv_put("k", "v", lease_id=lease)
+        assert await hub.kv_get("k") == "v"
+        sub = await hub.subscribe("t.*")
+        await hub.publish("t.x", 42)
+        _, payload = await asyncio.wait_for(sub.__anext__(), 2)
+        assert payload == 42
+        await sub.aclose()
+        await hub.q_push("q", 1)
+        item, token = await hub.q_pop("q")
+        assert item == 1 and await hub.q_ack(token)
+    finally:
+        await hub.close()
