@@ -1,0 +1,162 @@
+"""CLI launcher — reference: launch/dynamo-run (``dynamo-run in=… out=…``),
+components/http (standalone frontend), plus the hub (docker-compose
+etcd+NATS replacement).
+
+Usage:
+  python -m dynamo_tpu.cli hub  [--host H] [--port P]
+  python -m dynamo_tpu.cli run  in=http out=echocore [--port 8000] [--model echo]
+  python -m dynamo_tpu.cli run  in=dyn://ns.comp.ep out=echocore --hub HOST:PORT \
+        [--model NAME]            # worker: serve engine at endpoint + register model
+  python -m dynamo_tpu.cli http --hub HOST:PORT [--port 8000]   # discovery frontend
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+from typing import Optional
+
+from .llm.backend import Backend
+from .llm.discovery import ModelWatcher, make_tokenizer, register_model
+from .llm.engines import EchoEngineCore, EchoEngineFull
+from .llm.http_service import HttpService
+from .llm.preprocessor import OpenAIPreprocessor
+from .runtime.component import DistributedRuntime, parse_endpoint_path
+from .runtime.pipeline import build_pipeline
+from .runtime.transports.hub import HubServer
+
+logger = logging.getLogger(__name__)
+
+
+def _build_engine(out: str, args):
+    """out= engine factory.  TPU JAX engine registers here as out=tpu."""
+    if out == "echocore":
+        return EchoEngineCore(), "core"
+    if out == "echofull":
+        return EchoEngineFull(), "full"
+    if out == "tpu":
+        from .engine import build_tpu_engine  # deferred: imports jax
+
+        return build_tpu_engine(args), "core"
+    raise SystemExit(f"unknown out= engine: {out!r}")
+
+
+def _tokenizer_spec(args) -> dict:
+    if getattr(args, "tokenizer", None):
+        return {"kind": "hf", "file": args.tokenizer}
+    return {"kind": "byte"}
+
+
+async def _run_hub(args) -> None:
+    server = await HubServer(host=args.host, port=args.port).start()
+    print(f"hub listening on {server.address}", flush=True)
+    await _wait_forever()
+
+
+async def _run_http_frontend(args) -> None:
+    runtime = await DistributedRuntime.connect(args.hub)
+    service = HttpService(host=args.host, port=args.port)
+    watcher = await ModelWatcher(runtime, service.models).start()
+    await service.start()
+    print(f"OpenAI frontend on http://{service.host}:{service.port}", flush=True)
+    try:
+        await _wait_forever()
+    finally:
+        await watcher.stop()
+        await service.close()
+        await runtime.close()
+
+
+async def _run(args) -> None:
+    inp = args.inp
+    engine, level = _build_engine(args.out, args)
+    tokenizer = make_tokenizer(_tokenizer_spec(args))
+
+    if inp == "http":
+        service = HttpService(host=args.host, port=args.port)
+        if level == "core":
+            pipeline = build_pipeline(
+                [OpenAIPreprocessor(tokenizer, args.model), Backend(tokenizer)], engine
+            )
+        else:
+            pipeline = engine
+        service.models.add_chat_model(args.model, pipeline)
+        service.models.add_completion_model(args.model, pipeline)
+        print(f"serving {args.model!r} on http://{args.host}:{args.port}", flush=True)
+        await service.run()
+    elif inp.startswith("dyn://"):
+        if not args.hub:
+            raise SystemExit("worker mode requires --hub HOST:PORT")
+        runtime = await DistributedRuntime.connect(args.hub)
+        ns, comp, ep = parse_endpoint_path(inp)
+        endpoint = runtime.namespace(ns).component(comp).endpoint(ep)
+        await endpoint.serve_endpoint(engine)
+        await register_model(
+            runtime, args.model, endpoint.path, tokenizer=_tokenizer_spec(args)
+        )
+        print(f"worker serving {inp} (model {args.model!r})", flush=True)
+        try:
+            await _wait_forever()
+        finally:
+            await runtime.close()
+    else:
+        raise SystemExit(f"unknown in= input: {inp!r}")
+
+
+async def _wait_forever() -> None:
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    await stop.wait()
+
+
+def main(argv: Optional[list] = None) -> None:
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    parser = argparse.ArgumentParser(prog="dynamo-tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_hub = sub.add_parser("hub", help="run the control-plane hub")
+    p_hub.add_argument("--host", default="0.0.0.0")
+    p_hub.add_argument("--port", type=int, default=6650)
+
+    p_http = sub.add_parser("http", help="standalone OpenAI frontend w/ discovery")
+    p_http.add_argument("--hub", required=True)
+    p_http.add_argument("--host", default="0.0.0.0")
+    p_http.add_argument("--port", type=int, default=8000)
+
+    p_run = sub.add_parser("run", help="in=… out=… launcher")
+    p_run.add_argument("inout", nargs=2, metavar="in=/out=")
+    p_run.add_argument("--hub", default=None)
+    p_run.add_argument("--host", default="0.0.0.0")
+    p_run.add_argument("--port", type=int, default=8000)
+    p_run.add_argument("--model", default="echo")
+    p_run.add_argument("--tokenizer", default=None, help="path to tokenizer.json")
+    p_run.add_argument("--model-config", default=None, help="model config json (out=tpu)")
+
+    args = parser.parse_args(argv)
+    if args.cmd == "run":
+        kv = dict(part.split("=", 1) for part in args.inout)
+        if "in" not in kv or "out" not in kv:
+            raise SystemExit("run requires in=… out=…")
+        args.inp, args.out = kv["in"], kv["out"]
+
+    try:
+        if args.cmd == "hub":
+            asyncio.run(_run_hub(args))
+        elif args.cmd == "http":
+            asyncio.run(_run_http_frontend(args))
+        else:
+            asyncio.run(_run(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

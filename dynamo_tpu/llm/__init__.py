@@ -1,0 +1,49 @@
+"""LLM serving library (reference: lib/llm/)."""
+
+from .backend import Backend, Decoder
+from .discovery import ModelWatcher, make_tokenizer, register_model
+from .http_service import HttpService, ModelManager
+from .engines import EchoEngineCore, EchoEngineFull
+from .openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    DeltaGenerator,
+    aggregate_chunks,
+    sse_encode,
+)
+from .preprocessor import OpenAIPreprocessor
+from .protocols import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from .tokenizer import BaseTokenizer, ByteTokenizer, DecodeStream, HFTokenizer
+
+__all__ = [
+    "Backend",
+    "Decoder",
+    "ModelWatcher",
+    "make_tokenizer",
+    "register_model",
+    "HttpService",
+    "ModelManager",
+    "EchoEngineCore",
+    "EchoEngineFull",
+    "ChatCompletionRequest",
+    "CompletionRequest",
+    "DeltaGenerator",
+    "aggregate_chunks",
+    "sse_encode",
+    "OpenAIPreprocessor",
+    "FinishReason",
+    "LLMEngineOutput",
+    "PreprocessedRequest",
+    "SamplingOptions",
+    "StopConditions",
+    "BaseTokenizer",
+    "ByteTokenizer",
+    "DecodeStream",
+    "HFTokenizer",
+]

@@ -1,0 +1,141 @@
+"""Model discovery: hub-watched ModelEntry registry → live HTTP models.
+
+Reference semantics: lib/llm/src/http/service/discovery.rs:36-166 — the HTTP
+frontend watches ``models/`` registrations; a Put builds a typed remote
+pipeline and adds it to the ModelManager, a Delete (lease expiry = worker
+death) removes it.  Workers register a ``ModelEntry`` naming the token-level
+endpoint they serve plus enough tokenizer info for the frontend to run the
+preprocessor locally (the reference ships this in the ModelDeploymentCard).
+
+Entry key: ``models/{model_name}/{worker_id}`` so multiple workers can back
+one model; the engine is added on the first entry, removed with the last.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, Optional
+
+from ..runtime.client import RouterMode
+from ..runtime.component import DistributedRuntime, parse_endpoint_path
+from ..runtime.pipeline import build_pipeline
+from .backend import Backend
+from .http_service import ModelManager
+from .preprocessor import OpenAIPreprocessor
+from .tokenizer import BaseTokenizer, ByteTokenizer, HFTokenizer
+
+logger = logging.getLogger(__name__)
+
+MODEL_PREFIX = "models/"
+
+
+def make_tokenizer(spec: Dict[str, Any]) -> BaseTokenizer:
+    kind = (spec or {}).get("kind", "byte")
+    if kind == "byte":
+        return ByteTokenizer()
+    if kind == "hf":
+        if "file" in spec:
+            return HFTokenizer(spec["file"])
+        return HFTokenizer.from_pretrained_dir(spec["dir"])
+    raise ValueError(f"unknown tokenizer kind {kind!r}")
+
+
+async def register_model(
+    runtime: DistributedRuntime,
+    name: str,
+    endpoint_path: str,
+    model_type: str = "both",  # chat | completion | both
+    tokenizer: Optional[Dict[str, Any]] = None,
+    lease: Optional[int] = None,
+) -> str:
+    """Worker-side model registration (reference: llmctl + ModelEntry)."""
+    key = f"{MODEL_PREFIX}{name}/{runtime.worker_id}"
+    entry = {
+        "name": name,
+        "endpoint": endpoint_path,
+        "model_type": model_type,
+        "tokenizer": tokenizer or {"kind": "byte"},
+    }
+    await runtime.hub.kv_put(key, entry, lease if lease is not None else runtime.primary_lease)
+    return key
+
+
+class ModelWatcher:
+    """Watches model registrations and maintains a ModelManager."""
+
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        manager: ModelManager,
+        router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+    ):
+        self.runtime = runtime
+        self.manager = manager
+        self.router_mode = router_mode
+        self._refcount: Dict[str, int] = {}
+        self._clients: Dict[str, Any] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._watcher = None
+
+    async def start(self) -> "ModelWatcher":
+        self._watcher = await self.runtime.hub.watch_prefix(MODEL_PREFIX)
+        self._task = asyncio.create_task(self._run())
+        await self._watcher.synced.wait()
+        return self
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self._watcher is not None:
+            await self._watcher.aclose()
+        for client in self._clients.values():
+            await client.close()
+        self._clients.clear()
+
+    async def _run(self) -> None:
+        try:
+            async for event in self._watcher:
+                name = event.key[len(MODEL_PREFIX) :].rsplit("/", 1)[0]
+                try:
+                    if event.type == "put":
+                        await self._handle_put(name, event.value)
+                    else:
+                        await self._handle_delete(name)
+                except Exception:  # noqa: BLE001 — keep watching
+                    logger.exception("model watcher failed handling %s", event.key)
+        except asyncio.CancelledError:
+            pass
+
+    async def _handle_put(self, name: str, entry: Dict[str, Any]) -> None:
+        self._refcount[name] = self._refcount.get(name, 0) + 1
+        if self._refcount[name] > 1:
+            return
+        ns, comp, ep = parse_endpoint_path(entry["endpoint"])
+        endpoint = self.runtime.namespace(ns).component(comp).endpoint(ep)
+        client = await endpoint.client(router_mode=self.router_mode)
+        self._clients[name] = client
+        tokenizer = make_tokenizer(entry.get("tokenizer"))
+        pipeline = build_pipeline(
+            [OpenAIPreprocessor(tokenizer, name), Backend(tokenizer)], client
+        )
+        model_type = entry.get("model_type", "both")
+        if model_type in ("chat", "both"):
+            self.manager.add_chat_model(name, pipeline)
+        if model_type in ("completion", "both"):
+            self.manager.add_completion_model(name, pipeline)
+        logger.info("model added: %s → %s", name, entry["endpoint"])
+
+    async def _handle_delete(self, name: str) -> None:
+        if name not in self._refcount:
+            return
+        self._refcount[name] -= 1
+        if self._refcount[name] > 0:
+            return
+        del self._refcount[name]
+        self.manager.remove_model(name)
+        client = self._clients.pop(name, None)
+        if client is not None:
+            await client.close()
+        logger.info("model removed: %s", name)

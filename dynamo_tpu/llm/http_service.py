@@ -1,0 +1,247 @@
+"""OpenAI-compatible HTTP frontend (aiohttp).
+
+Reference semantics: lib/llm/src/http/service/{service_v2,openai}.rs — routes
+``/v1/chat/completions``, ``/v1/completions``, ``/v1/models``, ``/metrics``,
+``/health``; every downstream engine streams, ``stream=false`` responses are
+aggregated at the edge (aggregator.rs); a client disconnect mid-stream calls
+``stop_generating`` and records status ``client_drop``; Prometheus metrics via
+``InflightGuard`` (metrics.rs:319).
+
+The ``ModelManager`` maps model name → chat/completion pipelines
+(http/service.rs:59-120); engines are added statically or by the hub model
+watcher (discovery.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from ..runtime.engine import AsyncEngine, Context
+from .metrics import Metrics, Status
+from .openai import SSE_DONE, aggregate_chunks, sse_encode
+
+logger = logging.getLogger(__name__)
+
+
+class ModelManager:
+    """Model name → engine registry (chat + completion separately)."""
+
+    def __init__(self):
+        self._chat: Dict[str, AsyncEngine] = {}
+        self._completion: Dict[str, AsyncEngine] = {}
+
+    def add_chat_model(self, name: str, engine: AsyncEngine) -> None:
+        self._chat[name] = engine
+
+    def add_completion_model(self, name: str, engine: AsyncEngine) -> None:
+        self._completion[name] = engine
+
+    def remove_model(self, name: str) -> None:
+        self._chat.pop(name, None)
+        self._completion.pop(name, None)
+
+    def chat_engine(self, name: str) -> Optional[AsyncEngine]:
+        return self._chat.get(name)
+
+    def completion_engine(self, name: str) -> Optional[AsyncEngine]:
+        return self._completion.get(name)
+
+    def model_names(self) -> list:
+        return sorted(set(self._chat) | set(self._completion))
+
+    def has_model(self, name: str) -> bool:
+        return name in self._chat or name in self._completion
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class HttpService:
+    """The OpenAI ingress service."""
+
+    def __init__(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        metrics_prefix: str = "dynamo_tpu",
+        model_manager: Optional[ModelManager] = None,
+    ):
+        self.host = host
+        self.port = port
+        self.models = model_manager or ModelManager()
+        self.metrics = Metrics(metrics_prefix)
+        self.app = web.Application()
+        self.app.router.add_post("/v1/chat/completions", self._chat_completions)
+        self.app.router.add_post("/v1/completions", self._completions)
+        self.app.router.add_get("/v1/models", self._list_models)
+        self.app.router.add_get("/metrics", self._metrics)
+        self.app.router.add_get("/health", self._health)
+        self.app.router.add_get("/live", self._health)
+        self._runner: Optional[web.AppRunner] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> "HttpService":
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in site._server.sockets:  # resolve port 0
+            self.port = s.getsockname()[1]
+            break
+        logger.info("HTTP service listening on %s:%s", self.host, self.port)
+        return self
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def run(self, shutdown: Optional[asyncio.Event] = None) -> None:
+        await self.start()
+        try:
+            if shutdown is None:
+                await asyncio.Event().wait()
+            else:
+                await shutdown.wait()
+        finally:
+            await self.close()
+
+    # -- handlers -----------------------------------------------------------
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "models": self.models.model_names()})
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(body=self.metrics.render(), content_type="text/plain")
+
+    async def _list_models(self, request: web.Request) -> web.Response:
+        now = int(time.time())
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {"id": name, "object": "model", "created": now, "owned_by": "dynamo_tpu"}
+                    for name in self.models.model_names()
+                ],
+            }
+        )
+
+    async def _chat_completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle_openai(request, chat=True)
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle_openai(request, chat=False)
+
+    async def _handle_openai(self, request: web.Request, chat: bool) -> web.StreamResponse:
+        endpoint = "chat_completions" if chat else "completions"
+        try:
+            body = await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return _error_response(400, "invalid JSON body")
+        model = body.get("model")
+        if not isinstance(model, str) or not model:
+            return _error_response(400, "missing 'model'")
+        engine = (
+            self.models.chat_engine(model) if chat else self.models.completion_engine(model)
+        )
+        if engine is None:
+            self.metrics.requests_total.labels(model, endpoint, "stream", Status.REJECTED).inc()
+            return _error_response(404, f"model {model!r} not found")
+
+        stream_mode = bool(body.get("stream", False))
+        guard = self.metrics.guard(model, endpoint, "stream" if stream_mode else "unary")
+        ctx = Context(body)
+        try:
+            stream = await engine.generate(ctx)
+        except Exception as e:  # noqa: BLE001 — edge boundary
+            guard.finish(Status.ERROR)
+            logger.exception("engine rejected request")
+            return _error_response(500, str(e))
+
+        if stream_mode:
+            return await self._stream_response(request, stream, ctx, guard)
+        return await self._unary_response(stream, ctx, guard)
+
+    async def _unary_response(self, stream, ctx: Context, guard) -> web.Response:
+        chunks = []
+        try:
+            async for chunk in stream:
+                if "__annotations__" in chunk:
+                    continue
+                if chunk.get("choices") or chunk.get("usage"):
+                    guard.on_token(0)
+                chunks.append(chunk)
+            full = aggregate_chunks(chunks)
+        except asyncio.CancelledError:
+            ctx.stop_generating()
+            guard.finish(Status.CLIENT_DROP)
+            raise
+        except Exception as e:  # noqa: BLE001
+            guard.finish(Status.ERROR)
+            logger.exception("stream failed")
+            return _error_response(500, str(e))
+        guard.finish(Status.SUCCESS)
+        return web.json_response(full)
+
+    async def _stream_response(
+        self, request: web.Request, stream, ctx: Context, guard
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+        status = Status.SUCCESS
+        try:
+            async for chunk in stream:
+                if "__annotations__" in chunk:
+                    await resp.write(
+                        b"event: annotation\n" + sse_encode(chunk["__annotations__"])
+                    )
+                    continue
+                guard.on_token()
+                await resp.write(sse_encode(chunk))
+            await resp.write(SSE_DONE)
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away: stop upstream generation
+            ctx.stop_generating()
+            status = Status.CLIENT_DROP
+        except Exception:  # noqa: BLE001
+            status = Status.ERROR
+            logger.exception("stream failed")
+            try:
+                await resp.write(
+                    b"event: error\n" + sse_encode({"error": "stream failed"})
+                )
+            except (ConnectionResetError, RuntimeError):
+                pass
+        finally:
+            guard.finish(status)
+            await stream.aclose()
+        try:
+            await resp.write_eof()
+        except (ConnectionResetError, RuntimeError):
+            pass
+        return resp
+
+
+def _error_response(status: int, message: str) -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": "invalid_request_error", "code": status}},
+        status=status,
+    )

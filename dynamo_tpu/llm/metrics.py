@@ -1,0 +1,129 @@
+"""HTTP-edge Prometheus metrics.
+
+Reference semantics: lib/llm/src/http/service/metrics.rs:57-128,319 —
+``{prefix}_http_service_{requests_total, inflight_requests,
+request_duration_seconds, time_to_first_token_seconds,
+inter_token_latency_seconds}`` with status labels
+``success | client_drop | rejected | error``, and a RAII ``InflightGuard``
+that records duration + status when dropped.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+REQUEST_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+TOKEN_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+class Status:
+    SUCCESS = "success"
+    CLIENT_DROP = "client_drop"
+    REJECTED = "rejected"
+    ERROR = "error"
+
+
+class Metrics:
+    def __init__(self, prefix: str = "dynamo_tpu"):
+        self.registry = CollectorRegistry()
+        ns = f"{prefix}_http_service"
+        self.requests_total = Counter(
+            f"{ns}_requests_total",
+            "Total requests by model/endpoint/status",
+            ["model", "endpoint", "request_type", "status"],
+            registry=self.registry,
+        )
+        self.inflight = Gauge(
+            f"{ns}_inflight_requests",
+            "Currently in-flight requests",
+            ["model", "endpoint"],
+            registry=self.registry,
+        )
+        self.request_duration = Histogram(
+            f"{ns}_request_duration_seconds",
+            "End-to-end request duration",
+            ["model", "endpoint"],
+            buckets=REQUEST_BUCKETS,
+            registry=self.registry,
+        )
+        self.ttft = Histogram(
+            f"{ns}_time_to_first_token_seconds",
+            "Time to first token (streaming)",
+            ["model", "endpoint"],
+            buckets=REQUEST_BUCKETS,
+            registry=self.registry,
+        )
+        self.itl = Histogram(
+            f"{ns}_inter_token_latency_seconds",
+            "Inter-token latency (streaming)",
+            ["model", "endpoint"],
+            buckets=TOKEN_BUCKETS,
+            registry=self.registry,
+        )
+        self.output_tokens = Counter(
+            f"{ns}_output_tokens_total",
+            "Total output tokens produced",
+            ["model", "endpoint"],
+            registry=self.registry,
+        )
+
+    def guard(self, model: str, endpoint: str, request_type: str) -> "InflightGuard":
+        return InflightGuard(self, model, endpoint, request_type)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+class InflightGuard:
+    """Tracks one request: inflight gauge, duration, TTFT, ITL, final status.
+
+    Must be closed with ``finish(status)``; a guard dropped without an explicit
+    status records ``error`` (the reference's RAII Drop behaviour).
+    """
+
+    def __init__(self, metrics: Metrics, model: str, endpoint: str, request_type: str):
+        self._m = metrics
+        self.model = model
+        self.endpoint = endpoint
+        self.request_type = request_type
+        self._start = time.monotonic()
+        self._last_token_t: Optional[float] = None
+        self._finished = False
+        metrics.inflight.labels(model, endpoint).inc()
+
+    def on_token(self, n_tokens: int = 1) -> None:
+        now = time.monotonic()
+        if self._last_token_t is None:
+            self._m.ttft.labels(self.model, self.endpoint).observe(now - self._start)
+        else:
+            self._m.itl.labels(self.model, self.endpoint).observe(now - self._last_token_t)
+        self._last_token_t = now
+        self._m.output_tokens.labels(self.model, self.endpoint).inc(n_tokens)
+
+    def finish(self, status: str) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        self._m.inflight.labels(self.model, self.endpoint).dec()
+        self._m.request_duration.labels(self.model, self.endpoint).observe(
+            time.monotonic() - self._start
+        )
+        self._m.requests_total.labels(
+            self.model, self.endpoint, self.request_type, status
+        ).inc()
+
+    def __del__(self):
+        if not self._finished:
+            try:
+                self.finish(Status.ERROR)
+            except Exception:  # noqa: BLE001 — interpreter teardown
+                pass

@@ -1,0 +1,213 @@
+"""OpenAI-compatible API types, delta generation, and aggregation.
+
+Reference semantics: lib/llm/src/protocols/openai/** — chat-completions and
+completions request types (with the ``nvext`` extension: ignore_eos,
+annotations, use_raw_prompt), the ``DeltaGenerator`` that shapes per-token
+engine outputs into ``chat.completion.chunk`` SSE objects, and the stream→full
+aggregators used for ``stream=false`` responses.
+
+Requests are validated with pydantic; chunks are plain dicts (hot path).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Literal, Optional, Union
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from .protocols import SamplingOptions, StopConditions
+
+
+class NvExt(BaseModel):
+    """Extension fields (reference nvext): engine hints + debug annotations."""
+
+    model_config = ConfigDict(extra="allow")
+    ignore_eos: Optional[bool] = None
+    use_raw_prompt: Optional[bool] = None
+    annotations: Optional[List[str]] = None
+    greed_sampling: Optional[bool] = None
+
+
+class ChatMessage(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    role: str
+    content: Optional[Union[str, List[Dict[str, Any]]]] = None
+    name: Optional[str] = None
+
+    def text(self) -> str:
+        if isinstance(self.content, list):
+            return "".join(
+                part.get("text", "") for part in self.content if part.get("type") == "text"
+            )
+        return self.content or ""
+
+
+class CommonFields(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    model: str
+    stream: bool = False
+    max_tokens: Optional[int] = None
+    max_completion_tokens: Optional[int] = None
+    min_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    seed: Optional[int] = None
+    stop: Optional[Union[str, List[str]]] = None
+    n: int = 1
+    nvext: Optional[NvExt] = None
+
+    def stop_conditions(self) -> StopConditions:
+        stop = self.stop
+        if isinstance(stop, str):
+            stop = [stop]
+        return StopConditions(
+            max_tokens=self.max_tokens or self.max_completion_tokens,
+            min_tokens=self.min_tokens,
+            stop=list(stop or []),
+            ignore_eos=bool(self.nvext and self.nvext.ignore_eos),
+        )
+
+    def sampling_options(self) -> SamplingOptions:
+        return SamplingOptions(
+            temperature=self.temperature,
+            top_p=self.top_p,
+            top_k=self.top_k,
+            frequency_penalty=self.frequency_penalty,
+            presence_penalty=self.presence_penalty,
+            seed=self.seed,
+        )
+
+
+class ChatCompletionRequest(CommonFields):
+    messages: List[ChatMessage]
+    logprobs: Optional[bool] = None
+    tools: Optional[List[Dict[str, Any]]] = None
+    stream_options: Optional[Dict[str, Any]] = None
+
+
+class CompletionRequest(CommonFields):
+    prompt: Union[str, List[str], List[int], List[List[int]]]
+    echo: Optional[bool] = None
+    logprobs: Optional[int] = None
+    stream_options: Optional[Dict[str, Any]] = None
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+class DeltaGenerator:
+    """Shapes backend text deltas into OpenAI streaming chunks.
+
+    Reference: protocols/openai/chat_completions/delta.rs — one object per
+    request, stamps a stable completion id/created, emits the role on the
+    first chunk, finish_reason on the last, optional usage chunk.
+    """
+
+    def __init__(self, model: str, chat: bool = True, request_id: Optional[str] = None):
+        self.chat = chat
+        self.model = model
+        self.id = ("chatcmpl-" if chat else "cmpl-") + (request_id or uuid.uuid4().hex)
+        self.created = _now()
+        self.object = "chat.completion.chunk" if chat else "text_completion"
+        self._first = True
+
+    def _base(self) -> Dict[str, Any]:
+        return {
+            "id": self.id,
+            "object": self.object,
+            "created": self.created,
+            "model": self.model,
+        }
+
+    def text_chunk(self, text: str) -> Dict[str, Any]:
+        out = self._base()
+        if self.chat:
+            delta: Dict[str, Any] = {"content": text}
+            if self._first:
+                delta["role"] = "assistant"
+                self._first = False
+            out["choices"] = [{"index": 0, "delta": delta, "finish_reason": None}]
+        else:
+            out["choices"] = [{"index": 0, "text": text, "finish_reason": None}]
+        return out
+
+    def finish_chunk(self, finish_reason: str) -> Dict[str, Any]:
+        out = self._base()
+        if self.chat:
+            out["choices"] = [{"index": 0, "delta": {}, "finish_reason": finish_reason}]
+        else:
+            out["choices"] = [{"index": 0, "text": "", "finish_reason": finish_reason}]
+        return out
+
+    def usage_chunk(self, usage: Dict[str, int]) -> Dict[str, Any]:
+        out = self._base()
+        out["choices"] = []
+        out["usage"] = usage
+        return out
+
+
+def aggregate_chunks(chunks: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold a chunk stream into a full (non-streaming) response.
+
+    Reference: protocols/openai/chat_completions/aggregator.rs — used at the
+    HTTP edge for ``stream=false`` (everything downstream always streams).
+    """
+    if not chunks:
+        raise ValueError("empty stream")
+    first = chunks[0]
+    chat = first.get("object") == "chat.completion.chunk"
+    text_parts: List[str] = []
+    finish_reason: Optional[str] = None
+    usage: Optional[Dict[str, int]] = None
+    role = "assistant"
+    for ch in chunks:
+        if ch.get("usage"):
+            usage = ch["usage"]
+        for choice in ch.get("choices", []):
+            if chat:
+                delta = choice.get("delta", {})
+                if delta.get("role"):
+                    role = delta["role"]
+                if delta.get("content"):
+                    text_parts.append(delta["content"])
+            else:
+                if choice.get("text"):
+                    text_parts.append(choice["text"])
+            if choice.get("finish_reason"):
+                finish_reason = choice["finish_reason"]
+    full_text = "".join(text_parts)
+    out = {
+        "id": first["id"],
+        "object": "chat.completion" if chat else "text_completion",
+        "created": first["created"],
+        "model": first["model"],
+    }
+    if chat:
+        out["choices"] = [
+            {
+                "index": 0,
+                "message": {"role": role, "content": full_text},
+                "finish_reason": finish_reason,
+            }
+        ]
+    else:
+        out["choices"] = [{"index": 0, "text": full_text, "finish_reason": finish_reason}]
+    if usage is not None:
+        out["usage"] = usage
+    return out
+
+
+def sse_encode(data: Any) -> bytes:
+    """One SSE event (reference codec.rs)."""
+    import json
+
+    return b"data: " + json.dumps(data, separators=(",", ":")).encode() + b"\n\n"
+
+
+SSE_DONE = b"data: [DONE]\n\n"

@@ -1,0 +1,114 @@
+"""OpenAI preprocessor operator: template + tokenize → PreprocessedRequest.
+
+Reference semantics: lib/llm/src/preprocessor.rs (OpenAIPreprocessor) — the
+forward edge renders the chat template and tokenizes into ``BackendInput``;
+the backward edge shapes backend text deltas into OpenAI chunks via
+``DeltaGenerator``.  Annotation requests (nvext.annotations) can echo the
+formatted prompt / token ids back to the caller as annotation events.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Dict, Optional, Union
+
+from ..runtime.engine import AsyncEngine, Context, ResponseStream
+from ..runtime.pipeline import Operator
+from .openai import ChatCompletionRequest, CompletionRequest, DeltaGenerator
+from .protocols import PreprocessedRequest
+from .tokenizer import BaseTokenizer
+
+
+class OpenAIPreprocessor(Operator):
+    """Chat/completions requests → token-level requests → OpenAI chunks."""
+
+    def __init__(self, tokenizer: BaseTokenizer, model_name: str = ""):
+        self._tokenizer = tokenizer
+        self.model_name = model_name
+
+    # -- forward ------------------------------------------------------------
+
+    def preprocess(
+        self, oai: Union[ChatCompletionRequest, CompletionRequest, Dict[str, Any]]
+    ) -> PreprocessedRequest:
+        if isinstance(oai, dict):
+            oai = (
+                ChatCompletionRequest.model_validate(oai)
+                if "messages" in oai
+                else CompletionRequest.model_validate(oai)
+            )
+        if isinstance(oai, ChatCompletionRequest):
+            if oai.nvext and oai.nvext.use_raw_prompt and len(oai.messages) == 1:
+                prompt = oai.messages[0].text()
+            else:
+                prompt = self._tokenizer.apply_chat_template(
+                    [
+                        {"role": m.role, "content": m.text()}
+                        for m in oai.messages
+                    ],
+                    add_generation_prompt=True,
+                    tools=oai.tools,
+                )
+            token_ids = self._tokenizer.encode(prompt, add_special_tokens=False)
+        else:
+            prompt_field = oai.prompt
+            if isinstance(prompt_field, list) and prompt_field and isinstance(prompt_field[0], int):
+                prompt = None
+                token_ids = list(prompt_field)
+            else:
+                prompt = prompt_field if isinstance(prompt_field, str) else str(prompt_field)
+                token_ids = self._tokenizer.encode(prompt)
+        annotations: Dict[str, Any] = {}
+        if oai.nvext and oai.nvext.annotations:
+            if "formatted_prompt" in oai.nvext.annotations and prompt is not None:
+                annotations["formatted_prompt"] = prompt
+            if "token_ids" in oai.nvext.annotations:
+                annotations["token_ids"] = token_ids
+        return PreprocessedRequest(
+            token_ids=token_ids,
+            stop_conditions=oai.stop_conditions(),
+            sampling_options=oai.sampling_options(),
+            model=oai.model,
+            annotations=annotations,
+        )
+
+    # -- the operator -------------------------------------------------------
+
+    async def generate(self, request: Context, next: AsyncEngine) -> ResponseStream:
+        raw = request.data
+        chat = "messages" in raw if isinstance(raw, dict) else True
+        pre = self.preprocess(raw)
+        stream = await next.generate(request.transfer(pre.to_dict()))
+        model = pre.model or self.model_name
+        return ResponseStream(
+            self._to_chunks(stream, model, chat, request.id, pre.annotations),
+            request.ctx,
+        )
+
+    async def _to_chunks(
+        self,
+        stream: ResponseStream,
+        model: str,
+        chat: bool,
+        request_id: str,
+        annotations: Dict[str, Any],
+    ) -> AsyncIterator[Dict[str, Any]]:
+        gen = DeltaGenerator(model, chat=chat, request_id=request_id)
+        try:
+            if annotations:
+                yield {"__annotations__": annotations}
+            async for item in stream:
+                reason = item.get("finish_reason")
+                if reason is not None:
+                    if item.get("usage"):
+                        # merge usage into the finish chunk (OpenAI shape
+                        # allows usage on the final chunk)
+                        chunk = gen.finish_chunk(reason)
+                        chunk["usage"] = item["usage"]
+                        yield chunk
+                    else:
+                        yield gen.finish_chunk(reason)
+                    return
+                if item.get("text"):
+                    yield gen.text_chunk(item["text"])
+        finally:
+            await stream.aclose()

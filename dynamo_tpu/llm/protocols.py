@@ -1,0 +1,145 @@
+"""Internal LLM protocols: the token-level request/response types.
+
+Reference semantics: lib/llm/src/protocols/common.rs — ``StopConditions``,
+``SamplingOptions``, ``PreprocessedRequest`` (aka BackendInput),
+``LLMEngineOutput``, ``FinishReason``.  These cross process boundaries, so the
+canonical wire form is a plain dict (msgpack-friendly); the classes here are
+thin construction/validation helpers with ``to_dict``/``from_dict``.
+
+Per-token engine outputs stay plain dicts on the hot path (one per generated
+token per request) — schema documented on ``LLMEngineOutput``.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class FinishReason(str, enum.Enum):
+    STOP = "stop"  # hit eos or a stop sequence
+    LENGTH = "length"  # hit max_tokens
+    CANCELLED = "cancelled"  # request cancelled
+    ERROR = "error"
+
+    def __str__(self) -> str:  # serialize as bare string
+        return self.value
+
+
+@dataclass
+class StopConditions:
+    """When to stop generating (protocols/common.rs StopConditions)."""
+
+    max_tokens: Optional[int] = None
+    min_tokens: Optional[int] = None
+    stop: List[str] = field(default_factory=list)  # stop strings (hidden)
+    stop_token_ids: List[int] = field(default_factory=list)
+    ignore_eos: bool = False
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "max_tokens": self.max_tokens,
+            "min_tokens": self.min_tokens,
+            "stop": self.stop,
+            "stop_token_ids": self.stop_token_ids,
+            "ignore_eos": self.ignore_eos,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "StopConditions":
+        return cls(
+            max_tokens=d.get("max_tokens"),
+            min_tokens=d.get("min_tokens"),
+            stop=list(d.get("stop") or []),
+            stop_token_ids=list(d.get("stop_token_ids") or []),
+            ignore_eos=bool(d.get("ignore_eos", False)),
+        )
+
+
+@dataclass
+class SamplingOptions:
+    """How to sample (protocols/common.rs SamplingOptions)."""
+
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    seed: Optional[int] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "temperature": self.temperature,
+            "top_p": self.top_p,
+            "top_k": self.top_k,
+            "frequency_penalty": self.frequency_penalty,
+            "presence_penalty": self.presence_penalty,
+            "seed": self.seed,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SamplingOptions":
+        return cls(
+            temperature=d.get("temperature"),
+            top_p=d.get("top_p"),
+            top_k=d.get("top_k"),
+            frequency_penalty=d.get("frequency_penalty"),
+            presence_penalty=d.get("presence_penalty"),
+            seed=d.get("seed"),
+        )
+
+
+@dataclass
+class PreprocessedRequest:
+    """Token-in request to an engine (protocols/common.rs PreprocessedRequest).
+
+    ``token_ids`` is the full prompt after templating+tokenization.
+    ``annotations`` carries pass-through flags (e.g. requesting the engine
+    echo back ``token_ids``/``formatted_prompt``).
+    """
+
+    token_ids: List[int]
+    stop_conditions: StopConditions = field(default_factory=StopConditions)
+    sampling_options: SamplingOptions = field(default_factory=SamplingOptions)
+    model: Optional[str] = None
+    annotations: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "token_ids": self.token_ids,
+            "stop_conditions": self.stop_conditions.to_dict(),
+            "sampling_options": self.sampling_options.to_dict(),
+            "model": self.model,
+            "annotations": self.annotations,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PreprocessedRequest":
+        return cls(
+            token_ids=list(d["token_ids"]),
+            stop_conditions=StopConditions.from_dict(d.get("stop_conditions") or {}),
+            sampling_options=SamplingOptions.from_dict(d.get("sampling_options") or {}),
+            model=d.get("model"),
+            annotations=dict(d.get("annotations") or {}),
+        )
+
+
+class LLMEngineOutput:
+    """Schema of the per-step engine output dict (kept as a plain dict on the
+    wire and in the hot loop; one per generated token):
+
+    ``{"token_ids": [int, ...],        # newly generated token(s) this step
+       "text": str | None,            # filled by the Backend detokenizer
+       "finish_reason": str | None,   # FinishReason value when finished
+       "cum_log_prob": float | None,
+       "usage": {...} | None}``        # optional final usage stats
+    """
+
+    @staticmethod
+    def token(token_id: int) -> Dict[str, Any]:
+        return {"token_ids": [token_id], "text": None, "finish_reason": None}
+
+    @staticmethod
+    def finished(reason: FinishReason, usage: Optional[Dict[str, int]] = None) -> Dict[str, Any]:
+        return {"token_ids": [], "text": None, "finish_reason": str(reason), "usage": usage}

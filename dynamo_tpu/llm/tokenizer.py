@@ -1,0 +1,261 @@
+"""Tokenizers + incremental detokenization.
+
+Reference semantics: lib/llm/src/tokenizers.rs (Encoding, HF/sentencepiece
+backends, incremental ``DecodeStream``) and the preprocessor's prompt
+templating (lib/llm/src/preprocessor/prompt/).
+
+Two implementations:
+- ``HFTokenizer`` — wraps a ``tokenizers.Tokenizer`` json file (the HF format
+  every target model ships) + a jinja2 chat template from
+  tokenizer_config.json.
+- ``ByteTokenizer`` — fully self-contained byte-level tokenizer (ids 0-255 are
+  raw bytes + special tokens above).  Used for tests, echo serving, and
+  synthetic benchmarks: no model files required anywhere in the stack.
+
+``DecodeStream`` performs incremental detokenization by decoding a sliding
+window of accumulated ids and diffing against the previously emitted prefix,
+holding back trailing bytes that form an incomplete UTF-8 sequence — same
+behaviour as the reference's DecodeStream (tokenizers.rs) where a multi-token
+unicode glyph must not be emitted until complete.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from abc import ABC, abstractmethod
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class BaseTokenizer(ABC):
+    """Minimal tokenizer interface used by the preprocessor and backend."""
+
+    @abstractmethod
+    def encode(self, text: str, add_special_tokens: bool = True) -> List[int]:
+        ...
+
+    @abstractmethod
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        ...
+
+    @property
+    @abstractmethod
+    def eos_token_id(self) -> Optional[int]:
+        ...
+
+    @property
+    @abstractmethod
+    def bos_token_id(self) -> Optional[int]:
+        ...
+
+    @property
+    @abstractmethod
+    def vocab_size(self) -> int:
+        ...
+
+    # -- chat templating ----------------------------------------------------
+
+    @property
+    def chat_template(self) -> Optional[str]:
+        return None
+
+    def apply_chat_template(
+        self,
+        messages: List[Dict[str, Any]],
+        add_generation_prompt: bool = True,
+        **kwargs: Any,
+    ) -> str:
+        """Render messages to a prompt string (reference: minijinja templates,
+        lib/llm/src/preprocessor/prompt/template/)."""
+        template = self.chat_template
+        if template is None:
+            # simple role-tagged fallback (mirrors no-template GGUF models)
+            parts = [f"<|{m['role']}|>\n{m.get('content') or ''}" for m in messages]
+            if add_generation_prompt:
+                parts.append("<|assistant|>\n")
+            return "\n".join(parts)
+        import jinja2
+
+        env = jinja2.Environment(trim_blocks=True, lstrip_blocks=True)
+        env.globals["raise_exception"] = _raise_exception
+        return env.from_string(template).render(
+            messages=messages,
+            add_generation_prompt=add_generation_prompt,
+            bos_token=getattr(self, "bos_token", "") or "",
+            eos_token=getattr(self, "eos_token", "") or "",
+            **kwargs,
+        )
+
+    def decode_stream(self, skip_special_tokens: bool = True) -> "DecodeStream":
+        return DecodeStream(self, skip_special_tokens=skip_special_tokens)
+
+
+def _raise_exception(message: str) -> None:
+    raise ValueError(message)
+
+
+class HFTokenizer(BaseTokenizer):
+    """HuggingFace ``tokenizer.json`` backend (+ chat template/config)."""
+
+    def __init__(
+        self,
+        tokenizer_file: str,
+        config_file: Optional[str] = None,
+    ):
+        from tokenizers import Tokenizer
+
+        self._tok = Tokenizer.from_file(tokenizer_file)
+        self._chat_template: Optional[str] = None
+        self.bos_token: Optional[str] = None
+        self.eos_token: Optional[str] = None
+        self._bos_id: Optional[int] = None
+        self._eos_id: Optional[int] = None
+
+        if config_file is None:
+            candidate = os.path.join(os.path.dirname(tokenizer_file), "tokenizer_config.json")
+            config_file = candidate if os.path.exists(candidate) else None
+        if config_file is not None:
+            with open(config_file) as f:
+                cfg = json.load(f)
+            self._chat_template = cfg.get("chat_template")
+            self.bos_token = _token_str(cfg.get("bos_token"))
+            self.eos_token = _token_str(cfg.get("eos_token"))
+        if self.bos_token:
+            self._bos_id = self._tok.token_to_id(self.bos_token)
+        if self.eos_token:
+            self._eos_id = self._tok.token_to_id(self.eos_token)
+
+    @classmethod
+    def from_pretrained_dir(cls, model_dir: str) -> "HFTokenizer":
+        return cls(os.path.join(model_dir, "tokenizer.json"))
+
+    def encode(self, text: str, add_special_tokens: bool = True) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=add_special_tokens).ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=skip_special_tokens)
+
+    @property
+    def eos_token_id(self) -> Optional[int]:
+        return self._eos_id
+
+    @property
+    def bos_token_id(self) -> Optional[int]:
+        return self._bos_id
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+    @property
+    def chat_template(self) -> Optional[str]:
+        return self._chat_template
+
+
+def _token_str(value: Any) -> Optional[str]:
+    """tokenizer_config tokens are either "..." or {"content": "..."}."""
+    if isinstance(value, dict):
+        return value.get("content")
+    return value
+
+
+class ByteTokenizer(BaseTokenizer):
+    """Self-contained byte-level tokenizer: ids 0-255 = bytes, then specials.
+
+    Deterministic, lossless, zero files.  Specials: BOS=256, EOS=257, PAD=258,
+    then one id per extra special token (e.g. role markers).
+    """
+
+    BOS = 256
+    EOS = 257
+    PAD = 258
+
+    def __init__(self, extra_specials: Optional[List[str]] = None):
+        self._specials: Dict[str, int] = {"<bos>": self.BOS, "<eos>": self.EOS, "<pad>": self.PAD}
+        for i, tok in enumerate(extra_specials or []):
+            self._specials[tok] = 259 + i
+        self._special_by_id = {v: k for k, v in self._specials.items()}
+        self.bos_token = "<bos>"
+        self.eos_token = "<eos>"
+
+    def encode(self, text: str, add_special_tokens: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        if add_special_tokens:
+            ids = [self.BOS] + ids
+        return ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        out: List[str] = []
+        buf = bytearray()
+        for i in ids:
+            if i < 256:
+                buf.append(i)
+            else:
+                if buf:
+                    out.append(buf.decode("utf-8", errors="replace"))
+                    buf = bytearray()
+                if not skip_special_tokens:
+                    out.append(self._special_by_id.get(i, f"<unk:{i}>"))
+        if buf:
+            out.append(buf.decode("utf-8", errors="replace"))
+        return "".join(out)
+
+    @property
+    def eos_token_id(self) -> int:
+        return self.EOS
+
+    @property
+    def bos_token_id(self) -> int:
+        return self.BOS
+
+    @property
+    def vocab_size(self) -> int:
+        return 259 + len(self._specials) - 3
+
+
+class DecodeStream:
+    """Incremental detokenizer: feed ids one at a time, get stable text deltas.
+
+    Offset-based incremental decode: decode the tail since the last stable
+    boundary; if it ends in U+FFFD the final token(s) form an incomplete
+    multi-byte sequence, so the delta is held back until a later token
+    completes it (reference DecodeStream semantics, lib/llm/src/tokenizers.rs).
+    """
+
+    def __init__(self, tokenizer: BaseTokenizer, skip_special_tokens: bool = True):
+        self._tok = tokenizer
+        self._skip = skip_special_tokens
+        self._ids: List[int] = []
+        self._prefix_offset = 0  # start of the decode window (last boundary)
+        self._read_offset = 0  # ids before this are already emitted
+
+    def step(self, token_id: int) -> str:
+        """Feed one token id; return newly-stable text (may be empty)."""
+        self._ids.append(token_id)
+        tail = self._ids[self._prefix_offset :]
+        text = self._tok.decode(tail, skip_special_tokens=self._skip)
+        if text.endswith("�"):
+            return ""
+        prev = self._tok.decode(
+            self._ids[self._prefix_offset : self._read_offset],
+            skip_special_tokens=self._skip,
+        )
+        delta = text[len(prev) :]
+        self._prefix_offset = self._read_offset
+        self._read_offset = len(self._ids)
+        return delta
+
+    def flush(self) -> str:
+        """Emit any held-back text at end of stream (replacement chars kept)."""
+        if self._read_offset >= len(self._ids):
+            return ""
+        text = self._tok.decode(
+            self._ids[self._prefix_offset :], skip_special_tokens=self._skip
+        )
+        prev = self._tok.decode(
+            self._ids[self._prefix_offset : self._read_offset],
+            skip_special_tokens=self._skip,
+        )
+        self._read_offset = len(self._ids)
+        self._prefix_offset = len(self._ids)
+        return text[len(prev) :]

@@ -11,8 +11,11 @@ from __future__ import annotations
 
 import asyncio
 import enum
+import logging
 import random
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 from .engine import AsyncEngine, Context, ResponseStream
 from .transports.service import RemoteEngine
@@ -62,15 +65,23 @@ class Client(AsyncEngine):
     async def _watch_loop(self) -> None:
         try:
             async for event in self._watcher:
-                worker_id = int(event.key.rsplit("/", 1)[-1])
-                if event.type == "put":
-                    self._instances[worker_id] = event.value
-                else:
-                    self._instances.pop(worker_id, None)
-                if self._instances:
-                    self._ready.set()
-                else:
-                    self._ready.clear()
+                try:
+                    worker_id = int(event.key.rsplit("/", 1)[-1])
+                except ValueError:
+                    # unrelated key under the prefix; the watch must survive
+                    logger.warning("ignoring non-instance key %r", event.key)
+                    continue
+                try:
+                    if event.type == "put":
+                        self._instances[worker_id] = event.value
+                    else:
+                        self._instances.pop(worker_id, None)
+                    if self._instances:
+                        self._ready.set()
+                    else:
+                        self._ready.clear()
+                except Exception:  # noqa: BLE001 — keep the watch alive
+                    logger.exception("error handling instance event %r", event)
         except asyncio.CancelledError:
             pass
 

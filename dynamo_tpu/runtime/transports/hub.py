@@ -249,7 +249,17 @@ class HubState:
             return qi
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._q_waiters.setdefault(queue, deque()).append(fut)
-        return await fut
+        try:
+            return await fut
+        except asyncio.CancelledError:
+            if fut.done() and not fut.cancelled():
+                # q_push handed us an item but our task was cancelled at the
+                # await: requeue it so at-least-once holds
+                qi = fut.result()
+                await self.q_nack(qi.ack_token)
+            else:
+                fut.cancel()  # q_push skips done/cancelled waiters
+            raise
 
     async def q_ack(self, token: str) -> bool:
         return self._inflight.pop(token, None) is not None
@@ -610,6 +620,9 @@ class HubClient:
         # pushes that arrive before the requesting coroutine registers its
         # queue (read_loop may outrun watch_prefix/subscribe resumption)
         self._early_pushes: Dict[str, List[Any]] = {}
+        # ids whose watch/subscription was closed: drop late pushes instead of
+        # buffering them forever
+        self._closed_push_ids: set = set()
         self._reader_task: Optional[asyncio.Task] = None
         self._keepalive_tasks: Dict[int, asyncio.Task] = {}
         self._write_lock = asyncio.Lock()
@@ -648,14 +661,14 @@ class HubClient:
                     q = self._watch_queues.get(msg["id"])
                     if q:
                         q.put_nowait(item)
-                    else:
+                    elif msg["id"] not in self._closed_push_ids:
                         self._early_pushes.setdefault(msg["id"], []).append(item)
                 elif push == "msg":
                     item = (msg["subject"], msg.get("payload"))
                     q = self._sub_queues.get(msg["id"])
                     if q:
                         q.put_nowait(item)
-                    else:
+                    elif msg["id"] not in self._closed_push_ids:
                         self._early_pushes.setdefault(msg["id"], []).append(item)
                 else:
                     fut = self._pending.pop(msg.get("rid"), None)
@@ -707,6 +720,8 @@ class HubClient:
 
         async def cancel():
             self._watch_queues.pop(wid, None)
+            self._early_pushes.pop(wid, None)
+            self._closed_push_ids.add(wid)
             if not self._closed:
                 try:
                     await self._request("watch_cancel", id=wid)
@@ -755,6 +770,8 @@ class HubClient:
 
         async def cancel():
             self._sub_queues.pop(sid, None)
+            self._early_pushes.pop(sid, None)
+            self._closed_push_ids.add(sid)
             if not self._closed:
                 try:
                     await self._request("unsubscribe", id=sid)

@@ -167,22 +167,58 @@ class RemoteEngine(AsyncEngine):
                 pass
 
         cancel_task = asyncio.create_task(forward_cancel())
+        return ResponseStream(_RemoteStreamIter(reader, writer, cancel_task), ctx)
 
-        async def items() -> AsyncIterator[Any]:
-            try:
-                while True:
-                    frame = await read_frame(reader)
-                    if frame.type == FrameType.RESP_ITEM:
-                        yield frame.unpack()
-                    elif frame.type == FrameType.RESP_COMPLETE:
-                        return
-                    elif frame.type == FrameType.RESP_ERROR:
-                        raise RemoteEngineError(frame.unpack().get("error", "remote error"))
-                    # ignore heartbeats/unknown
-            except asyncio.IncompleteReadError:
-                raise RemoteEngineError("remote connection closed mid-stream")
-            finally:
-                cancel_task.cancel()
-                writer.close()
 
-        return ResponseStream(items(), ctx)
+class _RemoteStreamIter:
+    """Response-frame iterator whose aclose() always releases the connection.
+
+    A plain inner async generator would skip its ``finally`` when closed
+    before the first ``__anext__`` (never-started generators don't run their
+    body), leaking the socket and the cancel-forwarding task; this class owns
+    cleanup explicitly.
+    """
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        cancel_task: asyncio.Task,
+    ):
+        self._reader = reader
+        self._writer = writer
+        self._cancel_task = cancel_task
+        self._done = False
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        if self._done:
+            raise StopAsyncIteration
+        try:
+            while True:
+                frame = await read_frame(self._reader)
+                if frame.type == FrameType.RESP_ITEM:
+                    return frame.unpack()
+                if frame.type == FrameType.RESP_COMPLETE:
+                    await self.aclose()
+                    raise StopAsyncIteration
+                if frame.type == FrameType.RESP_ERROR:
+                    err = frame.unpack().get("error", "remote error")
+                    await self.aclose()
+                    raise RemoteEngineError(err)
+                # ignore heartbeats/unknown frame types
+        except asyncio.IncompleteReadError:
+            await self.aclose()
+            raise RemoteEngineError("remote connection closed mid-stream")
+        except BaseException:
+            await self.aclose()
+            raise
+
+    async def aclose(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._cancel_task.cancel()
+        self._writer.close()
