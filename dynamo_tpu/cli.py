@@ -139,6 +139,17 @@ def main(argv: Optional[list] = None) -> None:
     p_run.add_argument("--model", default="echo")
     p_run.add_argument("--tokenizer", default=None, help="path to tokenizer.json")
     p_run.add_argument("--model-config", default=None, help="model config json (out=tpu)")
+    # out=tpu engine knobs (reference: launch/dynamo-run/src/flags.rs)
+    p_run.add_argument("--arch", default=None, help="model architecture name or HF dir (out=tpu)")
+    p_run.add_argument("--checkpoint", default=None, help="safetensors dir (out=tpu)")
+    p_run.add_argument("--tp", type=int, default=1, help="tensor parallel size")
+    p_run.add_argument("--dp", type=int, default=1, help="data parallel size")
+    p_run.add_argument("--ep", type=int, default=1, help="expert parallel size")
+    p_run.add_argument("--block-size", type=int, default=16, dest="block_size")
+    p_run.add_argument("--num-blocks", type=int, default=256, dest="num_blocks")
+    p_run.add_argument("--max-batch", type=int, default=8, dest="max_batch")
+    p_run.add_argument("--max-model-len", type=int, default=1024, dest="max_model_len")
+    p_run.add_argument("--prefill-chunk", type=int, default=512, dest="prefill_chunk")
 
     args = parser.parse_args(argv)
     if args.cmd == "run":

@@ -1,0 +1,40 @@
+"""The native TPU engine: paged KV block manager, continuous-batching
+scheduler, and the jitted device step loop (SURVEY.md §7 stage 4 — the piece
+the reference outsources to vLLM/sglang)."""
+
+from .config import EngineConfig  # noqa: F401
+from .kv_manager import KvBlockManager  # noqa: F401
+from .scheduler import Scheduler, SequenceState  # noqa: F401
+
+
+def build_tpu_engine(args):
+    """CLI factory (``run out=tpu`` — reference: launch/dynamo-run engine
+    selection, lib.rs:198-453).  Imports jax lazily."""
+    from .engine import TpuEngine
+
+    arch = getattr(args, "arch", None)
+    model_config_path = getattr(args, "model_config", None)
+    if model_config_path:
+        import json
+
+        from ..models.config import ModelConfig, register_config
+
+        with open(model_config_path) as f:
+            cfg_json = json.load(f)
+        arch = register_config(
+            ModelConfig.from_hf_config(cfg_json, name=cfg_json.get("_name", "custom"))
+        ).name
+
+    cfg = EngineConfig(
+        model=arch or "debug-tiny",
+        block_size=getattr(args, "block_size", 16),
+        num_blocks=getattr(args, "num_blocks", 256),
+        max_batch=getattr(args, "max_batch", 8),
+        max_model_len=getattr(args, "max_model_len", 1024),
+        prefill_chunk=getattr(args, "prefill_chunk", 512),
+        tp=getattr(args, "tp", 1),
+        dp=getattr(args, "dp", 1),
+        ep=getattr(args, "ep", 1),
+        checkpoint_path=getattr(args, "checkpoint", None),
+    )
+    return TpuEngine(cfg)

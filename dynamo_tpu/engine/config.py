@@ -1,0 +1,71 @@
+"""Engine configuration knobs.
+
+The reference exposes these through engine flags (`launch/dynamo-run/src/
+flags.rs`: --context-length, --kv-cache-block-size, --tensor-parallel-size)
+and vLLM config YAML; here they parameterise the native engine directly.
+Bucketing fields exist because XLA compiles one program per shape: batch and
+prefill-length buckets are powers of two, so a handful of compilations cover
+every workload mix.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+def _pow2_buckets(lo: int, hi: int) -> List[int]:
+    out, v = [], lo
+    while v < hi:
+        out.append(v)
+        v *= 2
+    out.append(hi)
+    return sorted(set(out))
+
+
+@dataclass
+class EngineConfig:
+    model: str = "debug-tiny"
+    block_size: int = 16
+    num_blocks: int = 256  # HBM KV blocks (per replica)
+    max_batch: int = 8  # decode slots
+    max_model_len: int = 1024  # context limit per sequence
+    prefill_chunk: int = 512  # max tokens prefillled per device step
+    # mesh
+    dp: int = 1
+    tp: int = 1
+    ep: int = 1
+    dtype: str = "bfloat16"
+    cache_dtype: Optional[str] = None  # defaults to dtype
+    seed: int = 0
+    # derived buckets
+    batch_buckets: List[int] = field(default_factory=list)
+    prefill_buckets: List[int] = field(default_factory=list)
+    enable_prefix_caching: bool = True
+    checkpoint_path: Optional[str] = None  # safetensors dir; None = random init
+
+    def __post_init__(self) -> None:
+        if not self.batch_buckets:
+            self.batch_buckets = _pow2_buckets(1, self.max_batch)
+        if not self.prefill_buckets:
+            self.prefill_buckets = _pow2_buckets(
+                min(self.block_size, self.prefill_chunk), self.prefill_chunk
+            )
+        if self.cache_dtype is None:
+            self.cache_dtype = self.dtype
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return (self.max_model_len + self.block_size - 1) // self.block_size
+
+    def bucket_batch(self, n: int) -> int:
+        for b in self.batch_buckets:
+            if n <= b:
+                return b
+        return self.batch_buckets[-1]
+
+    def bucket_prefill(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return self.prefill_buckets[-1]

@@ -1,0 +1,397 @@
+"""TpuEngine: the native JAX engine behind the AsyncEngine interface.
+
+This is the component the reference delegates to vLLM/sglang subprocesses
+(lib/engines/* — SURVEY.md §2.8); here it is in-process and TPU-native:
+
+- one jitted step function (forward + fused sampling) per shape bucket;
+  batch/prefill-length buckets are powers of two so a handful of XLA
+  programs cover every workload mix;
+- the KV cache lives in HBM as donated jit operands — scatters update it
+  in place, no reallocation per step;
+- the asyncio step loop runs device dispatch in a worker thread so request
+  ingress/egress stay responsive (dispatch is async, but fetching sampled
+  tokens blocks);
+- per-request cancellation is polled between steps (a batched synchronous
+  device loop can't preempt mid-step — SURVEY.md §7 hard part (c));
+- KV events (stored/removed, chained hashes) and ForwardPassMetrics are
+  emitted exactly as the reference's C-API hooks do
+  (lib/bindings/c/src/lib.rs:51-296), feeding the KV-aware router.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..llm.kv_router.protocols import ForwardPassMetrics, KvCacheEvent
+from ..llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
+from ..models.config import ModelConfig, get_config
+from ..models.llama import KVCache, ModelBatch, forward, init_params
+from ..ops.sampling import sample_tokens
+from ..parallel.mesh import (
+    MeshConfig,
+    cache_pspec,
+    make_mesh,
+    param_pspecs,
+    shard_tree,
+    sharding_tree,
+)
+from ..runtime.engine import AsyncEngine, Context, ResponseStream
+from .config import EngineConfig
+from .kv_manager import KvBlockManager
+from .scheduler import DecodeWork, PrefillWork, Scheduler, SequenceState
+
+logger = logging.getLogger(__name__)
+
+_FINISHED = object()  # queue sentinel
+
+
+class TpuEngine(AsyncEngine):
+    """Token-in/token-out engine (ExecutionContext equivalent)."""
+
+    def __init__(
+        self,
+        cfg: EngineConfig,
+        event_callback: Optional[Callable[[KvCacheEvent], None]] = None,
+        params: Any = None,
+    ):
+        self.cfg = cfg
+        self.model_config: ModelConfig = get_config(cfg.model).with_overrides(
+            dtype=cfg.dtype
+        )
+        self.kv = KvBlockManager(
+            cfg.num_blocks,
+            cfg.block_size,
+            event_callback=event_callback,
+            enable_prefix_caching=cfg.enable_prefix_caching,
+        )
+        self.scheduler = Scheduler(cfg, self.kv)
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._contexts: Dict[str, Any] = {}
+        self._wake = asyncio.Event()
+        self._closed = False
+        self._loop_task: Optional[asyncio.Task] = None
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        self._steps = 0
+
+        # --- device state -------------------------------------------------
+        mesh_cfg = MeshConfig(dp=cfg.dp, tp=cfg.tp, ep=cfg.ep)
+        self.mesh = make_mesh(mesh_cfg) if mesh_cfg.num_devices > 1 else None
+        if params is None:
+            if cfg.checkpoint_path:
+                from ..models.loader import load_params
+
+                params = load_params(self.model_config, cfg.checkpoint_path)
+            else:
+                params = init_params(self.model_config, jax.random.PRNGKey(cfg.seed))
+        cache = KVCache.create(
+            self.model_config,
+            cfg.num_blocks,
+            cfg.block_size,
+            dtype=jnp.dtype(cfg.cache_dtype),
+        )
+        if self.mesh is not None:
+            params = shard_tree(params, param_pspecs(self.model_config), self.mesh)
+            cache = shard_tree(
+                cache, KVCache(cache_pspec(), cache_pspec()), self.mesh
+            )
+        self.params = params
+        self.cache = cache
+
+        model_config, block_size = self.model_config, cfg.block_size
+
+        def _step(params, cache, batch, temp, topk, topp, rng):
+            logits, cache = forward(params, model_config, batch, cache, block_size)
+            tokens = sample_tokens(logits, rng, temp, topk, topp)
+            return tokens, cache
+
+        donate = (1,)
+        if self.mesh is None:
+            self._step_fn = jax.jit(_step, donate_argnums=donate)
+        else:
+            cache_sh = sharding_tree(
+                cache, KVCache(cache_pspec(), cache_pspec()), self.mesh
+            )
+            self._step_fn = jax.jit(
+                _step,
+                donate_argnums=donate,
+                out_shardings=(None, cache_sh),
+            )
+
+    # ------------------------------------------------------------ public API
+    async def generate(self, request: Context) -> ResponseStream:
+        pre = PreprocessedRequest.from_dict(request.data)
+        if len(pre.token_ids) > self.cfg.max_model_len:
+            raise ValueError(
+                f"prompt length {len(pre.token_ids)} exceeds max_model_len "
+                f"{self.cfg.max_model_len}"
+            )
+        self._ensure_loop()
+        seq = SequenceState.from_request(request.id, pre, self.cfg)
+        queue: asyncio.Queue = asyncio.Queue()
+        self._queues[request.id] = queue
+        self._contexts[request.id] = request.ctx
+        self.scheduler.add(seq)
+        self._wake.set()
+
+        async def gen() -> AsyncIterator[Dict[str, Any]]:
+            try:
+                while True:
+                    item = await queue.get()
+                    if item is _FINISHED:
+                        return
+                    yield item
+            finally:
+                self._queues.pop(request.id, None)
+                self._contexts.pop(request.id, None)
+
+        return ResponseStream(gen(), request.ctx)
+
+    def metrics(self) -> ForwardPassMetrics:
+        return ForwardPassMetrics(
+            request_active_slots=self.scheduler.num_running,
+            request_total_slots=self.cfg.max_batch,
+            kv_active_blocks=self.kv.active_blocks,
+            kv_total_blocks=self.kv.num_blocks,
+            num_requests_waiting=self.scheduler.num_waiting,
+            gpu_cache_usage_perc=self.kv.usage,
+            gpu_prefix_cache_hit_rate=self.kv.hit_rate,
+        )
+
+    async def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        if self._loop_task is not None:
+            await self._loop_task
+            self._loop_task = None
+        # Fail whatever is still in flight so no generate() stream hangs.
+        self._fail_all()
+
+    # -------------------------------------------------------------- the loop
+    def _ensure_loop(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.get_running_loop().create_task(self._run_loop())
+
+    async def _run_loop(self) -> None:
+        while not self._closed:
+            self._cancel_stopped()
+            work = self.scheduler.schedule()
+            for seq in self.scheduler.take_rejected():
+                self._finish(seq, FinishReason.ERROR)
+            if work is None:
+                if self.scheduler.num_waiting and not self.scheduler.num_running:
+                    # e.g. decode just preempted everyone back to waiting:
+                    # retry admission immediately (terminates: each pass
+                    # admits or rejects at least one waiting sequence).
+                    await asyncio.sleep(0)
+                    continue
+                # Idle: running is empty (running sequences always yield
+                # work), so sleep until a new request arrives.
+                self._wake.clear()
+                await self._wake.wait()
+                continue
+            try:
+                if isinstance(work, PrefillWork):
+                    await self._run_prefill(work)
+                else:
+                    await self._run_decode(work)
+            except Exception:  # engine-fatal: fail all inflight requests
+                logger.exception("engine step failed")
+                self._fail_all()
+                return
+            self._steps += 1
+            await asyncio.sleep(0)  # let ingress/egress run between steps
+
+    def _cancel_stopped(self) -> None:
+        for seq in list(self.scheduler.running) + list(self.scheduler.waiting):
+            ctx = self._contexts.get(seq.request_id)
+            if ctx is not None and ctx.is_stopped and not seq.finished:
+                seq.finished = True
+                self.scheduler.remove(seq)
+                self._finish(seq, FinishReason.CANCELLED)
+
+    def _fail_all(self) -> None:
+        for seq in list(self.scheduler.running) + list(self.scheduler.waiting):
+            self.scheduler.remove(seq)
+            self._finish(seq, FinishReason.ERROR)
+
+    # ------------------------------------------------------------ batch build
+    def _next_rng(self) -> jax.Array:
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _pad_tables(self, rows: List[List[int]]) -> np.ndarray:
+        width = self.cfg.max_blocks_per_seq
+        out = np.zeros((len(rows), width), np.int32)
+        for i, r in enumerate(rows):
+            out[i, : len(r)] = r[:width]
+        return out
+
+    async def _run_prefill(self, work: PrefillWork) -> None:
+        bs = self.cfg.block_size
+        B = self.cfg.bucket_batch(len(work.items))
+        Sq = self.cfg.bucket_prefill(max(chunk for _, _, chunk in work.items))
+
+        tokens = np.zeros((B, Sq), np.int32)
+        positions = np.zeros((B, Sq), np.int32)
+        slots = np.full((B, Sq), -1, np.int32)
+        tables_rows: List[List[int]] = []
+        ctx_lens = np.zeros((B,), np.int32)
+        logits_idx = np.zeros((B,), np.int32)
+        temp = np.zeros((B,), np.float32)
+        topk = np.zeros((B,), np.int32)
+        topp = np.ones((B,), np.float32)
+
+        for i, (seq, start, chunk) in enumerate(work.items):
+            all_toks = seq.prompt + seq.output
+            tokens[i, :chunk] = all_toks[start : start + chunk]
+            pos = np.arange(start, start + chunk, dtype=np.int32)
+            positions[i, :chunk] = pos
+            blk_ids = np.asarray(seq.block_ids, np.int32)
+            slots[i, :chunk] = blk_ids[pos // bs] * bs + pos % bs
+            tables_rows.append(seq.block_ids)
+            ctx_lens[i] = start + chunk
+            logits_idx[i] = chunk - 1
+            temp[i] = seq.sampling_temperature
+            topk[i] = seq.sampling_top_k
+            topp[i] = seq.sampling_top_p
+        tables_rows += [[] for _ in range(B - len(work.items))]
+
+        batch = ModelBatch(
+            token_ids=jnp.asarray(tokens),
+            positions=jnp.asarray(positions),
+            slot_mapping=jnp.asarray(slots),
+            block_tables=jnp.asarray(self._pad_tables(tables_rows)),
+            context_lens=jnp.asarray(ctx_lens),
+            logits_idx=jnp.asarray(logits_idx),
+        )
+        sampled = await self._dispatch(batch, temp, topk, topp)
+
+        for i, (seq, start, chunk) in enumerate(work.items):
+            seq.num_computed = start + chunk
+            self._seal_completed_blocks(seq)
+            if not seq.in_prefill:  # prompt fully computed → first output token
+                self._accept_token(seq, int(sampled[i]))
+
+    async def _run_decode(self, work: DecodeWork) -> None:
+        bs = self.cfg.block_size
+        B = self.cfg.bucket_batch(len(work.items))
+
+        tokens = np.zeros((B, 1), np.int32)
+        positions = np.zeros((B, 1), np.int32)
+        slots = np.full((B, 1), -1, np.int32)
+        tables_rows: List[List[int]] = []
+        ctx_lens = np.zeros((B,), np.int32)
+        logits_idx = np.zeros((B,), np.int32)
+        temp = np.zeros((B,), np.float32)
+        topk = np.zeros((B,), np.int32)
+        topp = np.ones((B,), np.float32)
+
+        for i, seq in enumerate(work.items):
+            all_toks = seq.prompt + seq.output
+            p = seq.num_computed
+            tokens[i, 0] = all_toks[p]
+            positions[i, 0] = p
+            slots[i, 0] = seq.block_ids[p // bs] * bs + p % bs
+            tables_rows.append(seq.block_ids)
+            ctx_lens[i] = p + 1
+            temp[i] = seq.sampling_temperature
+            topk[i] = seq.sampling_top_k
+            topp[i] = seq.sampling_top_p
+        tables_rows += [[] for _ in range(B - len(work.items))]
+
+        batch = ModelBatch(
+            token_ids=jnp.asarray(tokens),
+            positions=jnp.asarray(positions),
+            slot_mapping=jnp.asarray(slots),
+            block_tables=jnp.asarray(self._pad_tables(tables_rows)),
+            context_lens=jnp.asarray(ctx_lens),
+            logits_idx=jnp.asarray(logits_idx),
+        )
+        sampled = await self._dispatch(batch, temp, topk, topp)
+
+        for i, seq in enumerate(work.items):
+            fed = (seq.prompt + seq.output)[seq.num_computed]
+            if seq.num_computed >= len(seq.prompt):
+                seq.block_seq.append(fed)
+            seq.num_computed += 1
+            self._seal_completed_blocks(seq)
+            self._accept_token(seq, int(sampled[i]))
+
+    async def _dispatch(self, batch, temp, topk, topp) -> np.ndarray:
+        rng = self._next_rng()
+        step = self._step_fn
+
+        def run() -> np.ndarray:
+            tokens_dev, self.cache = step(
+                self.params,
+                self.cache,
+                batch,
+                jnp.asarray(temp),
+                jnp.asarray(topk),
+                jnp.asarray(topp),
+                rng,
+            )
+            return np.asarray(tokens_dev)
+
+        return await asyncio.to_thread(run)
+
+    # ------------------------------------------------------------ per-token
+    def _seal_completed_blocks(self, seq: SequenceState) -> None:
+        complete = seq.num_computed // self.cfg.block_size
+        hashed = len(seq.block_seq.blocks)
+        while seq.num_sealed_blocks < min(complete, hashed):
+            idx = seq.num_sealed_blocks
+            self.kv.seal_block(seq.block_ids[idx], seq.block_seq.blocks[idx])
+            seq.num_sealed_blocks += 1
+
+    def _accept_token(self, seq: SequenceState, token: int) -> None:
+        seq.output.append(token)
+        reason = self._check_stop(seq, token)
+        queue = self._queues.get(seq.request_id)
+        # Stop-triggering tokens (eos / stop_token_ids) are not emitted,
+        # matching the reference Backend's stop handling (backend.rs:234-423).
+        if queue is not None and reason is not FinishReason.STOP:
+            queue.put_nowait(LLMEngineOutput.token(token))
+        if reason is not None:
+            seq.finished = True
+            self.scheduler.remove(seq)
+            self._finish(seq, reason)
+
+    def _check_stop(self, seq: SequenceState, token: int) -> Optional[FinishReason]:
+        n_out = len(seq.output)
+        min_ok = seq.min_new_tokens is None or n_out >= seq.min_new_tokens
+        if min_ok and token in seq.stop_token_ids:
+            return FinishReason.STOP
+        if (
+            min_ok
+            and not seq.ignore_eos
+            and token in self.model_config.eos_token_ids
+        ):
+            return FinishReason.STOP
+        if seq.max_new_tokens is not None and n_out >= seq.max_new_tokens:
+            return FinishReason.LENGTH
+        if seq.total_tokens >= self.cfg.max_model_len:
+            return FinishReason.LENGTH
+        return None
+
+    def _finish(self, seq: SequenceState, reason: FinishReason) -> None:
+        queue = self._queues.get(seq.request_id)
+        if queue is None:
+            return
+        queue.put_nowait(
+            LLMEngineOutput.finished(
+                reason,
+                usage={
+                    "prompt_tokens": len(seq.prompt),
+                    "completion_tokens": len(seq.output),
+                    "total_tokens": len(seq.prompt) + len(seq.output),
+                },
+            )
+        )
+        queue.put_nowait(_FINISHED)

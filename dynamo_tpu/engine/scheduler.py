@@ -1,0 +1,234 @@
+"""Continuous-batching scheduler: admission, chunked prefill, decode slots.
+
+The reference's scheduling lives inside vLLM; this is the native equivalent,
+shaped for XLA's compilation model: each device step is either one *prefill*
+batch (a few sequences' next prompt chunks, padded to a token bucket) or one
+*decode* batch (every running sequence advances one token, padded to a batch
+bucket).  Keeping the two phases separate keeps shapes regular → a handful of
+compiled programs total.
+
+Admission is blocks-aware: a sequence is only admitted when the KV manager
+can allocate its prompt blocks (minus prefix-cache hits).  Decode growth
+allocates one block at a time; if the pool is exhausted the youngest sequence
+is preempted back to the waiting queue (its blocks freed — recomputed later,
+matching the reference engines' recompute-style preemption).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional, Sequence, Tuple
+
+from ..llm.protocols import PreprocessedRequest
+from ..tokens import TokenBlockSequence
+from .config import EngineConfig
+from .kv_manager import KvBlockManager
+
+
+@dataclass
+class SequenceState:
+    """Everything the engine tracks per in-flight request."""
+
+    request_id: str
+    prompt: List[int]
+    block_seq: TokenBlockSequence  # hashes prompt+output as blocks complete
+    sampling_temperature: float = 0.0
+    sampling_top_k: int = 0
+    sampling_top_p: float = 1.0
+    max_new_tokens: Optional[int] = None
+    min_new_tokens: Optional[int] = None
+    stop_token_ids: frozenset = frozenset()
+    ignore_eos: bool = False
+
+    output: List[int] = field(default_factory=list)
+    block_ids: List[int] = field(default_factory=list)
+    num_computed: int = 0  # tokens whose KV is resident
+    num_cached_prompt: int = 0  # prefix-cache hit length (metrics)
+    finished: bool = False
+    # blocks sealed (hash-published) so far — index into block_seq.blocks
+    num_sealed_blocks: int = 0
+
+    @property
+    def total_tokens(self) -> int:
+        return len(self.prompt) + len(self.output)
+
+    @property
+    def in_prefill(self) -> bool:
+        # The final prompt token's forward pass produces the first output
+        # token, so prefill is done once num_computed == len(prompt).
+        return self.num_computed < len(self.prompt)
+
+    @classmethod
+    def from_request(
+        cls, request_id: str, pre: PreprocessedRequest, cfg: EngineConfig
+    ) -> "SequenceState":
+        samp, stop = pre.sampling_options, pre.stop_conditions
+        return cls(
+            request_id=request_id,
+            prompt=list(pre.token_ids),
+            block_seq=TokenBlockSequence(block_size=cfg.block_size),
+            sampling_temperature=samp.temperature or 0.0,
+            sampling_top_k=samp.top_k or 0,
+            sampling_top_p=samp.top_p if samp.top_p is not None else 1.0,
+            max_new_tokens=stop.max_tokens,
+            min_new_tokens=stop.min_tokens,
+            stop_token_ids=frozenset(stop.stop_token_ids or ()),
+            ignore_eos=bool(stop.ignore_eos),
+        )
+
+
+@dataclass
+class PrefillWork:
+    """One prefill step: per-seq (state, chunk_start, chunk_len)."""
+
+    items: List[Tuple[SequenceState, int, int]]
+
+
+@dataclass
+class DecodeWork:
+    """One decode step over running sequences."""
+
+    items: List[SequenceState]
+
+
+class Scheduler:
+    def __init__(self, cfg: EngineConfig, kv: KvBlockManager):
+        self.cfg = cfg
+        self.kv = kv
+        self.waiting: Deque[SequenceState] = deque()
+        self.running: List[SequenceState] = []
+        self.rejected: List[SequenceState] = []  # can never fit; engine fails them
+        self.preempted = 0  # cumulative, for metrics
+
+    # ------------------------------------------------------------------ entry
+    def add(self, seq: SequenceState) -> None:
+        # Trim the generation budget to the context limit rather than reject;
+        # over-long prompts are rejected by the engine before reaching us.
+        room = self.cfg.max_model_len - len(seq.prompt)
+        if seq.max_new_tokens is None or seq.max_new_tokens > room:
+            seq.max_new_tokens = room
+        self.waiting.append(seq)
+
+    def remove(self, seq: SequenceState) -> None:
+        """Drop a sequence (finished or cancelled) and release its blocks."""
+        if seq in self.running:
+            self.running.remove(seq)
+        elif seq in self.waiting:
+            self.waiting.remove(seq)
+        if seq.block_ids:
+            self.kv.free_sequence(seq.block_ids)
+            seq.block_ids = []
+
+    # --------------------------------------------------------------- planning
+    def schedule(self) -> Optional[PrefillWork | DecodeWork]:
+        """Pick the next device step.  Prefill-priority (matches vLLM default
+        + the reference's TTFT-oriented disagg design): admit/advance prompts
+        first, decode only when no prefill work is pending."""
+        prefill = self._schedule_prefill()
+        if prefill is not None:
+            return prefill
+        return self._schedule_decode()
+
+    def _schedule_prefill(self) -> Optional[PrefillWork]:
+        budget = self.cfg.prefill_chunk
+        items: List[Tuple[SequenceState, int, int]] = []
+
+        # Continue part-way prefills already running (chunked prefill).
+        for seq in self.running:
+            if budget <= 0:
+                break
+            if seq.in_prefill and not seq.finished:
+                chunk = min(budget, len(seq.prompt) - seq.num_computed)
+                items.append((seq, seq.num_computed, chunk))
+                budget -= chunk
+
+        # Admit newcomers while slots + blocks + budget allow.
+        while budget > 0 and self.waiting:
+            if len(self.running) >= self.cfg.max_batch:
+                break
+            seq = self.waiting[0]
+            if not self._try_admit(seq):
+                if not self.running and self.kv.active_blocks == 0:
+                    # Pool is entirely free and it still doesn't fit: this
+                    # request can never run — reject instead of deadlocking.
+                    self.waiting.popleft()
+                    self.rejected.append(seq)
+                    continue
+                break
+            self.waiting.popleft()
+            self.running.append(seq)
+            if seq.in_prefill:
+                chunk = min(budget, len(seq.prompt) - seq.num_computed)
+                items.append((seq, seq.num_computed, chunk))
+                budget -= chunk
+            # else: fully prefix-cached; it will decode next step.
+
+        return PrefillWork(items) if items else None
+
+    def _try_admit(self, seq: SequenceState) -> bool:
+        """Allocate prompt blocks (sharing any cached prefix)."""
+        prompt_blocks = (len(seq.prompt) + self.cfg.block_size) // self.cfg.block_size
+        # ^ +1 slack block so the first decode token always has a slot.
+        seq.block_seq.extend(seq.prompt)
+        alloc = self.kv.allocate_sequence(seq.block_seq.blocks, prompt_blocks)
+        if alloc is None:
+            seq.block_seq = TokenBlockSequence(block_size=self.cfg.block_size)
+            return False
+        seq.block_ids, cached_tokens = alloc
+        # A fully-cached prompt must still recompute its last token to get
+        # logits for sampling the first output token.
+        if cached_tokens >= len(seq.prompt):
+            cached_tokens = len(seq.prompt) - 1
+        seq.num_computed = cached_tokens
+        seq.num_cached_prompt = cached_tokens
+        seq.num_sealed_blocks = cached_tokens // self.cfg.block_size
+        return True
+
+    def _schedule_decode(self) -> Optional[DecodeWork]:
+        ready = [s for s in self.running if not s.in_prefill and not s.finished]
+        if not ready:
+            return None
+        # Ensure every decoding seq has a slot for its next position; preempt
+        # the youngest sequences if the pool is dry.
+        for seq in list(reversed(ready)):
+            if not self._ensure_slot(seq):
+                self._preempt(seq)
+                ready.remove(seq)
+        return DecodeWork(ready[: self.cfg.max_batch]) if ready else None
+
+    def _ensure_slot(self, seq: SequenceState) -> bool:
+        needed_blocks = (seq.num_computed + 1 + self.cfg.block_size - 1) // self.cfg.block_size
+        while len(seq.block_ids) < needed_blocks:
+            bid = self.kv.allocate_block()
+            if bid is None:
+                return False
+            seq.block_ids.append(bid)
+        return True
+
+    def _preempt(self, seq: SequenceState) -> None:
+        """Recompute-style preemption: free blocks, rewind to waiting."""
+        self.running.remove(seq)
+        self.kv.free_sequence(seq.block_ids)
+        seq.block_ids = []
+        # Fold generated tokens into the prompt so recompute resumes exactly.
+        seq.prompt = seq.prompt + seq.output
+        seq.output = []
+        seq.num_computed = 0
+        seq.num_sealed_blocks = 0
+        seq.block_seq = TokenBlockSequence(block_size=self.cfg.block_size)
+        self.waiting.appendleft(seq)
+        self.preempted += 1
+
+    def take_rejected(self) -> List[SequenceState]:
+        out, self.rejected = self.rejected, []
+        return out
+
+    # ---------------------------------------------------------------- metrics
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)

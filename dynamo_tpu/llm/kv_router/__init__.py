@@ -1,0 +1,13 @@
+"""KV-cache-aware routing stack (reference: lib/llm/src/kv_router/**).
+
+Protocol types (events, metrics) are shared with the engine, which emits
+them; the indexer/scheduler consume them to pick workers by prefix overlap.
+"""
+
+from .protocols import (  # noqa: F401
+    ForwardPassMetrics,
+    KvCacheEvent,
+    KvCacheRemoveData,
+    KvCacheStoreData,
+    KvCacheStoredBlockData,
+)

@@ -1,0 +1,185 @@
+"""Global KV prefix index: which worker holds which cached blocks.
+
+Reference semantics (not code): lib/llm/src/kv_router/indexer.rs — a radix
+structure over *chained* block hashes with a per-node worker set;
+``apply_event`` ingests per-worker ``KvCacheEvent``s (Stored/Removed/Cleared)
+and ``find_matches`` walks a request's block-hash chain, returning per-worker
+overlap counts (how many leading blocks each worker already holds).
+
+Because block hashes are chained (dynamo_tpu.tokens), one hash already
+identifies its whole prefix, so lookup is a flat dict walk rather than an
+explicit trie descent; parent links are kept for pruning and diagnostics.
+The reference runs this on a dedicated thread fed by channels — here apply/
+match are O(blocks) dict ops on the event loop; ``KvIndexerSharded`` spreads
+very large indexes over hash shards (indexer.rs:499-796).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from ...tokens import hash_token_blocks
+from .protocols import KvCacheEvent, KvCacheRemoveData, KvCacheStoreData
+
+WorkerId = int
+
+
+@dataclass
+class OverlapScores:
+    """worker → number of leading request blocks it already caches."""
+
+    scores: Dict[WorkerId, int] = field(default_factory=dict)
+
+    def best(self) -> Optional[WorkerId]:
+        if not self.scores:
+            return None
+        return max(self.scores, key=self.scores.get)
+
+
+@dataclass
+class _Node:
+    workers: Set[WorkerId] = field(default_factory=set)
+    parent_hash: Optional[int] = None
+
+
+class RadixIndex:
+    """Hash → worker-set index with per-worker reverse map for fast removal."""
+
+    def __init__(self) -> None:
+        self._nodes: Dict[int, _Node] = {}
+        self._by_worker: Dict[WorkerId, Set[int]] = {}
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def add_block(
+        self, worker: WorkerId, seq_hash: int, parent_hash: Optional[int]
+    ) -> None:
+        node = self._nodes.get(seq_hash)
+        if node is None:
+            node = self._nodes[seq_hash] = _Node(parent_hash=parent_hash)
+        node.workers.add(worker)
+        self._by_worker.setdefault(worker, set()).add(seq_hash)
+
+    def remove_block(self, worker: WorkerId, seq_hash: int) -> None:
+        node = self._nodes.get(seq_hash)
+        if node is None:
+            return
+        node.workers.discard(worker)
+        owned = self._by_worker.get(worker)
+        if owned is not None:
+            owned.discard(seq_hash)
+        if not node.workers:
+            del self._nodes[seq_hash]
+
+    def remove_worker(self, worker: WorkerId) -> None:
+        for seq_hash in self._by_worker.pop(worker, set()):
+            node = self._nodes.get(seq_hash)
+            if node is not None:
+                node.workers.discard(worker)
+                if not node.workers:
+                    del self._nodes[seq_hash]
+
+    def workers_for(self, seq_hash: int) -> Set[WorkerId]:
+        node = self._nodes.get(seq_hash)
+        return node.workers if node is not None else set()
+
+    def find_matches(self, seq_hashes: Sequence[int]) -> OverlapScores:
+        """Per-worker count of leading blocks present (a worker's count stops
+        at its first missing block — prefix semantics)."""
+        scores: Dict[WorkerId, int] = {}
+        active: Optional[Set[WorkerId]] = None
+        for i, h in enumerate(seq_hashes):
+            holders = self.workers_for(h)
+            active = set(holders) if active is None else active & holders
+            if not active:
+                break
+            for w in active:
+                scores[w] = i + 1
+        return OverlapScores(scores)
+
+
+class KvIndexer:
+    """Event-driven index over one worker fleet (one model endpoint)."""
+
+    def __init__(self, block_size: int):
+        self.block_size = block_size
+        self._index = RadixIndex()
+        self.events_applied = 0
+
+    def apply_event(self, worker: WorkerId, event: KvCacheEvent) -> None:
+        data = event.data
+        if isinstance(data, KvCacheStoreData):
+            # Chain within the event: the first block parents on the event's
+            # parent_hash, each subsequent block on its predecessor.
+            parent = data.parent_hash
+            for blk in data.blocks:
+                self._index.add_block(worker, blk.block_hash, parent)
+                parent = blk.block_hash
+        elif isinstance(data, KvCacheRemoveData):
+            for h in data.block_hashes:
+                self._index.remove_block(worker, h)
+        else:  # cleared
+            self._index.remove_worker(worker)
+        self.events_applied += 1
+
+    def remove_worker(self, worker: WorkerId) -> None:
+        self._index.remove_worker(worker)
+
+    def find_matches(self, token_ids: Sequence[int]) -> OverlapScores:
+        blocks = hash_token_blocks(token_ids, self.block_size)
+        return self.find_matches_for_hashes([b.sequence_hash for b in blocks])
+
+    def find_matches_for_hashes(self, seq_hashes: Sequence[int]) -> OverlapScores:
+        return self._index.find_matches(seq_hashes)
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+
+class KvIndexerSharded:
+    """Hash-sharded variant for very large fleets (indexer.rs:499-796): each
+    shard owns hashes where ``hash % num_shards == shard_id``.  Matching
+    queries every shard per block (cheap dict hits) — the win is bounded
+    per-shard memory and, later, per-shard threads/processes."""
+
+    def __init__(self, block_size: int, num_shards: int = 4):
+        self.block_size = block_size
+        self.num_shards = num_shards
+        self._shards = [KvIndexer(block_size) for _ in range(num_shards)]
+
+    def _shard_for(self, seq_hash: int) -> KvIndexer:
+        return self._shards[seq_hash % self.num_shards]
+
+    def apply_event(self, worker: WorkerId, event: KvCacheEvent) -> None:
+        data = event.data
+        if isinstance(data, KvCacheStoreData):
+            for blk in data.blocks:
+                self._shard_for(blk.block_hash)._index.add_block(
+                    worker, blk.block_hash, data.parent_hash
+                )
+        elif isinstance(data, KvCacheRemoveData):
+            for h in data.block_hashes:
+                self._shard_for(h)._index.remove_block(worker, h)
+        else:
+            for shard in self._shards:
+                shard.remove_worker(worker)
+
+    def remove_worker(self, worker: WorkerId) -> None:
+        for shard in self._shards:
+            shard.remove_worker(worker)
+
+    def find_matches(self, token_ids: Sequence[int]) -> OverlapScores:
+        blocks = hash_token_blocks(token_ids, self.block_size)
+        hashes = [b.sequence_hash for b in blocks]
+        scores: Dict[WorkerId, int] = {}
+        active: Optional[Set[WorkerId]] = None
+        for i, h in enumerate(hashes):
+            holders = self._shard_for(h)._index.workers_for(h)
+            active = set(holders) if active is None else active & holders
+            if not active:
+                break
+            for w in active:
+                scores[w] = i + 1
+        return OverlapScores(scores)

@@ -1,0 +1,158 @@
+"""Event/metrics publishers (worker side) and the metrics aggregator
+(router side), over the runtime's event plane.
+
+Reference semantics: lib/llm/src/kv_router/publisher.rs (KvEventPublisher:
+worker-stamped cache events on subject ``kv_events``; KvMetricsPublisher:
+ForwardPassMetrics via watch channel + stats scrape) and
+metrics_aggregator.rs / scoring.rs (ProcessedEndpoints{endpoints, load_avg,
+load_std}).  The TPU build pushes metrics on the event plane (subject
+``kv_metrics``) instead of NATS ``$SRV.STATS`` polling — same data, push
+instead of scrape.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import statistics
+from typing import Callable, Dict, List, Optional
+
+from .indexer import WorkerId
+from .protocols import ForwardPassMetrics, KvCacheEvent
+from .scheduler import WorkerSnapshot
+
+logger = logging.getLogger(__name__)
+
+KV_EVENTS_TOPIC = "kv_events"
+KV_METRICS_TOPIC = "kv_metrics"
+
+
+class KvEventPublisher:
+    """Worker-side: stamp cache events with worker_id and publish them.
+
+    Sync-callable (``__call__``) so it can be handed directly to the engine's
+    ``event_callback``; publishes are queued onto the running event loop.
+    """
+
+    def __init__(self, component, worker_id: WorkerId):
+        self._component = component
+        self.worker_id = worker_id
+        self._tasks: set = set()
+
+    def __call__(self, event: KvCacheEvent) -> None:
+        payload = {"worker_id": self.worker_id, "event": event.to_dict()}
+        loop = asyncio.get_event_loop()
+        task = loop.create_task(self._component.publish(KV_EVENTS_TOPIC, payload))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def publish(self, event: KvCacheEvent) -> None:
+        await self._component.publish(
+            KV_EVENTS_TOPIC, {"worker_id": self.worker_id, "event": event.to_dict()}
+        )
+
+
+class KvMetricsPublisher:
+    """Worker-side: periodically push ForwardPassMetrics snapshots."""
+
+    def __init__(
+        self,
+        component,
+        worker_id: WorkerId,
+        source: Callable[[], ForwardPassMetrics],
+        interval: float = 1.0,
+    ):
+        self._component = component
+        self.worker_id = worker_id
+        self._source = source
+        self._interval = interval
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "KvMetricsPublisher":
+        self._task = asyncio.get_running_loop().create_task(self._run())
+        return self
+
+    async def publish_once(self) -> None:
+        await self._component.publish(
+            KV_METRICS_TOPIC,
+            {"worker_id": self.worker_id, "metrics": self._source().to_dict()},
+        )
+
+    async def _run(self) -> None:
+        try:
+            while True:
+                await self.publish_once()
+                await asyncio.sleep(self._interval)
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            logger.exception("metrics publisher failed")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+
+class KvMetricsAggregator:
+    """Router-side: subscribe to metrics pushes, keep the latest snapshot per
+    worker, expose ProcessedEndpoints-style load statistics."""
+
+    def __init__(self, component):
+        self._component = component
+        self._snapshots: Dict[WorkerId, ForwardPassMetrics] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._sub = None
+
+    async def start(self) -> "KvMetricsAggregator":
+        self._sub = await self._component.subscribe(KV_METRICS_TOPIC)
+        self._task = asyncio.get_running_loop().create_task(self._run())
+        return self
+
+    async def _run(self) -> None:
+        try:
+            async for msg in self._sub:
+                payload = msg.payload if hasattr(msg, "payload") else msg
+                try:
+                    wid = payload["worker_id"]
+                    self._snapshots[wid] = ForwardPassMetrics.from_dict(
+                        payload["metrics"]
+                    )
+                except (KeyError, TypeError):
+                    logger.warning("malformed kv_metrics payload: %r", payload)
+        except asyncio.CancelledError:
+            pass
+
+    def remove_worker(self, worker_id: WorkerId) -> None:
+        self._snapshots.pop(worker_id, None)
+
+    def snapshot(self, worker_id: WorkerId) -> ForwardPassMetrics:
+        return self._snapshots.get(worker_id, ForwardPassMetrics())
+
+    def endpoints(self, worker_ids: List[WorkerId]) -> List[WorkerSnapshot]:
+        return [WorkerSnapshot(w, self.snapshot(w)) for w in worker_ids]
+
+    def load_stats(self) -> Dict[str, float]:
+        """ProcessedEndpoints load_avg/load_std over kv_active_blocks."""
+        loads = [m.kv_active_blocks for m in self._snapshots.values()]
+        if not loads:
+            return {"load_avg": 0.0, "load_std": 0.0}
+        return {
+            "load_avg": float(statistics.fmean(loads)),
+            "load_std": float(statistics.pstdev(loads)) if len(loads) > 1 else 0.0,
+        }
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if self._sub is not None and hasattr(self._sub, "aclose"):
+            await self._sub.aclose()

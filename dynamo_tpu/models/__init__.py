@@ -1,0 +1,5 @@
+"""Model families executed natively in JAX (the reference delegates model
+execution to vLLM/sglang engine subprocesses; here the engine IS the
+framework — SURVEY.md §2.8, §7 stage 4)."""
+
+from .config import ModelConfig, get_config, register_config  # noqa: F401

@@ -1,0 +1,181 @@
+"""Llama-family forward pass (dense + Mixtral-style MoE) with paged KV.
+
+This replaces the reference's delegated engines (vLLM/sglang subprocesses —
+SURVEY.md §2.8): the model is a pure function over a params pytree, executed
+under jit on a device mesh.  TPU-first choices:
+
+- layer weights are *stacked* [L, ...] and the decoder runs as one
+  ``lax.scan`` — one compiled layer body regardless of depth, fast compiles;
+- all shapes static: queries padded per bucket, padding tokens carry slot -1
+  (dropped by the cache scatter) and are never read back (masked gather);
+- bfloat16 weights/activations (MXU-native), f32 softmax/norm accumulations,
+  f32 logits for sampling;
+- one forward for prefill (Sq = bucket) and decode (Sq = 1) — same code path,
+  attention always reads the paged cache it just wrote.
+
+Tensor-parallel sharding is applied externally via pjit shardings
+(parallel/mesh.py): heads shard over the "tp" mesh axis, XLA inserts the ICI
+collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import paged_attention, write_kv
+from ..ops.rope import apply_rope, rope_frequencies
+from .config import ModelConfig
+from .moe import init_moe_params, moe_mlp
+
+Params = Dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    """Per-layer flat slot slabs: [num_layers, num_slots, kv_heads, head_dim]."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @classmethod
+    def create(
+        cls, config: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16
+    ) -> "KVCache":
+        shape = (
+            config.num_layers,
+            num_blocks * block_size,
+            config.num_kv_heads,
+            config.head_dim,
+        )
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+class ModelBatch(NamedTuple):
+    """One device step's worth of work (static shapes per bucket).
+
+    Padding convention: unused query positions have slot_mapping -1 and
+    position 0; unused batch rows have context_len 0.
+    """
+
+    token_ids: jnp.ndarray  # [B, Sq] int32
+    positions: jnp.ndarray  # [B, Sq] int32
+    slot_mapping: jnp.ndarray  # [B, Sq] int32 (-1 = padding)
+    block_tables: jnp.ndarray  # [B, max_blocks] int32
+    context_lens: jnp.ndarray  # [B] int32
+    logits_idx: jnp.ndarray  # [B] int32 — query index whose logits we keep
+
+
+def _dtype(config: ModelConfig):
+    return jnp.dtype(config.dtype)
+
+
+def init_params(config: ModelConfig, key: jax.Array) -> Params:
+    """Random-init a full params pytree (jit-friendly; used for benchmarks
+    and tests; real checkpoints come through models/loader.py)."""
+    dt = _dtype(config)
+    D, H, KV, hd, F = (
+        config.hidden_size,
+        config.num_heads,
+        config.num_kv_heads,
+        config.head_dim,
+        config.intermediate_size,
+    )
+    L, V = config.num_layers, config.vocab_size
+    keys = jax.random.split(key, 12)
+
+    def norm(k, *shape):
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(dt)
+
+    layers: Dict[str, jnp.ndarray] = {
+        "attn_norm": jnp.ones((L, D), dt),
+        "wq": norm(keys[1], L, D, H * hd),
+        "wk": norm(keys[2], L, D, KV * hd),
+        "wv": norm(keys[3], L, D, KV * hd),
+        "wo": norm(keys[4], L, H * hd, D),
+        "mlp_norm": jnp.ones((L, D), dt),
+    }
+    if config.is_moe:
+        layers.update(init_moe_params(config, keys[5], dt))
+    else:
+        layers.update(
+            {
+                "w_gate": norm(keys[5], L, D, F),
+                "w_up": norm(keys[6], L, D, F),
+                "w_down": norm(keys[7], L, F, D),
+            }
+        )
+    params: Params = {
+        "embed": norm(keys[8], V, D),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), dt),
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = norm(keys[9], D, V)
+    return params
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
+
+
+def forward(
+    params: Params,
+    config: ModelConfig,
+    batch: ModelBatch,
+    kv_cache: KVCache,
+    block_size: int,
+) -> Tuple[jnp.ndarray, KVCache]:
+    """Run the decoder; returns (logits [B, vocab] f32, updated cache).
+
+    The cache arrays should be donated by the caller's jit so the scatter
+    updates happen in place in HBM.
+    """
+    B, Sq = batch.token_ids.shape
+    H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
+    inv_freq = rope_frequencies(hd, config.rope_theta, config.rope_scaling)
+
+    h = params["embed"][batch.token_ids]  # [B, Sq, D]
+
+    def layer(carry, xs):
+        h = carry
+        lp, kc, vc = xs
+        x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(B, Sq, H, hd)
+        k = (x @ lp["wk"]).reshape(B, Sq, KV, hd)
+        v = (x @ lp["wv"]).reshape(B, Sq, KV, hd)
+        q = apply_rope(q, batch.positions, inv_freq)
+        k = apply_rope(k, batch.positions, inv_freq)
+        kc, vc = write_kv(kc, vc, k, v, batch.slot_mapping)
+        attn = paged_attention(
+            q,
+            kc,
+            vc,
+            batch.block_tables,
+            batch.context_lens,
+            batch.positions,
+            block_size,
+        )
+        h = h + attn.reshape(B, Sq, H * hd) @ lp["wo"]
+        x = rms_norm(h, lp["mlp_norm"], config.rms_norm_eps)
+        if config.is_moe:
+            h = h + moe_mlp(x, lp, config)
+        else:
+            gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+            h = h + ((gate * (x @ lp["w_up"])) @ lp["w_down"])
+        return h, (kc, vc)
+
+    h, (k_new, v_new) = jax.lax.scan(
+        layer, h, (params["layers"], kv_cache.k, kv_cache.v)
+    )
+
+    h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
+    h_last = h[jnp.arange(B), batch.logits_idx]  # [B, D]
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = (h_last @ head).astype(jnp.float32)  # [B, vocab]
+    return logits, KVCache(k_new, v_new)

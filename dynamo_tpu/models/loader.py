@@ -1,0 +1,115 @@
+"""HF safetensors checkpoint → stacked params pytree.
+
+The reference's model loading happens inside vLLM/sglang; its own code only
+resolves paths + metadata (ModelDeploymentCard, lib/llm/src/model_card/
+create.rs).  Here we load weights natively: HF llama/mixtral layouts map onto
+the stacked-[L, ...] tree that models/llama.py consumes (torch [out, in]
+linears transpose to [in, out] matmul layout).
+
+Memory notes: tensors stream from safetensors one at a time; per-layer
+tensors accumulate as numpy then stack.  Sharded (multi-host) loading applies
+the param shardings at device_put time via parallel.shard_tree.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .config import ModelConfig
+
+_LAYER_MAP = {
+    "input_layernorm.weight": ("attn_norm", False),
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.v_proj.weight": ("wv", True),
+    "self_attn.o_proj.weight": ("wo", True),
+    "post_attention_layernorm.weight": ("mlp_norm", False),
+    "mlp.gate_proj.weight": ("w_gate", True),
+    "mlp.up_proj.weight": ("w_up", True),
+    "mlp.down_proj.weight": ("w_down", True),
+}
+
+
+def _iter_safetensors(path: str):
+    from safetensors import safe_open
+
+    files = sorted(
+        f for f in os.listdir(path) if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    for fname in files:
+        with safe_open(os.path.join(path, fname), framework="numpy") as f:
+            for key in f.keys():
+                yield key, f.get_tensor(key)
+
+
+def load_params(config: ModelConfig, path: str, dtype: Any = None) -> Dict[str, Any]:
+    """Load a HF llama-family checkpoint directory into the params tree."""
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype or config.dtype)
+    L = config.num_layers
+    per_layer: Dict[str, List[Any]] = {}
+    params: Dict[str, Any] = {"layers": {}}
+
+    def put_layer(name: str, idx: int, value: np.ndarray) -> None:
+        slot = per_layer.setdefault(name, [None] * L)
+        slot[idx] = value
+
+    for key, tensor in _iter_safetensors(path):
+        if key == "model.embed_tokens.weight":
+            params["embed"] = jnp.asarray(tensor, dt)
+        elif key == "model.norm.weight":
+            params["final_norm"] = jnp.asarray(tensor, dt)
+        elif key == "lm_head.weight":
+            params["lm_head"] = jnp.asarray(tensor.T, dt)
+        elif key.startswith("model.layers."):
+            rest = key[len("model.layers.") :]
+            idx_str, sub = rest.split(".", 1)
+            mapped = _LAYER_MAP.get(sub)
+            if mapped is None:
+                continue  # rotary inv_freq buffers etc.
+            name, transpose = mapped
+            put_layer(name, int(idx_str), tensor.T if transpose else tensor)
+
+    for name, tensors in per_layer.items():
+        missing = [i for i, t in enumerate(tensors) if t is None]
+        if missing:
+            raise ValueError(f"checkpoint missing {name} for layers {missing}")
+        params["layers"][name] = jnp.asarray(np.stack(tensors), dt)
+
+    if "embed" not in params:
+        raise ValueError("checkpoint has no model.embed_tokens.weight")
+    if config.tie_word_embeddings:
+        params.pop("lm_head", None)
+    return params
+
+
+def save_params_hf(params: Dict[str, Any], path: str) -> None:
+    """Write params back out in HF naming (testing/interchange helper)."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    # NB: safetensors silently mis-serialises non-contiguous arrays — every
+    # tensor (especially transposes) must be made contiguous first.
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.ascontiguousarray(params["embed"]),
+        "model.norm.weight": np.ascontiguousarray(params["final_norm"]),
+    }
+    if "lm_head" in params:
+        out["lm_head.weight"] = np.ascontiguousarray(np.asarray(params["lm_head"]).T)
+    inv = {v[0]: (k, v[1]) for k, v in _LAYER_MAP.items()}
+    for name, stacked in params["layers"].items():
+        if name not in inv:
+            continue
+        hf_sub, transpose = inv[name]
+        arr = np.asarray(stacked)
+        for i in range(arr.shape[0]):
+            t = arr[i].T if transpose else arr[i]
+            out[f"model.layers.{i}.{hf_sub}"] = np.ascontiguousarray(t)
+    save_file(out, os.path.join(path, "model.safetensors"))

@@ -1,0 +1,11 @@
+"""TPU compute ops: paged-KV attention, RoPE, sampling, block copies.
+
+Reference counterpart: the only kernel the reference owns is
+lib/llm/src/kernels/block_copy.cu (KV offload copies); attention kernels live
+inside vLLM.  Here the whole compute path is native: XLA-fused reference
+implementations first, Pallas kernels for the hot paths.
+"""
+
+from .attention import paged_attention, write_kv  # noqa: F401
+from .rope import apply_rope, rope_frequencies  # noqa: F401
+from .sampling import sample_tokens  # noqa: F401

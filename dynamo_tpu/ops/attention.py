@@ -1,0 +1,101 @@
+"""Paged-KV attention: the engine's core op.
+
+The KV cache for each layer is a flat slab of token slots
+``[num_slots, kv_heads, head_dim]`` (num_slots = num_blocks * block_size) —
+the TPU translation of the reference's slab-per-layer block storage
+(lib/llm/src/kv/layer.rs:100-772).  Sequences own *blocks* of ``block_size``
+consecutive slots; a block table maps each sequence's logical block index to
+its physical block id.  Because attention gathers whole blocks, any physical
+block order works — allocation never moves data.
+
+``paged_attention`` here is the XLA reference implementation: gather the
+sequence's slots, mask, flash-style softmax in f32.  It is used for both
+prefill (Sq = padded prompt bucket) and decode (Sq = 1), which keeps a single
+code path and a single set of compiled shapes per bucket.  A Pallas kernel
+with block-wise streaming replaces the gather for large contexts (ops/pallas_attention.py).
+
+Static shapes everywhere: padded queries use slot -1 (dropped scatter), padded
+context is masked by ``context_lens``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def write_kv(
+    k_cache: jnp.ndarray,  # [num_slots, kv_heads, head_dim]
+    v_cache: jnp.ndarray,
+    k_new: jnp.ndarray,  # [B, Sq, kv_heads, head_dim]
+    v_new: jnp.ndarray,
+    slot_mapping: jnp.ndarray,  # [B, Sq] int32; -1 = padding (write dropped)
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter new K/V rows into their cache slots (out-of-range = dropped)."""
+    flat_slots = slot_mapping.reshape(-1)
+    # Negative indices would wrap; remap them past the end so mode="drop"
+    # discards padding writes instead of clobbering the last slots.
+    flat_slots = jnp.where(flat_slots < 0, k_cache.shape[0], flat_slots)
+    kv_heads, head_dim = k_cache.shape[-2:]
+    k_flat = k_new.reshape(-1, kv_heads, head_dim).astype(k_cache.dtype)
+    v_flat = v_new.reshape(-1, kv_heads, head_dim).astype(v_cache.dtype)
+    k_cache = k_cache.at[flat_slots].set(k_flat, mode="drop")
+    v_cache = v_cache.at[flat_slots].set(v_flat, mode="drop")
+    return k_cache, v_cache
+
+
+def gather_context_slots(
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32 physical block ids
+    block_size: int,
+) -> jnp.ndarray:
+    """[B, max_blocks*block_size] physical slot index of each context position."""
+    max_blocks = block_tables.shape[-1]
+    ctx = jnp.arange(max_blocks * block_size, dtype=jnp.int32)
+    return block_tables[:, ctx // block_size] * block_size + ctx % block_size
+
+
+def paged_attention(
+    q: jnp.ndarray,  # [B, Sq, heads, head_dim]
+    k_cache: jnp.ndarray,  # [num_slots, kv_heads, head_dim]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks]
+    context_lens: jnp.ndarray,  # [B] total valid context tokens (incl. new)
+    positions: jnp.ndarray,  # [B, Sq] global position of each query token
+    block_size: int,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Causal attention of queries against their sequence's paged context.
+
+    Context position j (< context_lens[b]) is visible to query token i iff
+    j <= positions[b, i].  New tokens' K/V must already be in the cache
+    (write_kv runs first), so prefill attends to reused prefix + itself with
+    the same gather.
+    """
+    B, Sq, H, D = q.shape
+    KV = k_cache.shape[-2]
+    groups = H // KV
+    if scale is None:
+        scale = D**-0.5
+
+    slots = gather_context_slots(block_tables, block_size)  # [B, L]
+    L = slots.shape[-1]
+    k = k_cache[slots]  # [B, L, KV, D]
+    v = v_cache[slots]
+
+    qf = q.astype(jnp.float32).reshape(B, Sq, KV, groups, D) * scale
+    kf = k.astype(jnp.float32)
+    logits = jnp.einsum("bqkgd,blkd->bkgql", qf, kf)  # [B, KV, G, Sq, L]
+
+    ctx = jnp.arange(L, dtype=jnp.int32)
+    valid = ctx[None, :] < context_lens[:, None]  # [B, L]
+    causal = ctx[None, None, :] <= positions[:, :, None]  # [B, Sq, L]
+    mask = (valid[:, None, :] & causal)[:, None, None]  # [B, 1, 1, Sq, L]
+    logits = jnp.where(mask, logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgql,blkd->bqkgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, Sq, H, D).astype(q.dtype)

@@ -1,0 +1,55 @@
+"""Rotary position embeddings with Llama-3 frequency scaling.
+
+Computed on the fly from integer positions (no host-precomputed cos/sin
+tables): a gather from a [max_pos, hd] table would be HBM-bound, while
+computing cos/sin in-register is VPU work that XLA fuses into the attention
+prologue — the TPU-friendly trade.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float,
+    scaling: Optional[Dict[str, Any]] = None,
+) -> jnp.ndarray:
+    """Inverse frequencies [head_dim//2], with optional llama3-style scaling."""
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    inv_freq = 1.0 / (theta**exponents)
+    if scaling and scaling.get("rope_type", scaling.get("type")) == "llama3":
+        factor = scaling["factor"]
+        low = scaling.get("low_freq_factor", 1.0)
+        high = scaling.get("high_freq_factor", 4.0)
+        orig = scaling.get("original_max_position_embeddings", 8192)
+        # Long wavelengths (low freqs) scaled down by `factor`; short kept;
+        # the band between orig/low and orig/high blends linearly.
+        wavelen = 2.0 * math.pi / inv_freq
+        smooth = jnp.clip((orig / wavelen - low) / (high - low), 0.0, 1.0)
+        blended = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+        inv_freq = jnp.where(
+            wavelen > orig / low,
+            inv_freq / factor,
+            jnp.where(wavelen < orig / high, inv_freq, blended),
+        )
+    return inv_freq
+
+
+def apply_rope(
+    x: jnp.ndarray,  # [..., seq, heads, head_dim]
+    positions: jnp.ndarray,  # [..., seq] int32
+    inv_freq: jnp.ndarray,  # [head_dim//2]
+) -> jnp.ndarray:
+    """Rotate pairs (x[2i], x[2i+1]) — interleaved convention folded to
+    half-split (HF llama convention: first/second half pairing)."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., seq, hd/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., seq, 1, hd/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,48 @@
+"""Batched on-device token sampling: greedy / temperature / top-k / top-p.
+
+All requests in a decode batch sample in one fused op with per-request
+parameters as arrays — no host round-trip per request.  temperature == 0
+means greedy regardless of the other knobs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V] f32
+    rng: jax.Array,
+    temperature: jnp.ndarray,  # [B] f32; 0 → greedy
+    top_k: jnp.ndarray,  # [B] int32; 0 → disabled
+    top_p: jnp.ndarray,  # [B] f32; 1.0 → disabled
+) -> jnp.ndarray:
+    """Returns sampled token ids [B] int32."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # top-k: mask everything below the k-th largest logit.
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V]
+    k = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)  # [B, 1]
+    scaled = jnp.where(scaled >= kth, scaled, NEG_INF)
+
+    # top-p: keep the smallest prefix of the sorted distribution with
+    # cumulative probability >= top_p (the kept set always includes argmax).
+    probs_sorted = jax.nn.softmax(jnp.sort(scaled, axis=-1)[:, ::-1], axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    cutoff_count = jnp.sum(cum - probs_sorted < top_p[:, None], axis=-1)  # [B]
+    cutoff_count = jnp.clip(cutoff_count, 1, V)
+    thresh = jnp.take_along_axis(
+        jnp.sort(scaled, axis=-1)[:, ::-1], (cutoff_count - 1)[:, None], axis=-1
+    )
+    scaled = jnp.where(scaled >= thresh, scaled, NEG_INF)
+
+    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

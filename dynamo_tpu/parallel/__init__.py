@@ -1,0 +1,17 @@
+"""Device-mesh parallelism: TP/DP/EP shardings for the native engine.
+
+The reference delegates intra-model parallelism to its engines (NCCL inside
+vLLM — SURVEY.md §2.7); here it is first-class: a `jax.sharding.Mesh` with
+named axes, PartitionSpec trees per params structure, and XLA-generated ICI
+collectives.
+"""
+
+from .mesh import (  # noqa: F401
+    MeshConfig,
+    batch_pspecs,
+    cache_pspec,
+    make_mesh,
+    param_pspecs,
+    shard_tree,
+    sharding_tree,
+)

@@ -1,0 +1,156 @@
+"""Mesh + sharding rules (the scaling-book recipe: pick a mesh, annotate
+shardings, let XLA insert collectives).
+
+Axes:
+- "dp"  — data parallel: distinct batch rows (request-level; the serving tier
+          usually does DP via multiple engine replicas instead, matching the
+          reference's replica model, but in-engine dp is supported).
+- "tp"  — tensor parallel: attention heads / FFN hidden / vocab. Collectives
+          (all-reduce after wo/w_down, all-gather for logits) ride ICI.
+- "ep"  — expert parallel for MoE: experts dimension. Folded onto "tp" when
+          not given its own axis.
+
+KV cache shards over "tp" on the kv_heads axis, so paged attention is fully
+local per chip (each chip owns its heads' cache); block tables/ids are
+replicated host metadata.
+
+Reference counterpart: `--tensor-parallel-size` and friends
+(launch/dynamo-run/src/flags.rs:63; SURVEY.md §2.7) — there they configure an
+external engine; here they parameterise the mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    tp: int = 1
+    ep: int = 1  # expert parallel; 1 = fold experts onto tp
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.tp * self.ep
+
+
+def make_mesh(
+    cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    n = cfg.num_devices
+    if devices is None:
+        devices = jax.devices()
+        if len(devices) < n:
+            # Virtual CPU mesh fallback (tests / dry-runs use
+            # --xla_force_host_platform_device_count; SURVEY.md §4).
+            try:
+                cpus = jax.devices("cpu")
+            except RuntimeError:
+                cpus = []
+            if len(cpus) >= n:
+                devices = cpus
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices for {cfg}, have {len(devices)}")
+    grid = np.array(devices[:n]).reshape(cfg.dp, cfg.ep, cfg.tp)
+    return Mesh(grid, ("dp", "ep", "tp"))
+
+
+def param_pspecs(config: ModelConfig) -> Any:
+    """PartitionSpec tree matching models.llama.init_params structure.
+
+    Column-parallel (wq/wk/wv/w_gate/w_up): shard output features on tp.
+    Row-parallel (wo/w_down): shard input features on tp → XLA all-reduces
+    the partial sums.  Vocab shards on tp for embed and lm_head.  MoE experts
+    shard on ep (plus tp on the expert FFN hidden dim).
+    """
+    layers = {
+        "attn_norm": P(),
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "mlp_norm": P(),
+        # dense FFN
+        "w_gate": P(None, None, "tp"),
+        "w_up": P(None, None, "tp"),
+        "w_down": P(None, "tp", None),
+        # MoE
+        "router": P(),
+        "moe_gate": P(None, "ep", None, "tp"),
+        "moe_up": P(None, "ep", None, "tp"),
+        "moe_down": P(None, "ep", "tp", None),
+    }
+    specs = {
+        "embed": P("tp", None),
+        "layers": layers,
+        "final_norm": P(),
+        "lm_head": P(None, "tp"),
+    }
+    return specs
+
+
+def cache_pspec() -> P:
+    """KVCache slabs [L, slots, kv_heads, head_dim]: heads shard on tp."""
+    return P(None, None, "tp", None)
+
+
+def batch_pspecs() -> Any:
+    """ModelBatch arrays: batch dim shards on dp, rest replicated."""
+    from ..models.llama import ModelBatch
+
+    return ModelBatch(
+        token_ids=P("dp", None),
+        positions=P("dp", None),
+        slot_mapping=P("dp", None),
+        block_tables=P("dp", None),
+        context_lens=P("dp"),
+        logits_idx=P("dp"),
+    )
+
+
+def _trim(spec: P, ndim: int) -> P:
+    parts = list(spec) + [None] * ndim
+    return P(*parts[:ndim])
+
+
+def _spec_for_path(specs: Any, path: Sequence[Any]) -> P:
+    """Walk a spec tree along a tree_map_with_path key path; P() if absent."""
+    spec = specs
+    for key in path:
+        # DictKey.key / SequenceKey.idx / GetAttrKey.name (namedtuples)
+        k = getattr(key, "key", None)
+        if k is None:
+            k = getattr(key, "idx", None)
+        if k is None:
+            k = getattr(key, "name", None)
+        if isinstance(spec, dict):
+            spec = spec.get(k, P())
+        elif isinstance(spec, tuple) and not isinstance(spec, P):
+            spec = getattr(spec, k) if isinstance(k, str) else spec[k]
+    return spec if isinstance(spec, P) else P()
+
+
+def sharding_tree(tree: Any, specs: Any, mesh: Mesh) -> Any:
+    """NamedSharding pytree matching ``tree``'s structure (for use as jit
+    in_shardings/out_shardings), pruning spec entries the tree lacks (e.g.
+    MoE specs on a dense model, lm_head on tied embeddings)."""
+
+    def to_sharding(path, leaf):
+        spec = _spec_for_path(specs, path)
+        return NamedSharding(mesh, _trim(spec, getattr(leaf, "ndim", 0)))
+
+    return jax.tree_util.tree_map_with_path(to_sharding, tree)
+
+
+def shard_tree(tree: Any, specs: Any, mesh: Mesh) -> Any:
+    """Place a pytree's arrays onto the mesh per the spec tree."""
+    shardings = sharding_tree(tree, specs, mesh)
+    return jax.tree_util.tree_map(jax.device_put, tree, shardings)

@@ -7,8 +7,11 @@ distributed graph with no GPU — SURVEY.md §4 takeaway (a)).
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax is imported anywhere.  JAX_PLATFORMS is forced (not
+# setdefault): the environment may pin a real TPU platform (e.g. "axon"),
+# and some platform plugins register themselves even when JAX_PLATFORMS
+# excludes them — so the default device is additionally pinned to cpu below.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -19,6 +22,10 @@ import asyncio  # noqa: E402
 import inspect  # noqa: E402
 
 import pytest  # noqa: E402
+import jax  # noqa: E402
+
+if jax.default_backend() != "cpu":
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 
 def pytest_pyfunc_call(pyfuncitem):

@@ -1,0 +1,223 @@
+"""TpuEngine integration tests on CPU: generation end-to-end, determinism
+across batist compositions, prefix-cache reuse, KV events, cancellation,
+preemption, and the KV block manager's reuse pool."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, KvBlockManager
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.llm.kv_router.protocols import KvCacheRemoveData, KvCacheStoreData
+from dynamo_tpu.llm.protocols import PreprocessedRequest, SamplingOptions, StopConditions
+from dynamo_tpu.runtime.engine import Context, collect
+from dynamo_tpu.tokens import hash_token_blocks
+
+CFG = dict(
+    model="debug-tiny",
+    block_size=4,
+    num_blocks=64,
+    max_batch=4,
+    max_model_len=128,
+    prefill_chunk=32,
+    dtype="float32",
+)
+
+
+def _req(tokens, max_tokens=8, **kw):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling_options=SamplingOptions(**kw),
+    ).to_dict()
+
+
+async def _generate(engine, tokens, max_tokens=8, **kw):
+    stream = await engine.generate(Context(_req(tokens, max_tokens, **kw)))
+    out = await collect(stream)
+    toks = [t for item in out for t in item["token_ids"]]
+    assert out[-1]["finish_reason"] is not None
+    return toks, out[-1]
+
+
+def test_engine_generates_deterministically():
+    async def main():
+        engine = TpuEngine(EngineConfig(**CFG))
+        toks1, final = await _generate(engine, [1, 2, 3, 4, 5], max_tokens=6)
+        assert len(toks1) == 6
+        assert final["finish_reason"] == "length"
+        assert final["usage"]["completion_tokens"] == 6
+        # Same prompt again (now prefix-cached) → identical greedy output.
+        toks2, _ = await _generate(engine, [1, 2, 3, 4, 5], max_tokens=6)
+        assert toks1 == toks2
+        await engine.close()
+
+    asyncio.run(main())
+
+
+def test_engine_concurrent_requests_match_serial():
+    async def main():
+        engine = TpuEngine(EngineConfig(**CFG))
+        prompts = [[1, 2, 3], [9, 8, 7, 6], [5, 5, 5, 5, 5], [11, 12]]
+        serial = []
+        for p in prompts:
+            toks, _ = await _generate(engine, p, max_tokens=5)
+            serial.append(toks)
+        await engine.close()
+
+        engine2 = TpuEngine(EngineConfig(**CFG))
+        results = await asyncio.gather(
+            *[_generate(engine2, p, max_tokens=5) for p in prompts]
+        )
+        concurrent = [r[0] for r in results]
+        assert concurrent == serial
+        await engine2.close()
+
+    asyncio.run(main())
+
+
+def test_engine_prefix_cache_hit_rate():
+    async def main():
+        engine = TpuEngine(EngineConfig(**CFG))
+        prompt = list(range(1, 17))  # 4 full blocks
+        await _generate(engine, prompt, max_tokens=2)
+        assert engine.kv.hit_rate == 0.0
+        await _generate(engine, prompt + [99], max_tokens=2)
+        m = engine.metrics()
+        assert m.gpu_prefix_cache_hit_rate > 0.4  # 4 of the 2nd req's blocks hit
+        await engine.close()
+
+    asyncio.run(main())
+
+
+def test_engine_emits_kv_events():
+    async def main():
+        events = []
+        engine = TpuEngine(EngineConfig(**CFG), event_callback=events.append)
+        prompt = list(range(1, 10))  # 2 full blocks of 4 + 1 tail
+        await _generate(engine, prompt, max_tokens=3)
+        stored = [e for e in events if isinstance(e.data, KvCacheStoreData)]
+        assert len(stored) >= 2
+        # Chained hashes must match tokens-module hashing of the prompt.
+        expected = hash_token_blocks(prompt, 4)
+        got = [b.block_hash for e in stored for b in e.data.blocks]
+        assert got[:2] == [tb.sequence_hash for tb in expected[:2]]
+        # Parent chain: first block's parent is None, second's is first's hash.
+        assert stored[0].data.parent_hash is None
+        assert stored[1].data.parent_hash == expected[0].sequence_hash
+        await engine.close()
+
+    asyncio.run(main())
+
+
+def test_engine_eviction_emits_removed():
+    async def main():
+        events = []
+        cfg = dict(CFG)
+        cfg["num_blocks"] = 8  # tiny pool to force eviction
+        engine = TpuEngine(EngineConfig(**cfg), event_callback=events.append)
+        for base in range(0, 60, 20):
+            await _generate(engine, [base + i for i in range(12)], max_tokens=2)
+        removed = [e for e in events if isinstance(e.data, KvCacheRemoveData)]
+        assert removed, "expected eviction events from the tiny pool"
+        await engine.close()
+
+    asyncio.run(main())
+
+
+def test_engine_cancellation():
+    async def main():
+        engine = TpuEngine(EngineConfig(**CFG))
+        ctx = Context(_req([1, 2, 3], max_tokens=10_000))
+        stream = await engine.generate(ctx)
+        got = 0
+        async for _item in stream:
+            got += 1
+            if got == 3:
+                ctx.stop_generating()
+        assert 3 <= got < 100
+        # Engine must have released the sequence's blocks.
+        for _ in range(20):
+            if engine.scheduler.num_running == 0:
+                break
+            await asyncio.sleep(0.05)
+        assert engine.scheduler.num_running == 0
+        assert engine.kv.active_blocks == 0
+        await engine.close()
+
+    asyncio.run(main())
+
+
+def test_engine_rejects_oversize_prompt():
+    async def main():
+        engine = TpuEngine(EngineConfig(**CFG))
+        with pytest.raises(ValueError):
+            await engine.generate(Context(_req(list(range(300)))))
+        await engine.close()
+
+    asyncio.run(main())
+
+
+def test_engine_stop_token():
+    async def main():
+        engine = TpuEngine(EngineConfig(**CFG))
+        # Find what the model generates, then stop on its 3rd token.
+        toks, _ = await _generate(engine, [1, 2, 3], max_tokens=6)
+        stop_tok = toks[2]
+        pre = PreprocessedRequest(
+            token_ids=[1, 2, 3],
+            stop_conditions=StopConditions(
+                max_tokens=6, ignore_eos=True, stop_token_ids=[stop_tok]
+            ),
+        )
+        stream = await engine.generate(Context(pre.to_dict()))
+        out = await collect(stream)
+        got = [t for item in out for t in item["token_ids"]]
+        # Generation halts at the stop token's FIRST occurrence (the tiny
+        # greedy model may repeat tokens), and the stop token is not emitted.
+        assert got == toks[: toks.index(stop_tok)]
+        assert out[-1]["finish_reason"] == "stop"
+        await engine.close()
+
+    asyncio.run(main())
+
+
+def test_kv_manager_reuse_and_eviction_order():
+    events = []
+    kv = KvBlockManager(4, 2, event_callback=events.append)
+    blocks = hash_token_blocks([1, 2, 3, 4], 2)
+    alloc = kv.allocate_sequence(blocks, 2)
+    assert alloc is not None
+    ids, cached = alloc
+    assert cached == 0
+    for bid, tb in zip(ids, blocks):
+        kv.seal_block(bid, tb)
+    kv.free_sequence(ids)
+    assert kv.free_blocks == 4
+
+    # Same prompt: full prefix hit, revived from the reuse pool.
+    alloc2 = kv.allocate_sequence(blocks, 2)
+    ids2, cached2 = alloc2
+    assert ids2 == ids and cached2 == 4
+    kv.free_sequence(ids2)
+
+    # Exhaust the pool → reusable blocks evicted → Removed events.
+    big = hash_token_blocks(list(range(10, 18)), 2)
+    alloc3 = kv.allocate_sequence(big, 4)
+    assert alloc3 is not None
+    removed = [e for e in events if isinstance(e.data, KvCacheRemoveData)]
+    assert removed
+
+
+def test_kv_manager_shared_refcount():
+    kv = KvBlockManager(8, 2)
+    blocks = hash_token_blocks([1, 2, 3, 4], 2)
+    ids1, _ = kv.allocate_sequence(blocks, 2)
+    for bid, tb in zip(ids1, blocks):
+        kv.seal_block(bid, tb)
+    ids2, cached = kv.allocate_sequence(blocks, 3)
+    assert ids2[:2] == ids1 and cached == 4
+    kv.free_sequence(ids1)
+    assert kv.active_blocks == 3  # still referenced by seq 2
+    kv.free_sequence(ids2)
+    assert kv.active_blocks == 0

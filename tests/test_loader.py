@@ -1,0 +1,26 @@
+"""Checkpoint loader roundtrip: params → HF safetensors → params."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models import get_config
+from dynamo_tpu.models.llama import init_params
+from dynamo_tpu.models.loader import load_params, save_params_hf
+
+
+def test_save_load_roundtrip(tmp_path):
+    cfg = get_config("debug-tiny").with_overrides(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    save_params_hf(params, str(tmp_path))
+    loaded = load_params(cfg, str(tmp_path), dtype=jnp.float32)
+
+    flat_a = jax.tree_util.tree_leaves_with_path(params)
+    flat_b = dict(
+        (jax.tree_util.keystr(p), v)
+        for p, v in jax.tree_util.tree_leaves_with_path(loaded)
+    )
+    assert len(flat_a) == len(flat_b)
+    for path, val in flat_a:
+        key = jax.tree_util.keystr(path)
+        np.testing.assert_array_equal(np.asarray(val), np.asarray(flat_b[key]), err_msg=key)

@@ -1,0 +1,176 @@
+"""Model/ops layer tests: paged attention vs dense reference, prefill/decode
+consistency, MoE, TP-sharded forward equivalence on the virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models import get_config
+from dynamo_tpu.models.llama import KVCache, ModelBatch, forward, init_params
+from dynamo_tpu.ops.attention import paged_attention, write_kv
+from dynamo_tpu.ops.rope import rope_frequencies
+from dynamo_tpu.ops.sampling import sample_tokens
+from dynamo_tpu.parallel import (
+    MeshConfig,
+    cache_pspec,
+    make_mesh,
+    param_pspecs,
+    shard_tree,
+)
+
+BLOCK = 4
+
+
+def dense_attention(q, k, v, positions, context_len):
+    """Straightforward causal softmax attention (float32, GQA)."""
+    B, Sq, H, D = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    qf = q.astype(jnp.float32).reshape(B, Sq, KV, G, D) * (D**-0.5)
+    logits = jnp.einsum("bqkgd,blkd->bkgql", qf, k.astype(jnp.float32))
+    L = k.shape[1]
+    ctx = jnp.arange(L)
+    mask = (ctx[None, None, :] <= positions[:, :, None]) & (
+        ctx[None, None, :] < context_len[:, None, None]
+    )
+    logits = jnp.where(mask[:, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgql,blkd->bqkgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, Sq, H, D)
+
+
+def test_paged_attention_matches_dense():
+    key = jax.random.PRNGKey(0)
+    B, S, H, KV, D = 2, 10, 4, 2, 16
+    nblocks = 8
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, KV, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, KV, D), jnp.float32)
+
+    # Scatter k/v into a paged cache with arbitrary (non-contiguous) blocks.
+    kc = jnp.zeros((nblocks * BLOCK, KV, D), jnp.float32)
+    vc = jnp.zeros_like(kc)
+    tables = jnp.array([[3, 0, 6, -1], [5, 1, 2, -1]], jnp.int32)
+    positions = jnp.tile(jnp.arange(S), (B, 1))
+    slot_map = tables[:, positions // BLOCK] * BLOCK + positions % BLOCK
+    slot_map = jnp.take_along_axis(
+        tables, positions // BLOCK, axis=1
+    ) * BLOCK + positions % BLOCK
+    kc, vc = write_kv(kc, vc, k, v, slot_map)
+
+    ctx_len = jnp.array([S, S], jnp.int32)
+    out = paged_attention(q, kc, vc, tables, ctx_len, positions, BLOCK)
+    ref = dense_attention(q, k, v, positions, ctx_len)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_write_kv_drops_padding():
+    kc = jnp.zeros((8, 1, 4), jnp.float32)
+    vc = jnp.zeros_like(kc)
+    k_new = jnp.ones((1, 2, 1, 4))
+    slot = jnp.array([[1, -1]], jnp.int32)  # second token is padding
+    kc2, _ = write_kv(kc, vc, k_new, k_new, slot)
+    assert float(kc2[1].sum()) == 4.0
+    assert float(kc2.sum()) == 4.0  # nothing else written
+
+
+def _make_batch(tokens_np, tables, start_pos=None):
+    B, Sq = tokens_np.shape
+    positions = jnp.tile(jnp.arange(Sq), (B, 1))
+    if start_pos is not None:
+        positions = positions + jnp.asarray(start_pos)[:, None]
+    slot_map = (
+        jnp.take_along_axis(tables, positions // BLOCK, axis=1) * BLOCK
+        + positions % BLOCK
+    )
+    return ModelBatch(
+        token_ids=jnp.asarray(tokens_np, jnp.int32),
+        positions=positions.astype(jnp.int32),
+        slot_mapping=slot_map.astype(jnp.int32),
+        block_tables=tables,
+        context_lens=(positions[:, -1] + 1).astype(jnp.int32),
+        logits_idx=jnp.full((B,), Sq - 1, jnp.int32),
+    )
+
+
+@pytest.mark.parametrize("name", ["debug-tiny", "debug-tiny-moe"])
+def test_prefill_decode_consistency(name):
+    """Prefilling N tokens at once must equal feeding them one by one."""
+    cfg = get_config(name).with_overrides(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    rng = np.random.default_rng(2)
+    B, S = 2, 7
+    tokens = rng.integers(0, cfg.vocab_size, (B, S))
+    tables = jnp.array([[0, 1, 2], [3, 4, 5]], jnp.int32)
+
+    cache = KVCache.create(cfg, num_blocks=8, block_size=BLOCK, dtype=jnp.float32)
+    logits_pre, _ = forward(params, cfg, _make_batch(tokens, tables), cache, BLOCK)
+
+    cache = KVCache.create(cfg, num_blocks=8, block_size=BLOCK, dtype=jnp.float32)
+    for i in range(S):
+        batch = _make_batch(tokens[:, i : i + 1], tables, start_pos=[i, i])
+        logits_dec, cache = forward(params, cfg, batch, cache, BLOCK)
+
+    np.testing.assert_allclose(
+        np.asarray(logits_pre), np.asarray(logits_dec), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_tp_sharded_forward_matches_single_device():
+    cfg = get_config("debug-tiny").with_overrides(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    tokens = np.arange(10).reshape(2, 5) % cfg.vocab_size
+    tables = jnp.array([[0, 1], [2, 3]], jnp.int32)
+    cache = KVCache.create(cfg, num_blocks=4, block_size=BLOCK, dtype=jnp.float32)
+    batch = _make_batch(tokens, tables)
+
+    logits_local, _ = forward(params, cfg, batch, cache, BLOCK)
+
+    mesh = make_mesh(MeshConfig(tp=2))
+    params_s = shard_tree(params, param_pspecs(cfg), mesh)
+    cache_s = shard_tree(cache, KVCache(cache_pspec(), cache_pspec()), mesh)
+    fwd = jax.jit(forward, static_argnames=("config", "block_size"))
+    logits_tp, _ = fwd(params_s, cfg, batch, cache_s, BLOCK)
+
+    np.testing.assert_allclose(
+        np.asarray(logits_local), np.asarray(logits_tp), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_rope_llama3_scaling_changes_low_freqs():
+    plain = rope_frequencies(64, 500000.0)
+    scaled = rope_frequencies(
+        64,
+        500000.0,
+        {
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 8192,
+        },
+    )
+    # High-frequency (early) components unchanged; low-frequency scaled down.
+    np.testing.assert_allclose(np.asarray(plain[0]), np.asarray(scaled[0]))
+    assert float(scaled[-1]) < float(plain[-1])
+
+
+def test_sampling_greedy_and_topk():
+    logits = jnp.array([[0.0, 5.0, 1.0], [3.0, 0.0, 2.9]], jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    zeros = jnp.zeros(2)
+    # temperature 0 → argmax
+    out = sample_tokens(logits, rng, zeros, jnp.zeros(2, jnp.int32), jnp.ones(2))
+    assert out.tolist() == [1, 0]
+    # top_k=1 with temperature → still argmax
+    out = sample_tokens(
+        logits, rng, jnp.ones(2), jnp.ones(2, jnp.int32), jnp.ones(2)
+    )
+    assert out.tolist() == [1, 0]
+    # top_p tiny → argmax
+    out = sample_tokens(
+        logits, rng, jnp.ones(2), jnp.zeros(2, jnp.int32), jnp.full(2, 0.01)
+    )
+    assert out.tolist() == [1, 0]

@@ -1,0 +1,48 @@
+"""Token block hashing tests (reference test model: lib/tokens unit tests)."""
+
+from dynamo_tpu.tokens import (
+    TokenBlockSequence,
+    chain_hash,
+    compute_block_hash,
+    hash_token_blocks,
+)
+
+
+def test_block_hash_deterministic_and_order_sensitive():
+    assert compute_block_hash([1, 2, 3]) == compute_block_hash([1, 2, 3])
+    assert compute_block_hash([1, 2, 3]) != compute_block_hash([3, 2, 1])
+    assert compute_block_hash([1]) != compute_block_hash([1, 0])
+
+
+def test_chained_hashes_depend_on_prefix():
+    a = hash_token_blocks([1, 2, 3, 4], 2)
+    b = hash_token_blocks([9, 9, 3, 4], 2)
+    # Same local content in block 1, different prefix → different seq hash.
+    assert a[1].block_hash == b[1].block_hash
+    assert a[1].sequence_hash != b[1].sequence_hash
+    assert a[1].parent_hash == a[0].sequence_hash
+    assert a[0].parent_hash is None
+    assert a[1].sequence_hash == chain_hash(a[0].sequence_hash, a[1].block_hash)
+
+
+def test_incremental_matches_oneshot():
+    seq = TokenBlockSequence(block_size=3)
+    completed = []
+    for t in range(10):
+        blk = seq.append(t)
+        if blk:
+            completed.append(blk)
+    oneshot = hash_token_blocks(list(range(10)), 3)
+    assert [b.sequence_hash for b in completed] == [b.sequence_hash for b in oneshot]
+    assert seq.tail_tokens == [9]
+    assert seq.total_tokens == 10
+
+
+def test_salt_separates_tenants():
+    a = TokenBlockSequence([1, 2, 3, 4], 2, salt="tenant-a")
+    b = TokenBlockSequence([1, 2, 3, 4], 2, salt="tenant-b")
+    plain = TokenBlockSequence([1, 2, 3, 4], 2)
+    assert a.blocks[0].sequence_hash != b.blocks[0].sequence_hash
+    assert a.blocks[0].sequence_hash != plain.blocks[0].sequence_hash
+    # Local hashes are salt-free (content identity).
+    assert a.blocks[0].block_hash == b.blocks[0].block_hash
