@@ -57,9 +57,12 @@ async def _run_hub(args) -> None:
 
 
 async def _run_http_frontend(args) -> None:
+    from .runtime.client import RouterMode
+
     runtime = await DistributedRuntime.connect(args.hub)
     service = HttpService(host=args.host, port=args.port)
-    watcher = await ModelWatcher(runtime, service.models).start()
+    mode = RouterMode(getattr(args, "router", "round_robin"))
+    watcher = await ModelWatcher(runtime, service.models, router_mode=mode).start()
     await service.start()
     print(f"OpenAI frontend on http://{service.host}:{service.port}", flush=True)
     try:
@@ -93,14 +96,92 @@ async def _run(args) -> None:
         runtime = await DistributedRuntime.connect(args.hub)
         ns, comp, ep = parse_endpoint_path(inp)
         endpoint = runtime.namespace(ns).component(comp).endpoint(ep)
-        await endpoint.serve_endpoint(engine)
+
+        role = getattr(args, "disagg", None)
+        if role and not hasattr(engine, "inject_blocks"):
+            raise SystemExit(
+                f"--disagg {role} requires the native TPU engine (out=tpu), "
+                f"not out={args.out}"
+            )
+        served_engine = engine
+        cleanups = []
+
+        if role == "prefill":
+            # Dedicated prefill worker: drains the queue; serves no endpoint.
+            from .llm.disagg import PrefillQueue, PrefillWorkerLoop
+
+            ploop = await PrefillWorkerLoop(
+                engine, PrefillQueue(runtime.hub, args.model)
+            ).start()
+            cleanups.append(ploop.stop)
+            print(f"prefill worker draining queue for {args.model!r}", flush=True)
+            try:
+                await _wait_forever()
+            finally:
+                for fn in cleanups:
+                    await fn()
+                await runtime.close()
+            return
+
+        if role == "decode":
+            from .llm.disagg import (
+                KV_IMPORT_ENDPOINT,
+                DisaggConfig,
+                DisaggDecodeWorker,
+                DisaggregatedRouter,
+                PrefillQueue,
+            )
+
+            server = await runtime.service_server()
+            import_ep = endpoint.component.endpoint(KV_IMPORT_ENDPOINT)
+            disagg_router = await DisaggregatedRouter(
+                args.model,
+                DisaggConfig(
+                    max_local_prefill_length=args.max_local_prefill,
+                ),
+            ).watch_config(runtime.hub)
+            cleanups.append(disagg_router.stop)
+            worker = DisaggDecodeWorker(
+                engine,
+                PrefillQueue(runtime.hub, args.model),
+                disagg_router,
+                import_address=server.address,
+                import_path=import_ep.path,
+            )
+            await import_ep.serve_endpoint(worker.kv_import_handler)
+            served_engine = worker
+
+        await endpoint.serve_endpoint(served_engine)
+        kv_block_size = 16
+        if hasattr(engine, "set_event_callback"):  # native TPU engine
+            from .llm.kv_router.publisher import KvEventPublisher, KvMetricsPublisher
+
+            kv_block_size = engine.cfg.block_size
+            engine.set_event_callback(
+                KvEventPublisher(endpoint.component, runtime.worker_id)
+            )
+            metrics_pub = await KvMetricsPublisher(
+                endpoint.component, runtime.worker_id, engine.metrics
+            ).start()
+            cleanups.append(metrics_pub.stop)
         await register_model(
-            runtime, args.model, endpoint.path, tokenizer=_tokenizer_spec(args)
+            runtime,
+            args.model,
+            endpoint.path,
+            tokenizer=_tokenizer_spec(args),
+            kv_block_size=kv_block_size,
         )
-        print(f"worker serving {inp} (model {args.model!r})", flush=True)
+        print(
+            f"worker serving {inp} (model {args.model!r}"
+            + (f", disagg={role}" if role else "")
+            + ")",
+            flush=True,
+        )
         try:
             await _wait_forever()
         finally:
+            for fn in cleanups:
+                await fn()
             await runtime.close()
     else:
         raise SystemExit(f"unknown in= input: {inp!r}")
@@ -130,6 +211,12 @@ def main(argv: Optional[list] = None) -> None:
     p_http.add_argument("--hub", required=True)
     p_http.add_argument("--host", default="0.0.0.0")
     p_http.add_argument("--port", type=int, default=8000)
+    p_http.add_argument(
+        "--router",
+        default="round_robin",
+        choices=["random", "round_robin", "kv"],
+        help="worker selection policy (kv = cache-aware)",
+    )
 
     p_run = sub.add_parser("run", help="in=… out=… launcher")
     p_run.add_argument("inout", nargs=2, metavar="in=/out=")
@@ -150,6 +237,19 @@ def main(argv: Optional[list] = None) -> None:
     p_run.add_argument("--max-batch", type=int, default=8, dest="max_batch")
     p_run.add_argument("--max-model-len", type=int, default=1024, dest="max_model_len")
     p_run.add_argument("--prefill-chunk", type=int, default=512, dest="prefill_chunk")
+    p_run.add_argument(
+        "--disagg",
+        default=None,
+        choices=["decode", "prefill"],
+        help="disaggregated role for this worker (requires --hub)",
+    )
+    p_run.add_argument(
+        "--max-local-prefill",
+        type=int,
+        default=512,
+        dest="max_local_prefill",
+        help="prefills longer than this (minus prefix hit) go remote",
+    )
 
     args = parser.parse_args(argv)
     if args.cmd == "run":

@@ -76,6 +76,9 @@ class TpuEngine(AsyncEngine):
         self._wake = asyncio.Event()
         self._closed = False
         self._loop_task: Optional[asyncio.Task] = None
+        # Serialises device-state access: step functions donate the cache
+        # buffers, so export/import must never observe a mid-step cache.
+        self._device_lock = asyncio.Lock()
         self._rng = jax.random.PRNGKey(cfg.seed)
         self._steps = 0
 
@@ -152,6 +155,13 @@ class TpuEngine(AsyncEngine):
 
         return ResponseStream(gen(), request.ctx)
 
+    def set_event_callback(
+        self, callback: Optional[Callable[[KvCacheEvent], None]]
+    ) -> None:
+        """Attach/replace the KV event sink (e.g. a KvEventPublisher) after
+        construction — the CLI builds the engine before the runtime exists."""
+        self.kv._event_callback = callback
+
     def metrics(self) -> ForwardPassMetrics:
         return ForwardPassMetrics(
             request_active_slots=self.scheduler.num_running,
@@ -171,6 +181,104 @@ class TpuEngine(AsyncEngine):
             self._loop_task = None
         # Fail whatever is still in flight so no generate() stream hangs.
         self._fail_all()
+
+    # --------------------------------------------------- KV export / import
+    #
+    # TPU counterpart of the reference's block_copy.cu + NIXL transfer
+    # (lib/llm/src/kernels/block_copy.cu, kv/layer.rs:100-772): whole blocks
+    # move between workers as host-staged arrays (msgpack binary over the
+    # service plane; ICI device-to-device when workers share a pod slice).
+    # Imported blocks are sealed under their chained hashes, so the decode
+    # scheduler sees remote-prefilled prompts as ordinary prefix-cache hits.
+
+    def _kv_slots(self, block_ids: List[int]) -> np.ndarray:
+        bs = self.cfg.block_size
+        ids = np.asarray(block_ids, np.int32)
+        return (ids[:, None] * bs + np.arange(bs, dtype=np.int32)[None, :]).reshape(-1)
+
+    async def export_prompt_blocks(
+        self, token_ids: List[int]
+    ) -> Optional[Dict[str, Any]]:
+        """Gather the cached KV for ``token_ids``'s complete blocks to host.
+
+        Returns None unless every complete block of the prompt is resident
+        (blocks are looked up by chained hash — reuse-pool contents count).
+        """
+        from ..tokens import hash_token_blocks
+
+        blocks = hash_token_blocks(token_ids, self.cfg.block_size)
+        if not blocks:
+            return None
+        ids: List[int] = []
+        for tb in blocks:
+            bid = self.kv._by_hash.get(tb.sequence_hash)
+            if bid is None:
+                return None
+            ids.append(bid)
+        slots = self._kv_slots(ids)
+        async with self._device_lock:
+            k = np.asarray(self.cache.k[:, slots])  # [L, n*bs, KV, hd]
+            v = np.asarray(self.cache.v[:, slots])
+        return {
+            "n_blocks": len(ids),
+            "block_size": self.cfg.block_size,
+            "dtype": str(k.dtype),
+            "shape": list(k.shape),
+            "k": k.tobytes(),
+            "v": v.tobytes(),
+        }
+
+    async def inject_blocks(self, token_ids: List[int], payload: Dict[str, Any]) -> int:
+        """Write transferred KV into this engine's cache as sealed blocks.
+
+        Returns the number of tokens now covered by the local prefix cache.
+        The blocks are immediately released to the reuse pool (contents
+        intact), so the very next generate() for these tokens admits with a
+        full prefix hit — no special remote-prefill state in the scheduler.
+        """
+        from ..tokens import hash_token_blocks
+
+        blocks = hash_token_blocks(token_ids, self.cfg.block_size)
+        n = min(int(payload["n_blocks"]), len(blocks))
+        if n == 0:
+            return 0
+        blocks = blocks[:n]
+        alloc = self.kv.allocate_sequence(blocks, n)
+        if alloc is None:
+            return 0  # no capacity; caller falls back to local prefill
+        if int(payload.get("block_size", self.cfg.block_size)) != self.cfg.block_size:
+            # Mismatched layouts would seal misaligned KV under valid hashes
+            # — refuse and let the caller prefill locally.
+            logger.warning(
+                "rejecting KV import: block_size %s != local %s",
+                payload.get("block_size"),
+                self.cfg.block_size,
+            )
+            self.kv.free_sequence(alloc[0])
+            return 0
+        ids, cached = alloc
+        shape = tuple(payload["shape"])
+        name = payload["dtype"]
+        dt = jnp.bfloat16 if name == "bfloat16" else np.dtype(name)
+        k = np.frombuffer(payload["k"], dtype=dt).reshape(shape)
+        v = np.frombuffer(payload["v"], dtype=dt).reshape(shape)
+        take = n * self.cfg.block_size
+        slots = jnp.asarray(self._kv_slots(ids))
+        async with self._device_lock:
+            ck = self.cache.k.at[:, slots].set(jnp.asarray(k[:, :take]))
+            cv = self.cache.v.at[:, slots].set(jnp.asarray(v[:, :take]))
+            self.cache = KVCache(ck, cv)
+        for bid, tb in zip(ids, blocks):
+            self.kv.seal_block(bid, tb)
+        self.kv.free_sequence(ids)
+        return n * self.cfg.block_size
+
+    def estimate_prefix_hit(self, token_ids: List[int]) -> int:
+        """Tokens of ``token_ids`` already resident locally (router input)."""
+        from ..tokens import hash_token_blocks
+
+        blocks = hash_token_blocks(token_ids, self.cfg.block_size)
+        return len(self.kv.match_prefix(blocks)) * self.cfg.block_size
 
     # -------------------------------------------------------------- the loop
     def _ensure_loop(self) -> None:
@@ -262,13 +370,16 @@ class TpuEngine(AsyncEngine):
             topp[i] = seq.sampling_top_p
         tables_rows += [[] for _ in range(B - len(work.items))]
 
+        # Plain numpy: host→device transfer happens inside the jitted call on
+        # the dispatch thread, not on the event loop (which must stay live
+        # for lease keepalives during long compiles).
         batch = ModelBatch(
-            token_ids=jnp.asarray(tokens),
-            positions=jnp.asarray(positions),
-            slot_mapping=jnp.asarray(slots),
-            block_tables=jnp.asarray(self._pad_tables(tables_rows)),
-            context_lens=jnp.asarray(ctx_lens),
-            logits_idx=jnp.asarray(logits_idx),
+            token_ids=tokens,
+            positions=positions,
+            slot_mapping=slots,
+            block_tables=self._pad_tables(tables_rows),
+            context_lens=ctx_lens,
+            logits_idx=logits_idx,
         )
         sampled = await self._dispatch(batch, temp, topk, topp)
 
@@ -306,12 +417,12 @@ class TpuEngine(AsyncEngine):
         tables_rows += [[] for _ in range(B - len(work.items))]
 
         batch = ModelBatch(
-            token_ids=jnp.asarray(tokens),
-            positions=jnp.asarray(positions),
-            slot_mapping=jnp.asarray(slots),
-            block_tables=jnp.asarray(self._pad_tables(tables_rows)),
-            context_lens=jnp.asarray(ctx_lens),
-            logits_idx=jnp.asarray(logits_idx),
+            token_ids=tokens,
+            positions=positions,
+            slot_mapping=slots,
+            block_tables=self._pad_tables(tables_rows),
+            context_lens=ctx_lens,
+            logits_idx=logits_idx,
         )
         sampled = await self._dispatch(batch, temp, topk, topp)
 
@@ -339,7 +450,8 @@ class TpuEngine(AsyncEngine):
             )
             return np.asarray(tokens_dev)
 
-        return await asyncio.to_thread(run)
+        async with self._device_lock:
+            return await asyncio.to_thread(run)
 
     # ------------------------------------------------------------ per-token
     def _seal_completed_blocks(self, seq: SequenceState) -> None:

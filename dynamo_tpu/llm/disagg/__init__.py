@@ -1,0 +1,16 @@
+"""Disaggregated prefill/decode serving (SURVEY §3.4, §7 stage 6).
+
+Reference shape: long prefills are offloaded from decode workers to
+dedicated prefill workers through a work queue; the prefill worker computes
+prompt KV and pushes the blocks directly into the decode worker's cache
+(reference: NIXL GPUDirect-RDMA inside the vLLM patch).  TPU-native
+equivalent: the KV blocks travel host-staged over the service plane
+(msgpack binary frames; ICI-direct device-to-device transfer applies when
+prefill and decode share a pod slice), and land in the decode engine's
+paged cache as *sealed, hash-addressed blocks* — so the decode pass sees
+them as a prefix-cache hit and the scheduler needs no special remote mode.
+"""
+
+from .prefill_queue import PrefillQueue  # noqa: F401
+from .router import DisaggConfig, DisaggregatedRouter  # noqa: F401
+from .worker import DisaggDecodeWorker, PrefillWorkerLoop, KV_IMPORT_ENDPOINT  # noqa: F401

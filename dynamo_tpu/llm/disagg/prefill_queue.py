@@ -1,0 +1,34 @@
+"""Prefill work queue over the hub's ack/nack queue plane.
+
+Reference semantics: examples/llm/utils/{nats_queue,prefill_queue}.py — a
+JetStream work queue named per model; decode workers enqueue
+RemotePrefillRequests, prefill workers pull with at-least-once handoff
+(un-acked items requeue on failure, so a dying prefill worker never loses a
+request).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class PrefillQueue:
+    def __init__(self, hub, model: str):
+        self.hub = hub
+        self.queue_name = f"prefill/{model}"
+
+    async def enqueue(self, request: Dict[str, Any]) -> None:
+        await self.hub.q_push(self.queue_name, request)
+
+    async def dequeue(self):
+        """Returns ``(request, ack_token)``; blocks until an item arrives."""
+        return await self.hub.q_pop(self.queue_name)
+
+    async def ack(self, token: str) -> bool:
+        return await self.hub.q_ack(token)
+
+    async def nack(self, token: str) -> bool:
+        return await self.hub.q_nack(token)
+
+    async def size(self) -> int:
+        return await self.hub.q_len(self.queue_name)

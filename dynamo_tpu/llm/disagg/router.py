@@ -1,0 +1,103 @@
+"""Local-vs-remote prefill decision with live config.
+
+Reference semantics: lib/llm/src/disagg_router.rs:24-41,142-253 — prefill
+goes remote iff
+
+    prefill_tokens − prefix_hit_tokens > max_local_prefill_length
+    AND queue_size < max_prefill_queue_size
+
+and the thresholds live-update from a config key watched in the control
+plane (etcd key ``public/components/disagg_router/models/chat/{model}``
+there; hub key ``disagg_router/{model}`` here).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+CONFIG_PREFIX = "disagg_router/"
+
+
+@dataclass
+class DisaggConfig:
+    max_local_prefill_length: int = 512
+    max_prefill_queue_size: int = 64
+
+    def to_dict(self) -> dict:
+        return {
+            "max_local_prefill_length": self.max_local_prefill_length,
+            "max_prefill_queue_size": self.max_prefill_queue_size,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DisaggConfig":
+        return cls(
+            max_local_prefill_length=int(
+                d.get("max_local_prefill_length", cls.max_local_prefill_length)
+            ),
+            max_prefill_queue_size=int(
+                d.get("max_prefill_queue_size", cls.max_prefill_queue_size)
+            ),
+        )
+
+
+class DisaggregatedRouter:
+    def __init__(self, model: str, config: Optional[DisaggConfig] = None):
+        self.model = model
+        self.config = config or DisaggConfig()
+        self._watch_task: Optional[asyncio.Task] = None
+        self._watcher = None
+
+    def prefill_remote(
+        self, prefill_length: int, prefix_hit_length: int, queue_size: int
+    ) -> bool:
+        return (
+            prefill_length - prefix_hit_length > self.config.max_local_prefill_length
+            and queue_size < self.config.max_prefill_queue_size
+        )
+
+    # ---------------------------------------------------------- live config
+    @property
+    def config_key(self) -> str:
+        return f"{CONFIG_PREFIX}{self.model}"
+
+    async def watch_config(self, hub) -> "DisaggregatedRouter":
+        """Start live-updating thresholds from the hub KV."""
+        current = await hub.kv_get(self.config_key)
+        if current:
+            self.config = DisaggConfig.from_dict(current)
+        self._watcher = await hub.watch_prefix(self.config_key)
+        self._watch_task = asyncio.get_running_loop().create_task(self._watch())
+        return self
+
+    async def _watch(self) -> None:
+        try:
+            async for event in self._watcher:
+                if event.type == "put" and event.value:
+                    self.config = DisaggConfig.from_dict(event.value)
+                    logger.info(
+                        "disagg config updated for %s: %s", self.model, self.config
+                    )
+        except asyncio.CancelledError:
+            pass
+
+    async def stop(self) -> None:
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+            try:
+                await self._watch_task
+            except asyncio.CancelledError:
+                pass
+            self._watch_task = None
+        if self._watcher is not None:
+            await self._watcher.aclose()
+
+
+async def publish_config(hub, model: str, config: DisaggConfig) -> None:
+    """Operator-side: push new thresholds (hot-reloads every watcher)."""
+    await hub.kv_put(f"{CONFIG_PREFIX}{model}", config.to_dict())

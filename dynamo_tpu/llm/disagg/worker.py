@@ -1,0 +1,208 @@
+"""Disaggregated decode + prefill worker orchestration.
+
+Flow (reference: SURVEY §3.4; examples/llm/components/{worker,
+prefill_worker}.py semantics, re-designed around hash-addressed KV blocks):
+
+decode side (``DisaggDecodeWorker`` wraps the decode TpuEngine):
+1. request arrives; ask the engine how much prefix is already local;
+2. DisaggregatedRouter decides local vs remote using (prefill_len −
+   prefix_hit, queue depth);
+3. remote: enqueue {token_ids, reply address} on the PrefillQueue and wait;
+4. the prefill worker computes the prompt KV on its own engine, then calls
+   this worker's ``kv_import`` endpoint with the block payload;
+5. ``inject_blocks`` seals the blocks into the decode cache → the normal
+   ``engine.generate`` admission sees a (near-)full prefix hit and decode
+   proceeds — no special remote state inside the scheduler;
+6. timeout or transfer failure falls back to local prefill (the request is
+   never lost — at-least-once queue semantics cover prefill-worker death).
+
+prefill side (``PrefillWorkerLoop``): pull → generate(max_tokens=1, KV
+retained via prefix cache) → export blocks → push to the decode worker's
+import endpoint → ack.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import Any, AsyncIterator, Dict, Optional
+
+from ...runtime.client import Client
+from ...runtime.engine import AsyncEngine, Context, ResponseStream
+from ..protocols import PreprocessedRequest
+from .prefill_queue import PrefillQueue
+from .router import DisaggregatedRouter
+
+logger = logging.getLogger(__name__)
+
+KV_IMPORT_ENDPOINT = "kv_import"
+
+
+class DisaggDecodeWorker(AsyncEngine):
+    def __init__(
+        self,
+        engine,
+        queue: PrefillQueue,
+        router: DisaggregatedRouter,
+        import_address: str,
+        import_path: str,
+        transfer_timeout: float = 30.0,
+    ):
+        self.engine = engine
+        self.queue = queue
+        self.router = router
+        self.import_address = import_address
+        self.import_path = import_path
+        self.transfer_timeout = transfer_timeout
+        self._pending: Dict[str, asyncio.Future] = {}
+        self.remote_prefills = 0
+        self.local_prefills = 0
+
+    # The engine handler served at the decode worker's kv_import endpoint.
+    async def kv_import_handler(self, request: Context) -> AsyncIterator[Dict]:
+        data = request.data
+        tokens = data["token_ids"]
+        covered = await self.engine.inject_blocks(tokens, data["payload"])
+        fut = self._pending.pop(data["transfer_id"], None)
+        if fut is not None and not fut.done():
+            fut.set_result(covered)
+        yield {"ok": True, "tokens_covered": covered}
+
+    async def generate(self, request: Context) -> ResponseStream:
+        pre = PreprocessedRequest.from_dict(request.data)
+        tokens = pre.token_ids
+        prefix_hit = self.engine.estimate_prefix_hit(tokens)
+        # Cheap local length test first; the queue-depth RPC to the hub only
+        # runs for prompts that are candidates for remote prefill.
+        remote = (
+            len(tokens) - prefix_hit > self.router.config.max_local_prefill_length
+        )
+        if remote:
+            qsize = await self.queue.size()
+            remote = self.router.prefill_remote(len(tokens), prefix_hit, qsize)
+        if remote:
+            await self._remote_prefill(tokens)
+        else:
+            self.local_prefills += 1
+        return await self.engine.generate(request)
+
+    async def _remote_prefill(self, tokens) -> None:
+        transfer_id = uuid.uuid4().hex
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[transfer_id] = fut
+        await self.queue.enqueue(
+            {
+                "transfer_id": transfer_id,
+                "token_ids": list(tokens),
+                "reply": {"address": self.import_address, "path": self.import_path},
+            }
+        )
+        try:
+            covered = await asyncio.wait_for(fut, self.transfer_timeout)
+            self.remote_prefills += 1
+            logger.info("remote prefill covered %d tokens", covered)
+        except asyncio.TimeoutError:
+            # Fall back to local prefill; a late transfer still lands as a
+            # harmless prefix-cache fill.
+            self._pending.pop(transfer_id, None)
+            self.local_prefills += 1
+            logger.warning("remote prefill timed out; prefilling locally")
+
+
+class PrefillWorkerLoop:
+    """Dedicated prefill worker: drain the queue, compute KV, push blocks."""
+
+    MAX_ATTEMPTS = 3
+
+    def __init__(self, engine, queue: PrefillQueue):
+        self.engine = engine
+        self.queue = queue
+        self._task: Optional[asyncio.Task] = None
+        self._clients: Dict[str, Client] = {}
+        self._attempts: Dict[str, int] = {}
+        self.handled = 0
+        self.dropped = 0
+
+    async def start(self) -> "PrefillWorkerLoop":
+        self._task = asyncio.get_running_loop().create_task(self._run())
+        return self
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        try:
+            while True:
+                item, token = await self.queue.dequeue()
+                tid = item.get("transfer_id", "?")
+                try:
+                    await self._handle(item)
+                    await self.queue.ack(token)
+                    self._attempts.pop(tid, None)
+                    self.handled += 1
+                    logger.info(
+                        "prefill %s done (%d tokens)", tid, len(item["token_ids"])
+                    )
+                except asyncio.CancelledError:
+                    await self.queue.nack(token)
+                    raise
+                except Exception:
+                    # Bounded retry with backoff: the decode side falls back
+                    # to local prefill on timeout anyway, so a poisoned item
+                    # (dead reply target, evicted blocks) is dropped rather
+                    # than spun on forever.
+                    attempts = self._attempts.get(tid, 0) + 1
+                    self._attempts[tid] = attempts
+                    if attempts >= self.MAX_ATTEMPTS:
+                        logger.exception(
+                            "prefill %s failed %d times; dropping", tid, attempts
+                        )
+                        await self.queue.ack(token)
+                        self._attempts.pop(tid, None)
+                        self.dropped += 1
+                    else:
+                        logger.warning("prefill %s failed; requeueing", tid)
+                        await self.queue.nack(token)
+                        await asyncio.sleep(0.2 * attempts)
+        except asyncio.CancelledError:
+            pass
+
+    async def _handle(self, item: Dict[str, Any]) -> None:
+        tokens = item["token_ids"]
+        pre = PreprocessedRequest(token_ids=list(tokens))
+        pre.stop_conditions.max_tokens = 1
+        pre.stop_conditions.ignore_eos = True
+        # Run the prompt through the engine: prefix caching retains the KV
+        # blocks (sealed, hash-addressed) after the request completes.
+        stream = await self.engine.generate(Context(pre.to_dict()))
+        async for _ in stream:
+            pass
+        payload = await self.engine.export_prompt_blocks(tokens)
+        if payload is None:
+            raise RuntimeError("prompt blocks missing after prefill (evicted?)")
+        reply = item["reply"]
+        client = self._client_for(reply["address"], reply["path"])
+        resp = await client.generate(
+            Context(
+                {
+                    "transfer_id": item["transfer_id"],
+                    "token_ids": list(tokens),
+                    "payload": payload,
+                }
+            )
+        )
+        async for _ack in resp:
+            pass
+
+    def _client_for(self, address: str, path: str) -> Client:
+        key = f"{address}/{path}"
+        if key not in self._clients:
+            self._clients[key] = Client.static(address, path)
+        return self._clients[key]

@@ -48,6 +48,7 @@ async def register_model(
     model_type: str = "both",  # chat | completion | both
     tokenizer: Optional[Dict[str, Any]] = None,
     lease: Optional[int] = None,
+    kv_block_size: int = 16,
 ) -> str:
     """Worker-side model registration (reference: llmctl + ModelEntry)."""
     key = f"{MODEL_PREFIX}{name}/{runtime.worker_id}"
@@ -56,7 +57,12 @@ async def register_model(
         "endpoint": endpoint_path,
         "model_type": model_type,
         "tokenizer": tokenizer or {"kind": "byte"},
+        # Routers must hash with the engine's block size or overlap is zero.
+        "kv_block_size": kv_block_size,
     }
+    if lease is None:
+        await runtime.register_key(key, entry)  # self-healing registration
+        return key
     await runtime.hub.kv_put(key, entry, lease if lease is not None else runtime.primary_lease)
     return key
 
@@ -75,6 +81,7 @@ class ModelWatcher:
         self.router_mode = router_mode
         self._refcount: Dict[str, int] = {}
         self._clients: Dict[str, Any] = {}
+        self._router_cores: Dict[str, Any] = {}
         self._task: Optional[asyncio.Task] = None
         self._watcher = None
 
@@ -90,6 +97,9 @@ class ModelWatcher:
             self._task = None
         if self._watcher is not None:
             await self._watcher.aclose()
+        for core in self._router_cores.values():
+            await core.stop()
+        self._router_cores.clear()
         for client in self._clients.values():
             await client.close()
         self._clients.clear()
@@ -116,9 +126,20 @@ class ModelWatcher:
         endpoint = self.runtime.namespace(ns).component(comp).endpoint(ep)
         client = await endpoint.client(router_mode=self.router_mode)
         self._clients[name] = client
+        sink: Any = client
+        if self.router_mode == RouterMode.KV:
+            from .kv_router.router import KvPushRouter, KvRouterCore
+
+            core = await KvRouterCore(
+                endpoint.component,
+                client,
+                block_size=int(entry.get("kv_block_size", 16)),
+            ).start()
+            self._router_cores[name] = core
+            sink = KvPushRouter(core)
         tokenizer = make_tokenizer(entry.get("tokenizer"))
         pipeline = build_pipeline(
-            [OpenAIPreprocessor(tokenizer, name), Backend(tokenizer)], client
+            [OpenAIPreprocessor(tokenizer, name), Backend(tokenizer)], sink
         )
         model_type = entry.get("model_type", "both")
         if model_type in ("chat", "both"):
@@ -135,6 +156,9 @@ class ModelWatcher:
             return
         del self._refcount[name]
         self.manager.remove_model(name)
+        core = self._router_cores.pop(name, None)
+        if core is not None:
+            await core.stop()
         client = self._clients.pop(name, None)
         if client is not None:
             await client.close()

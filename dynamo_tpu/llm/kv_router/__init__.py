@@ -4,10 +4,23 @@ Protocol types (events, metrics) are shared with the engine, which emits
 them; the indexer/scheduler consume them to pick workers by prefix overlap.
 """
 
+from .indexer import KvIndexer, KvIndexerSharded, OverlapScores  # noqa: F401
 from .protocols import (  # noqa: F401
     ForwardPassMetrics,
     KvCacheEvent,
     KvCacheRemoveData,
     KvCacheStoreData,
     KvCacheStoredBlockData,
+)
+from .publisher import (  # noqa: F401
+    KvEventPublisher,
+    KvMetricsAggregator,
+    KvMetricsPublisher,
+)
+from .recorder import KvRecorder, replay_events  # noqa: F401
+from .router import KvPushRouter, KvRouter, KvRouterCore, make_kv_router  # noqa: F401
+from .scheduler import (  # noqa: F401
+    DefaultWorkerSelector,
+    KvScheduler,
+    WorkerSnapshot,
 )

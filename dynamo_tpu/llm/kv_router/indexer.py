@@ -19,7 +19,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set
 
-from ...tokens import hash_token_blocks
+from ...tokens import fast_sequence_hashes
 from .protocols import KvCacheEvent, KvCacheRemoveData, KvCacheStoreData
 
 WorkerId = int
@@ -128,8 +128,9 @@ class KvIndexer:
         self._index.remove_worker(worker)
 
     def find_matches(self, token_ids: Sequence[int]) -> OverlapScores:
-        blocks = hash_token_blocks(token_ids, self.block_size)
-        return self.find_matches_for_hashes([b.sequence_hash for b in blocks])
+        return self.find_matches_for_hashes(
+            fast_sequence_hashes(token_ids, self.block_size)
+        )
 
     def find_matches_for_hashes(self, seq_hashes: Sequence[int]) -> OverlapScores:
         return self._index.find_matches(seq_hashes)
@@ -171,8 +172,7 @@ class KvIndexerSharded:
             shard.remove_worker(worker)
 
     def find_matches(self, token_ids: Sequence[int]) -> OverlapScores:
-        blocks = hash_token_blocks(token_ids, self.block_size)
-        hashes = [b.sequence_hash for b in blocks]
+        hashes = fast_sequence_hashes(token_ids, self.block_size)
         scores: Dict[WorkerId, int] = {}
         active: Optional[Set[WorkerId]] = None
         for i, h in enumerate(hashes):

@@ -27,6 +27,13 @@ KV_EVENTS_TOPIC = "kv_events"
 KV_METRICS_TOPIC = "kv_metrics"
 
 
+def unpack_message(msg) -> dict:
+    """Event-plane subscriptions yield ``(subject, payload)`` tuples."""
+    if isinstance(msg, tuple) and len(msg) == 2:
+        return msg[1]
+    return getattr(msg, "payload", msg)
+
+
 class KvEventPublisher:
     """Worker-side: stamp cache events with worker_id and publish them.
 
@@ -116,7 +123,7 @@ class KvMetricsAggregator:
     async def _run(self) -> None:
         try:
             async for msg in self._sub:
-                payload = msg.payload if hasattr(msg, "payload") else msg
+                payload = unpack_message(msg)
                 try:
                     wid = payload["worker_id"]
                     self._snapshots[wid] = ForwardPassMetrics.from_dict(

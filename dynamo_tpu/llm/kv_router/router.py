@@ -1,0 +1,173 @@
+"""KvRouter: KV-cache-aware request routing as an AsyncEngine.
+
+Reference semantics: lib/llm/src/kv_router.rs:52-169 — the router subscribes
+the worker fleet's ``kv_events``, keeps the global prefix index, and answers
+"which worker should run these tokens" by combining prefix overlap with live
+worker load (ForwardPassMetrics).  Two faces:
+
+- ``KvRouter``: the standalone service engine (components/router) —
+  RouterRequest {"token_ids"} → RouterResponse {"worker_id",
+  "overlap_blocks"}.
+- ``KvPushRouter``: drop-in pipeline sink that routes a PreprocessedRequest
+  to the chosen worker via ``client.direct`` (what the reference's processor
+  does in examples/llm/components/kv_router.py + processor.py).
+
+Worker liveness: instance set comes from the endpoint client's hub watch;
+workers that disappear are pruned from the index (indexer.remove_worker —
+the reference does this on etcd lease loss, kv_router/indexer.rs:380).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator, Dict, Optional, Tuple
+
+from ...runtime.client import Client
+from ...runtime.engine import AsyncEngine, Context, ResponseStream
+from .indexer import KvIndexer, KvIndexerSharded, WorkerId
+from .publisher import KV_EVENTS_TOPIC, KvMetricsAggregator, unpack_message
+from .scheduler import KvScheduler, KVHitRateEvent, KV_HIT_RATE_SUBJECT, WorkerSelector
+
+logger = logging.getLogger(__name__)
+
+
+class KvRouterCore:
+    """Index + metrics + selection (shared by both router faces)."""
+
+    def __init__(
+        self,
+        component,
+        client: Client,
+        block_size: int,
+        selector: Optional[WorkerSelector] = None,
+        sharded: bool = False,
+        publish_hit_rate: bool = True,
+    ):
+        self.component = component
+        self.client = client
+        self.block_size = block_size
+        self.indexer = (
+            KvIndexerSharded(block_size) if sharded else KvIndexer(block_size)
+        )
+        self.aggregator = KvMetricsAggregator(component)
+        self.scheduler = KvScheduler(
+            block_size,
+            selector=selector,
+            hit_rate_callback=self._on_hit_rate if publish_hit_rate else None,
+        )
+        self._event_task: Optional[asyncio.Task] = None
+        self._event_sub = None
+        self._known_workers: set = set()
+        self._bg: set = set()
+
+    async def start(self) -> "KvRouterCore":
+        self._event_sub = await self.component.subscribe(KV_EVENTS_TOPIC)
+        self._event_task = asyncio.get_running_loop().create_task(self._event_loop())
+        await self.aggregator.start()
+        return self
+
+    async def stop(self) -> None:
+        if self._event_task is not None:
+            self._event_task.cancel()
+            try:
+                await self._event_task
+            except asyncio.CancelledError:
+                pass
+            self._event_task = None
+        if self._event_sub is not None and hasattr(self._event_sub, "aclose"):
+            await self._event_sub.aclose()
+        await self.aggregator.stop()
+
+    async def _event_loop(self) -> None:
+        from .protocols import KvCacheEvent
+
+        try:
+            async for msg in self._event_sub:
+                payload = unpack_message(msg)
+                try:
+                    worker = payload["worker_id"]
+                    event = KvCacheEvent.from_dict(payload["event"])
+                except (KeyError, TypeError):
+                    logger.warning("malformed kv_event payload: %r", payload)
+                    continue
+                self.indexer.apply_event(worker, event)
+        except asyncio.CancelledError:
+            pass
+
+    def _on_hit_rate(self, event: KVHitRateEvent) -> None:
+        loop = asyncio.get_event_loop()
+        task = loop.create_task(
+            self.component.publish(KV_HIT_RATE_SUBJECT, event.to_dict())
+        )
+        self._bg.add(task)
+        task.add_done_callback(self._bg.discard)
+
+    def _prune_dead_workers(self, live: set) -> None:
+        for gone in self._known_workers - live:
+            logger.info("pruning dead worker %s from kv index", gone)
+            self.indexer.remove_worker(gone)
+            self.aggregator.remove_worker(gone)
+        self._known_workers = live
+
+    def select(self, token_ids) -> Tuple[Optional[WorkerId], int]:
+        """(best worker, overlap_blocks); None if no instances."""
+        live = set(self.client.instance_ids)
+        if live != self._known_workers:
+            self._prune_dead_workers(live)
+        if not live:
+            return None, 0
+        overlap = self.indexer.find_matches(token_ids)
+        workers = self.aggregator.endpoints(sorted(live))
+        winner = self.scheduler.schedule(len(token_ids), overlap, workers)
+        return winner, overlap.scores.get(winner, 0) if winner is not None else 0
+
+
+class KvRouter(AsyncEngine):
+    """Standalone routing service (reference: components/router)."""
+
+    def __init__(self, core: KvRouterCore):
+        self.core = core
+
+    async def generate(self, request: Context) -> ResponseStream:
+        token_ids = request.data["token_ids"]
+        worker_id, overlap = self.core.select(token_ids)
+
+        async def gen() -> AsyncIterator[Dict[str, Any]]:
+            yield {"worker_id": worker_id, "overlap_blocks": overlap}
+
+        return ResponseStream(gen(), request.ctx)
+
+
+class KvPushRouter(AsyncEngine):
+    """Pipeline sink: route PreprocessedRequest to the overlap-best worker.
+
+    Falls back to round-robin when no worker has been selected (e.g. no KV
+    events yet) — the client handles that internally via ``generate``.
+    """
+
+    def __init__(self, core: KvRouterCore):
+        self.core = core
+
+    async def generate(self, request: Context) -> ResponseStream:
+        token_ids = request.data.get("token_ids") or []
+        worker_id, overlap = self.core.select(token_ids)
+        if worker_id is None:
+            return await self.core.client.generate(request)
+        return await self.core.client.generate(request, worker_id=worker_id)
+
+
+async def make_kv_router(
+    endpoint,
+    block_size: int,
+    selector: Optional[WorkerSelector] = None,
+    sharded: bool = False,
+) -> KvRouterCore:
+    """Build + start a router core watching ``endpoint``'s worker fleet."""
+    from ...runtime.client import RouterMode
+
+    client = await endpoint.client(router_mode=RouterMode.ROUND_ROBIN)
+    core = KvRouterCore(
+        endpoint.component, client, block_size, selector=selector, sharded=sharded
+    )
+    return await core.start()

@@ -25,6 +25,10 @@ class RouterMode(enum.Enum):
     RANDOM = "random"
     ROUND_ROBIN = "round_robin"
     DIRECT = "direct"
+    # KV-cache-aware routing: interpreted by the serving layer (ModelWatcher
+    # builds a KvPushRouter around the client); the Client itself treats it
+    # as round-robin fallback.  Reference: component/client.rs RouterMode::KV.
+    KV = "kv"
 
 
 class NoInstancesError(RuntimeError):
@@ -117,6 +121,7 @@ class Client(AsyncEngine):
         ids = sorted(self._instances.keys())
         if mode == RouterMode.RANDOM:
             return self._instances[random.choice(ids)]
+        # ROUND_ROBIN (and KV fallback when no overlap decision was made)
         self._rr_index = (self._rr_index + 1) % len(ids)
         return self._instances[ids[self._rr_index]]
 

@@ -14,8 +14,11 @@ reference's etcd-lease liveness design (SURVEY §5 failure detection).
 from __future__ import annotations
 
 import asyncio
+import logging
 import uuid
 from typing import Any, Callable, Dict, Optional
+
+logger = logging.getLogger(__name__)
 
 from .client import Client, RouterMode
 from .engine import AsyncEngine, engine_from_generator
@@ -53,7 +56,10 @@ class DistributedRuntime:
     endpoint registrations default to that lease.
     """
 
-    DEFAULT_LEASE_TTL = 5.0
+    # 10s tolerates multi-second event-loop stalls (JAX tracing holds the
+    # GIL hard even from worker threads); the lease monitor below re-grants
+    # and re-registers if a stall still outlives the lease.
+    DEFAULT_LEASE_TTL = 10.0
 
     def __init__(self, hub, host: str = "127.0.0.1"):
         self.hub = hub
@@ -62,6 +68,10 @@ class DistributedRuntime:
         self._host = host
         self._service_server: Optional[ServiceServer] = None
         self._shutdown_event = asyncio.Event()
+        # key → value for every primary-lease registration, so a lost lease
+        # (event-loop stall > TTL) self-heals: re-grant + re-put everything.
+        self._registrations: Dict[str, Any] = {}
+        self._lease_monitor_task: Optional[asyncio.Task] = None
 
     @classmethod
     async def detached(cls) -> "DistributedRuntime":
@@ -75,7 +85,42 @@ class DistributedRuntime:
 
     async def _init(self) -> "DistributedRuntime":
         self.primary_lease = await self.hub.lease_grant(self.DEFAULT_LEASE_TTL)
+        self._lease_monitor_task = asyncio.get_running_loop().create_task(
+            self._lease_monitor()
+        )
         return self
+
+    async def register_key(self, key: str, value: Any) -> None:
+        """kv_put under the primary lease, tracked for re-registration."""
+        self._registrations[key] = value
+        await self.hub.kv_put(key, value, self.primary_lease)
+
+    async def unregister_key(self, key: str) -> None:
+        self._registrations.pop(key, None)
+        await self.hub.kv_delete(key)
+
+    async def _lease_monitor(self) -> None:
+        """Elastic recovery (SURVEY §5 failure detection): if the primary
+        lease expired (e.g. a compile stalled the loop past the TTL), grant a
+        fresh one and restore every tracked registration — the worker
+        re-appears to watchers instead of staying dead."""
+        while not self._shutdown_event.is_set():
+            await asyncio.sleep(self.DEFAULT_LEASE_TTL)
+            if self.primary_lease is None:
+                continue
+            try:
+                alive = await self.hub.lease_keepalive(self.primary_lease)
+                if alive:
+                    continue
+                logger.warning("primary lease lost; re-registering %d keys",
+                               len(self._registrations))
+                self.primary_lease = await self.hub.lease_grant(
+                    self.DEFAULT_LEASE_TTL
+                )
+                for key, value in list(self._registrations.items()):
+                    await self.hub.kv_put(key, value, self.primary_lease)
+            except (ConnectionError, RuntimeError, asyncio.CancelledError):
+                return
 
     async def service_server(self) -> ServiceServer:
         if self._service_server is None:
@@ -93,6 +138,9 @@ class DistributedRuntime:
 
     async def close(self) -> None:
         self.shutdown()
+        if self._lease_monitor_task is not None:
+            self._lease_monitor_task.cancel()
+            self._lease_monitor_task = None
         if self._service_server is not None:
             await self._service_server.close()
         if self.primary_lease is not None:
@@ -190,14 +238,17 @@ class Endpoint:
             engine = engine_from_generator(engine)
         server = await runtime.service_server()
         server.register(self.path, engine)
-        lease_id = lease if lease is not None else runtime.primary_lease
         info = {
             "address": server.address,
             "path": self.path,
             "worker_id": runtime.worker_id,
             "metadata": metadata or {},
         }
-        await runtime.hub.kv_put(self.instance_key(runtime.worker_id), info, lease_id)
+        key = self.instance_key(runtime.worker_id)
+        if lease is None:
+            await runtime.register_key(key, info)  # self-healing registration
+        else:
+            await runtime.hub.kv_put(key, info, lease)
         return ServedEndpoint(self, server)
 
     async def client(self, router_mode: RouterMode = RouterMode.ROUND_ROBIN) -> Client:
@@ -220,4 +271,4 @@ class ServedEndpoint:
     async def stop(self) -> None:
         runtime = self.endpoint.runtime
         self._server.unregister(self.endpoint.path)
-        await runtime.hub.kv_delete(self.endpoint.instance_key(runtime.worker_id))
+        await runtime.unregister_key(self.endpoint.instance_key(runtime.worker_id))

@@ -11,10 +11,12 @@ what lets the KV-aware router mirror engine cache state exactly.
 An optional ``salt`` mixes tenant/LoRA identity into the root so equal token
 streams from different tenants never share cache entries.
 
-TPU-native notes: hashing is pure host-side bookkeeping (never traced by JAX).
-xxhash (xxh3_64, seed 1337) is used when present; blake2b-64 otherwise — the
-choice only needs to be consistent within one deployment, since hashes are
-exchanged between our own components only.
+Hashing is pure host-side bookkeeping (never traced by JAX).  The algorithm
+is XXH64 seed 1337 — chosen because the native C++ runtime components
+(native/dyn_tokens.cc) implement the identical function, so hashes computed
+in either language agree across one deployment.  blake2b-64 is the fallback
+only when the xxhash module is missing (dev env) — mixing fallback and
+native hashing in one fleet would break routing.
 """
 
 from __future__ import annotations
@@ -28,11 +30,15 @@ HASH_SEED = 1337
 try:
     import xxhash
 
+    USING_XXHASH = True
+
     def _hash_bytes(data: bytes) -> int:
-        return xxhash.xxh3_64_intdigest(data, seed=HASH_SEED)
+        return xxhash.xxh64_intdigest(data, seed=HASH_SEED)
 
 except ImportError:  # pragma: no cover - image always has xxhash
     import hashlib
+
+    USING_XXHASH = False
 
     def _hash_bytes(data: bytes) -> int:
         h = hashlib.blake2b(data, digest_size=8, salt=b"dyn1337\x00")
@@ -151,3 +157,25 @@ def hash_token_blocks(
 ) -> List[TokenBlock]:
     """One-shot helper: hash all complete blocks of ``tokens``."""
     return TokenBlockSequence(tokens, block_size, salt).blocks
+
+
+def fast_sequence_hashes(
+    tokens: Sequence[int], block_size: int, salt: Optional[str] = None
+) -> List[int]:
+    """Chained sequence hashes of all complete blocks — the router's hot path
+    (one call per routed request over the full prompt).  Uses the native C++
+    library (native/dyn_tokens.cc, bit-identical XXH64 chain) when available,
+    pure Python otherwise."""
+    # The native library is XXH64; if this process hashes with the blake2b
+    # fallback, native hashes would not match engine-sealed blocks — skip it.
+    if USING_XXHASH:
+        try:
+            from . import native
+        except ImportError:  # pragma: no cover
+            native = None
+        if native is not None:
+            root = salt_hash(salt) or 0
+            pairs = native.hash_blocks(list(tokens), block_size, root)
+            if pairs is not None:
+                return [seq for _local, seq in pairs]
+    return [b.sequence_hash for b in hash_token_blocks(tokens, block_size, salt)]

@@ -1,0 +1,229 @@
+"""KV router stack tests: indexer event/match logic, selector cost function,
+publisher→aggregator roundtrip, record/replay, and KV-aware routing of real
+engine traffic over the distributed plane (mirrors the reference's
+kv_router unit tests + test_kv_bindings.py roundtrip — SURVEY §4)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.llm.kv_router import (
+    DefaultWorkerSelector,
+    ForwardPassMetrics,
+    KvCacheEvent,
+    KvCacheStoredBlockData,
+    KvIndexer,
+    KvIndexerSharded,
+    KvRecorder,
+    KvScheduler,
+    WorkerSnapshot,
+    replay_events,
+)
+from dynamo_tpu.tokens import hash_token_blocks
+
+BS = 4
+
+
+def _stored_event(eid, tokens, worker_blocks=None):
+    blocks = hash_token_blocks(tokens, BS)
+    return KvCacheEvent.stored(
+        eid,
+        None,
+        [
+            KvCacheStoredBlockData(b.sequence_hash, b.block_hash)
+            for b in blocks
+        ],
+    )
+
+
+def _apply_prompt(indexer, worker, tokens, eid=1):
+    indexer.apply_event(worker, _stored_event(eid, tokens))
+
+
+@pytest.mark.parametrize("cls", [KvIndexer, KvIndexerSharded])
+def test_indexer_prefix_matching(cls):
+    idx = cls(BS)
+    _apply_prompt(idx, 1, list(range(16)))  # worker 1: blocks 0..3
+    _apply_prompt(idx, 2, list(range(8)))  # worker 2: blocks 0..1
+
+    scores = idx.find_matches(list(range(16)))
+    assert scores.scores == {1: 4, 2: 2}
+    # Diverging suffix: only the shared prefix counts.
+    scores = idx.find_matches(list(range(8)) + [99] * 8)
+    assert scores.scores == {1: 2, 2: 2}
+    # Different first block → no match at all.
+    scores = idx.find_matches([99] * 16)
+    assert scores.scores == {}
+
+
+@pytest.mark.parametrize("cls", [KvIndexer, KvIndexerSharded])
+def test_indexer_removal_and_worker_pruning(cls):
+    idx = cls(BS)
+    _apply_prompt(idx, 1, list(range(16)))
+    _apply_prompt(idx, 2, list(range(16)))
+    blocks = hash_token_blocks(list(range(16)), BS)
+
+    # Worker 1 evicts its last two blocks.
+    idx.apply_event(
+        1, KvCacheEvent.removed(9, [b.sequence_hash for b in blocks[2:]])
+    )
+    scores = idx.find_matches(list(range(16)))
+    assert scores.scores == {1: 2, 2: 4}
+
+    idx.remove_worker(2)
+    scores = idx.find_matches(list(range(16)))
+    assert scores.scores == {1: 2}
+
+
+def test_indexer_chained_prefix_identity():
+    """Same local block content after different prefixes must not match."""
+    idx = KvIndexer(BS)
+    _apply_prompt(idx, 1, [1, 2, 3, 4, 9, 9, 9, 9])
+    scores = idx.find_matches([5, 6, 7, 8, 9, 9, 9, 9])
+    assert scores.scores == {}
+
+
+def test_selector_prefers_overlap_then_load():
+    sel = DefaultWorkerSelector()
+    sched = KvScheduler(BS, selector=sel)
+    idx = KvIndexer(BS)
+    _apply_prompt(idx, 1, list(range(16)))
+    overlap = idx.find_matches(list(range(16)))
+
+    idle = ForwardPassMetrics(request_active_slots=0, request_total_slots=8)
+    workers = [WorkerSnapshot(1, idle), WorkerSnapshot(2, idle)]
+    assert sched.schedule(16, overlap, workers) == 1
+
+    # Worker 1 overloaded enough to outweigh its full prefix hit
+    # (2*score = 2.0 < usage 1.0 + slots 1.0 + worker2's zero cost edge).
+    busy = ForwardPassMetrics(
+        request_active_slots=8, request_total_slots=8, gpu_cache_usage_perc=1.01
+    )
+    workers = [WorkerSnapshot(1, busy), WorkerSnapshot(2, idle)]
+    assert sched.schedule(16, overlap, workers) == 2
+
+
+def test_scheduler_emits_hit_rate_events():
+    events = []
+    sched = KvScheduler(BS, hit_rate_callback=events.append)
+    idx = KvIndexer(BS)
+    _apply_prompt(idx, 7, list(range(8)))
+    overlap = idx.find_matches(list(range(8)))
+    winner = sched.schedule(8, overlap, [WorkerSnapshot(7)])
+    assert winner == 7
+    assert events and events[0].worker_id == 7
+    assert events[0].overlap_blocks == 2 and events[0].isl_blocks == 2
+
+
+def test_event_serde_roundtrip():
+    ev = _stored_event(3, list(range(8)))
+    back = KvCacheEvent.from_dict(ev.to_dict())
+    assert back == ev
+    rm = KvCacheEvent.removed(4, [123, 456])
+    assert KvCacheEvent.from_dict(rm.to_dict()) == rm
+    cleared = KvCacheEvent(5, None)
+    assert KvCacheEvent.from_dict(cleared.to_dict()) == cleared
+
+
+def test_recorder_replay(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    rec = KvRecorder(path)
+    rec.record(1, _stored_event(1, list(range(16))))
+    rec.record(2, _stored_event(2, list(range(8))))
+    rec.close()
+
+    idx = KvIndexer(BS)
+
+    async def main():
+        n = await replay_events(path, idx)
+        assert n == 2
+
+    asyncio.run(main())
+    assert idx.find_matches(list(range(16))).scores == {1: 4, 2: 2}
+
+
+@pytest.mark.asyncio
+async def test_engine_events_route_repeat_prompts_to_same_worker():
+    """Full loop: two TPU engines publish KV events through the hub; the
+    KV-aware frontend routes a repeated prompt to the worker that cached it
+    (reference flow: SURVEY §3.3)."""
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.llm.discovery import ModelWatcher, register_model
+    from dynamo_tpu.llm.http_service import ModelManager
+    from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, KvMetricsPublisher
+    from dynamo_tpu.runtime import DistributedRuntime, HubServer
+    from dynamo_tpu.runtime.client import RouterMode
+    from dynamo_tpu.runtime.engine import Context, collect
+
+    cfg = dict(
+        model="debug-tiny",
+        block_size=BS,
+        num_blocks=64,
+        max_batch=4,
+        max_model_len=128,
+        prefill_chunk=32,
+        dtype="float32",
+    )
+    hub = await HubServer().start()
+    worker_rts, engines, pubs = [], [], []
+    try:
+        for _ in range(2):
+            rt = await DistributedRuntime.connect(hub.address)
+            engine = TpuEngine(EngineConfig(**cfg))
+            endpoint = rt.namespace("t").component("worker").endpoint("generate")
+            await endpoint.serve_endpoint(engine)
+            engine.set_event_callback(
+                KvEventPublisher(endpoint.component, rt.worker_id)
+            )
+            pub = await KvMetricsPublisher(
+                endpoint.component, rt.worker_id, engine.metrics, interval=0.1
+            ).start()
+            await register_model(
+                rt, "tiny", endpoint.path, kv_block_size=BS
+            )
+            worker_rts.append(rt)
+            engines.append(engine)
+            pubs.append(pub)
+
+        front_rt = await DistributedRuntime.connect(hub.address)
+        manager = ModelManager()
+        watcher = await ModelWatcher(
+            front_rt, manager, router_mode=RouterMode.KV
+        ).start()
+        pipeline = manager.chat_engine("tiny")
+
+        async def ask(prompt: str):
+            req = {
+                "model": "tiny",
+                "messages": [{"role": "user", "content": prompt}],
+                "max_tokens": 4,
+                "stream": True,
+            }
+            stream = await pipeline.generate(Context(req))
+            return await collect(stream)
+
+        # First run lands on an arbitrary worker and publishes its blocks.
+        await ask("alpha " * 8)
+        await asyncio.sleep(0.3)  # let KV events propagate
+        core = watcher._router_cores["tiny"]
+        assert len(core.indexer) > 0, "kv events never reached the router index"
+
+        # The repeat must route to the worker holding the cache: exactly one
+        # engine reports prefix-match gains.
+        before = [e.kv.matched_blocks for e in engines]
+        await ask("alpha " * 8)
+        await asyncio.sleep(0.1)
+        gains = [e.kv.matched_blocks - b for e, b in zip(engines, before)]
+        assert sum(1 for g in gains if g > 0) == 1, gains
+
+        await watcher.stop()
+        await front_rt.close()
+    finally:
+        for pub in pubs:
+            await pub.stop()
+        for e in engines:
+            await e.close()
+        for rt in worker_rts:
+            await rt.close()
+        await hub.close()

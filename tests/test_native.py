@@ -1,0 +1,69 @@
+"""Native C++ component tests: hash identity with Python, C-ABI event shim
+roundtrip.  Skipped cleanly if the toolchain can't build the library."""
+
+import pytest
+
+from dynamo_tpu import native
+from dynamo_tpu.llm.kv_router.protocols import KvCacheRemoveData, KvCacheStoreData
+from dynamo_tpu.tokens import fast_sequence_hashes, hash_token_blocks, salt_hash
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+def test_xxh64_matches_python_xxhash():
+    xxhash = pytest.importorskip("xxhash")
+    lib = native.get_lib()
+    for data in [b"", b"a", b"hello world", bytes(range(256)) * 5]:
+        expected = xxhash.xxh64_intdigest(data, seed=1337)
+        got = lib.dyn_xxh64(data, len(data), 1337)
+        assert got == expected, data
+
+
+def test_hash_blocks_matches_python_chain():
+    tokens = list(range(100, 164))  # 4 blocks of 16
+    py = hash_token_blocks(tokens, 16)
+    nat = native.hash_blocks(tokens, 16, 0)
+    assert len(nat) == len(py) == 4
+    for (local, seq), tb in zip(nat, py):
+        assert local == tb.block_hash
+        assert seq == tb.sequence_hash
+
+
+def test_fast_sequence_hashes_with_salt():
+    tokens = list(range(32))
+    fast = fast_sequence_hashes(tokens, 8, salt="tenant-a")
+    py = [b.sequence_hash for b in hash_token_blocks(tokens, 8, salt="tenant-a")]
+    assert fast == py
+    assert salt_hash("tenant-a") is not None
+
+
+def test_kv_event_shim_roundtrip():
+    import ctypes
+
+    shim = native.KvEventShim(worker_id=7)
+    try:
+        lib = native.get_lib()
+        seqs = (ctypes.c_uint64 * 2)(111, 222)
+        toks = (ctypes.c_uint64 * 2)(333, 444)
+        assert lib.dyn_kv_publish_stored(99, seqs, toks, 2) == 0
+        assert lib.dyn_kv_publish_removed(seqs, 1) == 0
+        assert lib.dyn_kv_publish_cleared() == 0
+
+        events = shim.drain()
+        assert len(events) == 3
+        stored, removed, cleared = events
+        assert isinstance(stored.data, KvCacheStoreData)
+        assert stored.data.parent_hash == 99
+        assert [(b.block_hash, b.tokens_hash) for b in stored.data.blocks] == [
+            (111, 333),
+            (222, 444),
+        ]
+        assert isinstance(removed.data, KvCacheRemoveData)
+        assert removed.data.block_hashes == [111]
+        assert cleared.data is None
+        assert shim.drain() == []  # drained
+        assert shim.dropped == 0
+    finally:
+        shim.close()
