@@ -58,6 +58,7 @@ def _engine_config():
         # the window tight to the workload (power-of-two padded).
         max_model_len=max(256, 1 << (isl + osl + 16 - 1).bit_length()),
         prefill_chunk=512,
+        decode_steps=int(os.environ.get("BENCH_DECODE_STEPS", "8")),
     )
     return cfg, {
         "isl": int(os.environ.get("BENCH_ISL", "128")),

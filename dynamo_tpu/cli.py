@@ -187,6 +187,74 @@ async def _run(args) -> None:
         raise SystemExit(f"unknown in= input: {inp!r}")
 
 
+async def _run_model_cmd(args) -> None:
+    """llmctl equivalent (reference: launch/llmctl/src/main.rs:26-124)."""
+    from .llm.discovery import MODEL_PREFIX
+
+    runtime = await DistributedRuntime.connect(args.hub)
+    try:
+        if args.verb == "add":
+            key = await register_model(
+                runtime,
+                args.name,
+                args.endpoint,
+                model_type=args.type,
+                tokenizer={"kind": "hf", "file": args.tokenizer}
+                if args.tokenizer
+                else {"kind": "byte"},
+                kv_block_size=args.block_size,
+                static=True,
+            )
+            print(f"registered {args.name} -> {args.endpoint} ({key})")
+        elif args.verb == "list":
+            kvs = await runtime.hub.kv_get_prefix(MODEL_PREFIX)
+            for key, entry in sorted(kvs.items()):
+                print(f"{entry['name']}\t{entry['model_type']}\t{entry['endpoint']}")
+            if not kvs:
+                print("(no models registered)")
+        elif args.verb == "remove":
+            kvs = await runtime.hub.kv_get_prefix(f"{MODEL_PREFIX}{args.name}/")
+            for key in kvs:
+                await runtime.hub.kv_delete(key)
+            print(f"removed {len(kvs)} registration(s) for {args.name}")
+    finally:
+        await runtime.close()
+
+
+async def _run_metrics(args) -> None:
+    """Namespace metrics aggregator (reference: components/metrics)."""
+    from .llm.metrics_service import MetricsAggregatorService
+
+    runtime = await DistributedRuntime.connect(args.hub)
+    component = runtime.namespace(args.namespace).component(args.component)
+    service = await MetricsAggregatorService(
+        component, host=args.host, port=args.port
+    ).start()
+    print(f"metrics aggregator on http://{args.host}:{args.port}/metrics", flush=True)
+    try:
+        await _wait_forever()
+    finally:
+        await service.stop()
+        await runtime.close()
+
+
+async def _run_mock_worker(args) -> None:
+    """Synthetic metrics/KV-event publisher (reference: mock_worker.rs)."""
+    from .llm.metrics_service import MockWorker
+
+    runtime = await DistributedRuntime.connect(args.hub)
+    component = runtime.namespace(args.namespace).component(args.component)
+    worker = await MockWorker(
+        component, runtime.worker_id, interval=args.interval
+    ).start()
+    print(f"mock worker {runtime.worker_id} publishing", flush=True)
+    try:
+        await _wait_forever()
+    finally:
+        await worker.stop()
+        await runtime.close()
+
+
 async def _wait_forever() -> None:
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -238,6 +306,13 @@ def main(argv: Optional[list] = None) -> None:
     p_run.add_argument("--max-model-len", type=int, default=1024, dest="max_model_len")
     p_run.add_argument("--prefill-chunk", type=int, default=512, dest="prefill_chunk")
     p_run.add_argument(
+        "--attn-impl",
+        default="auto",
+        choices=["auto", "xla", "pallas", "jax"],
+        dest="attn_impl",
+        help="decode attention backend",
+    )
+    p_run.add_argument(
         "--disagg",
         default=None,
         choices=["decode", "prefill"],
@@ -251,7 +326,33 @@ def main(argv: Optional[list] = None) -> None:
         help="prefills longer than this (minus prefix hit) go remote",
     )
 
+    p_model = sub.add_parser("model", help="model registry (llmctl equivalent)")
+    p_model.add_argument("verb", choices=["add", "list", "remove"])
+    p_model.add_argument("name", nargs="?", default=None)
+    p_model.add_argument("endpoint", nargs="?", default=None, help="dyn://ns.comp.ep")
+    p_model.add_argument("--hub", required=True)
+    p_model.add_argument("--type", default="both", choices=["chat", "completion", "both"])
+    p_model.add_argument("--tokenizer", default=None)
+    p_model.add_argument("--block-size", type=int, default=16, dest="block_size")
+
+    p_metrics = sub.add_parser("metrics", help="namespace metrics aggregator")
+    p_metrics.add_argument("--hub", required=True)
+    p_metrics.add_argument("--namespace", default="dynamo")
+    p_metrics.add_argument("--component", default="TpuWorker")
+    p_metrics.add_argument("--host", default="0.0.0.0")
+    p_metrics.add_argument("--port", type=int, default=9091)
+
+    p_mock = sub.add_parser("mock-worker", help="synthetic metrics/KV events")
+    p_mock.add_argument("--hub", required=True)
+    p_mock.add_argument("--namespace", default="dynamo")
+    p_mock.add_argument("--component", default="TpuWorker")
+    p_mock.add_argument("--interval", type=float, default=0.5)
+
     args = parser.parse_args(argv)
+    if args.cmd == "model" and args.verb in ("add", "remove") and not args.name:
+        parser.error(f"model {args.verb} requires a model name")
+    if args.cmd == "model" and args.verb == "add" and not args.endpoint:
+        parser.error("model add requires an endpoint path")
     if args.cmd == "run":
         kv = dict(part.split("=", 1) for part in args.inout)
         if "in" not in kv or "out" not in kv:
@@ -263,6 +364,12 @@ def main(argv: Optional[list] = None) -> None:
             asyncio.run(_run_hub(args))
         elif args.cmd == "http":
             asyncio.run(_run_http_frontend(args))
+        elif args.cmd == "model":
+            asyncio.run(_run_model_cmd(args))
+        elif args.cmd == "metrics":
+            asyncio.run(_run_metrics(args))
+        elif args.cmd == "mock-worker":
+            asyncio.run(_run_mock_worker(args))
         else:
             asyncio.run(_run(args))
     except KeyboardInterrupt:

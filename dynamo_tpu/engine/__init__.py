@@ -36,5 +36,6 @@ def build_tpu_engine(args):
         dp=getattr(args, "dp", 1),
         ep=getattr(args, "ep", 1),
         checkpoint_path=getattr(args, "checkpoint", None),
+        attn_impl=getattr(args, "attn_impl", "auto"),
     )
     return TpuEngine(cfg)

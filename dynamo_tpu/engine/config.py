@@ -43,6 +43,14 @@ class EngineConfig:
     prefill_buckets: List[int] = field(default_factory=list)
     enable_prefix_caching: bool = True
     checkpoint_path: Optional[str] = None  # safetensors dir; None = random init
+    # Decode attention backend: auto (pallas on TPU, xla elsewhere) |
+    # xla | pallas | jax (jax's built-in paged_attention kernel).
+    attn_impl: str = "auto"
+    # Decode iterations fused into one device dispatch (lax.scan feeding
+    # sampled tokens forward in HBM).  >1 amortises host→device dispatch
+    # latency at the cost of token-delivery granularity; essential when the
+    # chip is reached over a network tunnel, still useful locally.
+    decode_steps: int = 4
 
     def __post_init__(self) -> None:
         if not self.batch_buckets:

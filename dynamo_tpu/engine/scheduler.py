@@ -198,7 +198,15 @@ class Scheduler:
         return DecodeWork(ready[: self.cfg.max_batch]) if ready else None
 
     def _ensure_slot(self, seq: SequenceState) -> bool:
-        needed_blocks = (seq.num_computed + 1 + self.cfg.block_size - 1) // self.cfg.block_size
+        # Allocate ahead for the whole fused decode chunk (decode_steps);
+        # the device-side `limits` guard keeps any tail steps past the
+        # allocation from writing.
+        lookahead = max(1, getattr(self.cfg, "decode_steps", 1))
+        needed_blocks = min(
+            (seq.num_computed + lookahead + self.cfg.block_size - 1)
+            // self.cfg.block_size,
+            self.cfg.max_blocks_per_seq,
+        )
         while len(seq.block_ids) < needed_blocks:
             bid = self.kv.allocate_block()
             if bid is None:

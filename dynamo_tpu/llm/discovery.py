@@ -49,6 +49,7 @@ async def register_model(
     tokenizer: Optional[Dict[str, Any]] = None,
     lease: Optional[int] = None,
     kv_block_size: int = 16,
+    static: bool = False,  # no lease: survives the registrar (llmctl mode)
 ) -> str:
     """Worker-side model registration (reference: llmctl + ModelEntry)."""
     key = f"{MODEL_PREFIX}{name}/{runtime.worker_id}"
@@ -60,6 +61,9 @@ async def register_model(
         # Routers must hash with the engine's block size or overlap is zero.
         "kv_block_size": kv_block_size,
     }
+    if static:
+        await runtime.hub.kv_put(key, entry)  # persistent, no liveness tie
+        return key
     if lease is None:
         await runtime.register_key(key, entry)  # self-healing registration
         return key

@@ -25,7 +25,7 @@ from typing import Any, Dict, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import paged_attention, write_kv
+from ..ops.attention import decode_attention, paged_attention, write_kv
 from ..ops.rope import apply_rope, rope_frequencies
 from .config import ModelConfig
 from .moe import init_moe_params, moe_mlp
@@ -34,7 +34,10 @@ Params = Dict[str, Any]
 
 
 class KVCache(NamedTuple):
-    """Per-layer flat slot slabs: [num_layers, num_slots, kv_heads, head_dim]."""
+    """Per-layer head-major slot slabs:
+    [num_layers, kv_heads, num_slots, head_dim] — reshapes for free to the
+    pages layout [kv_heads, num_pages, page_size, head_dim] the decode
+    kernels stream (ops/attention.py module doc)."""
 
     k: jnp.ndarray
     v: jnp.ndarray
@@ -45,8 +48,8 @@ class KVCache(NamedTuple):
     ) -> "KVCache":
         shape = (
             config.num_layers,
-            num_blocks * block_size,
             config.num_kv_heads,
+            num_blocks * block_size,
             config.head_dim,
         )
         return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
@@ -128,11 +131,14 @@ def forward(
     batch: ModelBatch,
     kv_cache: KVCache,
     block_size: int,
+    attn_impl: str = "xla",
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Run the decoder; returns (logits [B, vocab] f32, updated cache).
 
     The cache arrays should be donated by the caller's jit so the scatter
-    updates happen in place in HBM.
+    updates happen in place in HBM.  ``attn_impl`` selects the decode-path
+    attention backend (xla gather | custom pallas | jax built-in); prefill
+    (Sq > 1) always uses the XLA gather path.
     """
     B, Sq = batch.token_ids.shape
     H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
@@ -150,15 +156,26 @@ def forward(
         q = apply_rope(q, batch.positions, inv_freq)
         k = apply_rope(k, batch.positions, inv_freq)
         kc, vc = write_kv(kc, vc, k, v, batch.slot_mapping)
-        attn = paged_attention(
-            q,
-            kc,
-            vc,
-            batch.block_tables,
-            batch.context_lens,
-            batch.positions,
-            block_size,
-        )
+        if Sq == 1 and attn_impl != "xla":
+            attn = decode_attention(
+                q,
+                kc,
+                vc,
+                batch.block_tables,
+                batch.context_lens,
+                block_size,
+                impl=attn_impl,
+            )
+        else:
+            attn = paged_attention(
+                q,
+                kc,
+                vc,
+                batch.block_tables,
+                batch.context_lens,
+                batch.positions,
+                block_size,
+            )
         h = h + attn.reshape(B, Sq, H * hd) @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], config.rms_norm_eps)
         if config.is_moe:

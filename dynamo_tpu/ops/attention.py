@@ -1,21 +1,27 @@
 """Paged-KV attention: the engine's core op.
 
-The KV cache for each layer is a flat slab of token slots
-``[num_slots, kv_heads, head_dim]`` (num_slots = num_blocks * block_size) —
+The KV cache for each layer is a head-major slab of token slots
+``[kv_heads, num_slots, head_dim]`` (num_slots = num_blocks * block_size) —
 the TPU translation of the reference's slab-per-layer block storage
-(lib/llm/src/kv/layer.rs:100-772).  Sequences own *blocks* of ``block_size``
-consecutive slots; a block table maps each sequence's logical block index to
-its physical block id.  Because attention gathers whole blocks, any physical
-block order works — allocation never moves data.
+(lib/llm/src/kv/layer.rs:100-772).  Head-major order makes each head's pages
+contiguous, which is what both the Pallas decode kernel and jax's built-in
+paged_attention stream (the slab reshapes to pages
+``[kv_heads, num_pages, page_size, head_dim]`` for free).  Sequences own
+*blocks* of ``block_size`` consecutive slots; a block table maps each
+sequence's logical block index to its physical block id.  Because attention
+addresses whole blocks, any physical block order works — allocation never
+moves data.
 
-``paged_attention`` here is the XLA reference implementation: gather the
-sequence's slots, mask, flash-style softmax in f32.  It is used for both
-prefill (Sq = padded prompt bucket) and decode (Sq = 1), which keeps a single
-code path and a single set of compiled shapes per bucket.  A Pallas kernel
-with block-wise streaming replaces the gather for large contexts (ops/pallas_attention.py).
+Two execution paths behind one contract:
+- ``paged_attention`` — XLA reference: gather the sequence's slots, mask,
+  flash-style softmax in f32.  Used for prefill (Sq = padded bucket) on all
+  platforms and for decode on CPU.
+- ``decode_attention`` — dispatcher for the Sq=1 decode hot path: custom
+  Pallas kernel (ops/pallas_attention.py), jax's built-in paged_attention,
+  or the XLA path, per engine config.
 
-Static shapes everywhere: padded queries use slot -1 (dropped scatter), padded
-context is masked by ``context_lens``.
+Static shapes everywhere: padded queries use slot -1 (dropped scatter),
+padded context is masked by ``context_lens``.
 """
 
 from __future__ import annotations
@@ -28,8 +34,18 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def on_tpu() -> bool:
+    """True when default execution actually lands on a TPU — accounts for a
+    jax_default_device override (tests pin CPU while a TPU plugin is still
+    registered as the default backend)."""
+    if jax.default_backend() != "tpu":
+        return False
+    dev = jax.config.jax_default_device
+    return dev is None or getattr(dev, "platform", None) == "tpu"
+
+
 def write_kv(
-    k_cache: jnp.ndarray,  # [num_slots, kv_heads, head_dim]
+    k_cache: jnp.ndarray,  # [kv_heads, num_slots, head_dim]
     v_cache: jnp.ndarray,
     k_new: jnp.ndarray,  # [B, Sq, kv_heads, head_dim]
     v_new: jnp.ndarray,
@@ -39,12 +55,12 @@ def write_kv(
     flat_slots = slot_mapping.reshape(-1)
     # Negative indices would wrap; remap them past the end so mode="drop"
     # discards padding writes instead of clobbering the last slots.
-    flat_slots = jnp.where(flat_slots < 0, k_cache.shape[0], flat_slots)
-    kv_heads, head_dim = k_cache.shape[-2:]
-    k_flat = k_new.reshape(-1, kv_heads, head_dim).astype(k_cache.dtype)
-    v_flat = v_new.reshape(-1, kv_heads, head_dim).astype(v_cache.dtype)
-    k_cache = k_cache.at[flat_slots].set(k_flat, mode="drop")
-    v_cache = v_cache.at[flat_slots].set(v_flat, mode="drop")
+    flat_slots = jnp.where(flat_slots < 0, k_cache.shape[1], flat_slots)
+    kv_heads, _, head_dim = k_cache.shape
+    k_flat = k_new.transpose(2, 0, 1, 3).reshape(kv_heads, -1, head_dim)
+    v_flat = v_new.transpose(2, 0, 1, 3).reshape(kv_heads, -1, head_dim)
+    k_cache = k_cache.at[:, flat_slots].set(k_flat.astype(k_cache.dtype), mode="drop")
+    v_cache = v_cache.at[:, flat_slots].set(v_flat.astype(v_cache.dtype), mode="drop")
     return k_cache, v_cache
 
 
@@ -60,7 +76,7 @@ def gather_context_slots(
 
 def paged_attention(
     q: jnp.ndarray,  # [B, Sq, heads, head_dim]
-    k_cache: jnp.ndarray,  # [num_slots, kv_heads, head_dim]
+    k_cache: jnp.ndarray,  # [kv_heads, num_slots, head_dim]
     v_cache: jnp.ndarray,
     block_tables: jnp.ndarray,  # [B, max_blocks]
     context_lens: jnp.ndarray,  # [B] total valid context tokens (incl. new)
@@ -68,7 +84,8 @@ def paged_attention(
     block_size: int,
     scale: float | None = None,
 ) -> jnp.ndarray:
-    """Causal attention of queries against their sequence's paged context.
+    """Causal attention of queries against their sequence's paged context
+    (XLA gather path).
 
     Context position j (< context_lens[b]) is visible to query token i iff
     j <= positions[b, i].  New tokens' K/V must already be in the cache
@@ -76,19 +93,19 @@ def paged_attention(
     the same gather.
     """
     B, Sq, H, D = q.shape
-    KV = k_cache.shape[-2]
+    KV = k_cache.shape[0]
     groups = H // KV
     if scale is None:
         scale = D**-0.5
 
     slots = gather_context_slots(block_tables, block_size)  # [B, L]
     L = slots.shape[-1]
-    k = k_cache[slots]  # [B, L, KV, D]
-    v = v_cache[slots]
+    k = k_cache[:, slots]  # [KV, B, L, D]
+    v = v_cache[:, slots]
 
     qf = q.astype(jnp.float32).reshape(B, Sq, KV, groups, D) * scale
     kf = k.astype(jnp.float32)
-    logits = jnp.einsum("bqkgd,blkd->bkgql", qf, kf)  # [B, KV, G, Sq, L]
+    logits = jnp.einsum("bqkgd,kbld->bkgql", qf, kf)  # [B, KV, G, Sq, L]
 
     ctx = jnp.arange(L, dtype=jnp.int32)
     valid = ctx[None, :] < context_lens[:, None]  # [B, L]
@@ -97,5 +114,59 @@ def paged_attention(
     logits = jnp.where(mask, logits, NEG_INF)
 
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bkgql,blkd->bqkgd", probs, v.astype(jnp.float32))
+    out = jnp.einsum("bkgql,kbld->bqkgd", probs, v.astype(jnp.float32))
     return out.reshape(B, Sq, H, D).astype(q.dtype)
+
+
+def decode_attention(
+    q: jnp.ndarray,  # [B, 1, heads, head_dim]
+    k_cache: jnp.ndarray,  # [kv_heads, num_slots, head_dim]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks]
+    context_lens: jnp.ndarray,  # [B]
+    block_size: int,
+    impl: str = "xla",  # xla | pallas | jax
+) -> jnp.ndarray:
+    """Sq=1 hot path: dispatch to the configured kernel backend."""
+    B, Sq, H, D = q.shape
+    KV = k_cache.shape[0]
+    G = H // KV
+
+    if impl == "xla":
+        positions = (context_lens - 1)[:, None]
+        return paged_attention(
+            q, k_cache, v_cache, block_tables, context_lens, positions, block_size
+        )
+
+    num_pages = k_cache.shape[1] // block_size
+    k_pages = k_cache.reshape(KV, num_pages, block_size, D)
+    v_pages = v_cache.reshape(KV, num_pages, block_size, D)
+
+    if impl == "pallas":
+        from .pallas_attention import paged_decode_attention
+
+        out = paged_decode_attention(
+            q.reshape(B, KV, G, D),
+            k_pages,
+            v_pages,
+            context_lens,
+            block_tables,
+            page_size=block_size,
+        )
+        return out.reshape(B, Sq, H, D)
+
+    if impl == "jax":
+        from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention as jax_paged
+
+        # jax's kernel does not scale q internally — pre-scale by 1/sqrt(d).
+        out = jax_paged(
+            (q.reshape(B, H, D) * (D**-0.5)).astype(q.dtype),
+            k_pages,
+            v_pages,
+            jnp.maximum(context_lens, 1),
+            block_tables,
+            pages_per_compute_block=min(8, block_tables.shape[1]),
+        )
+        return out.reshape(B, Sq, H, D)
+
+    raise ValueError(f"unknown attention impl {impl!r}")

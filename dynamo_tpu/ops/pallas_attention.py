@@ -1,0 +1,134 @@
+"""Pallas TPU decode kernel: paged attention for Sq=1 continuous batching.
+
+The hot op of the decode loop (SURVEY §7 stage 4): each sequence reads its
+own scattered KV pages.  The XLA reference path (ops/attention.py) gathers
+``max_blocks`` pages per sequence through HBM into one dense tensor; this
+kernel instead streams pages through VMEM with flash-style online softmax,
+one (batch row, kv head, page) grid step at a time, with the page table as
+scalar-prefetch so the DMA pipeline knows each page's address up front
+(pallas_guide: PrefetchScalarGridSpec + double-buffering pattern).
+
+Layout contract (shared with jax's built-in paged_attention, so both are
+interchangeable backends behind ops.attention.decode_attention):
+  q        [B, kv_heads, group, head_dim]
+  k_pages  [kv_heads, num_pages, page_size, head_dim]
+  lengths  i32[B]  (context length per row, 0 = padding row)
+  page_tables i32[B, pages_per_seq]
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import on_tpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    page_tables_ref,  # i32[B, PPS]
+    lengths_ref,  # i32[B]
+    # blocks
+    q_ref,  # [1, 1, G, hd]
+    k_ref,  # [1, 1, ps, hd]
+    v_ref,  # [1, 1, ps, hd]
+    o_ref,  # [1, 1, G, hd]
+    # scratch
+    m_ref,  # f32[G, 128]
+    l_ref,  # f32[G, 128]
+    acc_ref,  # f32[G, hd]
+    *,
+    page_size: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    length = lengths_ref[b]
+
+    @pl.when(j * page_size < length)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # [G, hd]
+        k = k_ref[0, 0].astype(jnp.float32)  # [ps, hd]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [G, ps]
+        pos = j * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < length, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]  # [G, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # [G, ps]
+        l_ref[:, :1] = l_ref[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:, :1] = m_new
+
+    @pl.when(j == n_pages - 1)
+    def _finish():
+        denom = jnp.where(l_ref[:, :1] == 0.0, 1.0, l_ref[:, :1])
+        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_size",))
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, KV, G, hd]
+    k_pages: jnp.ndarray,  # [KV, NP, ps, hd]
+    v_pages: jnp.ndarray,
+    lengths: jnp.ndarray,  # i32[B]
+    page_tables: jnp.ndarray,  # i32[B, PPS]
+    *,
+    page_size: int,
+) -> jnp.ndarray:
+    """Returns [B, KV, G, hd] attention output (our custom kernel)."""
+    B, KV, G, hd = q.shape
+    pps = page_tables.shape[1]
+    scale = hd**-0.5
+
+    kernel = functools.partial(_decode_kernel, page_size=page_size, scale=scale)
+
+    grid = (B, KV, pps)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, G, hd), lambda b, h, j, pt, ln: (b, h, 0, 0)),
+                pl.BlockSpec(
+                    (1, 1, page_size, hd), lambda b, h, j, pt, ln: (h, pt[b, j], 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, page_size, hd), lambda b, h, j, pt, ln: (h, pt[b, j], 0, 0)
+                ),
+            ],
+            out_specs=pl.BlockSpec((1, 1, G, hd), lambda b, h, j, pt, ln: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((G, 128), jnp.float32),
+                pltpu.VMEM((G, 128), jnp.float32),
+                pltpu.VMEM((G, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+        interpret=not on_tpu(),
+    )(page_tables, lengths, q, k_pages, v_pages)

@@ -98,8 +98,8 @@ def param_pspecs(config: ModelConfig) -> Any:
 
 
 def cache_pspec() -> P:
-    """KVCache slabs [L, slots, kv_heads, head_dim]: heads shard on tp."""
-    return P(None, None, "tp", None)
+    """KVCache slabs [L, kv_heads, slots, head_dim]: heads shard on tp."""
+    return P(None, "tp", None, None)
 
 
 def batch_pspecs() -> Any:

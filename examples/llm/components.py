@@ -1,0 +1,104 @@
+"""Example LLM serving components — the SDK counterpart of the reference's
+examples/llm/components/{frontend,processor,worker}.py, built on the native
+TPU engine instead of vLLM.
+
+Services:
+- ``TpuWorker``  — native JAX engine serving token-in/token-out, publishing
+  KV events + metrics (1 TPU chip by default).
+- ``Processor``  — tokenizes OpenAI requests and routes token requests to
+  workers (round-robin here; the HTTP frontend's --router kv does KV-aware
+  routing in the main serving path).
+- ``Frontend``   — entry service; in this deployment the OpenAI HTTP edge
+  runs via ``--http-port`` on the runner, so Frontend only anchors the graph.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Dict
+
+from dynamo_tpu.engine import EngineConfig
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.discovery import make_tokenizer, register_model
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.pipeline import build_pipeline
+from dynamo_tpu.sdk import async_on_start, depends, dynamo_endpoint, service
+
+
+@service(namespace="examples", resources={"tpu": 1})
+class TpuWorker:
+    """Native engine worker (reference: components/worker.py VllmWorker)."""
+
+    def __init__(self, config: Dict[str, Any] | None = None):
+        self.config = config or {}
+        self.engine = None
+
+    @async_on_start
+    async def boot(self) -> None:
+        from dynamo_tpu.engine.engine import TpuEngine
+        from dynamo_tpu.llm.kv_router.publisher import (
+            KvEventPublisher,
+            KvMetricsPublisher,
+        )
+
+        cfg = EngineConfig(
+            model=self.config.get("model", "debug-tiny"),
+            block_size=int(self.config.get("block_size", 16)),
+            num_blocks=int(self.config.get("num_blocks", 256)),
+            max_batch=int(self.config.get("max_batch", 8)),
+            max_model_len=int(self.config.get("max_model_len", 1024)),
+            tp=int(self.config.get("tp", 1)),
+        )
+        self.engine = TpuEngine(cfg)
+        component = self.runtime.namespace("examples").component("TpuWorker")
+        self.engine.set_event_callback(
+            KvEventPublisher(component, self.runtime.worker_id)
+        )
+        self._metrics_pub = await KvMetricsPublisher(
+            component, self.runtime.worker_id, self.engine.metrics
+        ).start()
+        await register_model(
+            self.runtime,
+            self.config.get("served_model_name", "example-model"),
+            "examples/TpuWorker/generate",
+            tokenizer={"kind": "byte"},
+            kv_block_size=cfg.block_size,
+        )
+
+    @dynamo_endpoint
+    async def generate(self, request: Context) -> AsyncIterator[Dict]:
+        stream = await self.engine.generate(request)
+        async for item in stream:
+            yield item
+
+
+@service(namespace="examples")
+class Processor:
+    """Tokenize + forward (reference: components/processor.py)."""
+
+    worker = depends(TpuWorker, endpoint="generate")
+
+    def __init__(self, config: Dict[str, Any] | None = None):
+        self.config = config or {}
+        tokenizer = make_tokenizer({"kind": "byte"})
+        model = self.config.get("served_model_name", "example-model")
+        self._stages = [OpenAIPreprocessor(tokenizer, model), Backend(tokenizer)]
+
+    @dynamo_endpoint
+    async def chat(self, request: Context) -> AsyncIterator[Dict]:
+        pipeline = build_pipeline(list(self._stages), self.worker.client)
+        stream = await pipeline.generate(request)
+        async for item in stream:
+            yield item
+
+
+@service(namespace="examples")
+class Frontend:
+    """Graph entry (reference: components/frontend.py — there it spawns the
+    HTTP binary; here the runner's --http-port serves the OpenAI edge)."""
+
+    processor = depends(Processor, endpoint="chat")
+
+    @dynamo_endpoint
+    async def health(self, request: Context) -> AsyncIterator[Dict]:
+        yield {"ok": True}

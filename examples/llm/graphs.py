@@ -1,0 +1,13 @@
+"""Deployable graphs (reference: examples/llm/graphs/*.py).
+
+- ``agg``        — Frontend → Processor → TpuWorker, single linked graph.
+- ``agg_router`` — same topology; deploy with the runner's ``--router kv``
+  so the HTTP edge routes KV-aware.
+"""
+
+from dynamo_tpu.sdk import Graph
+
+from .components import Frontend, Processor, TpuWorker
+
+agg = Graph(Frontend)
+agg_router = Graph(Frontend)  # pair with: runner --router kv

@@ -1,0 +1,104 @@
+"""Auxiliary subsystem tests: model cards, billing events, metrics
+aggregator + mock worker, llmctl-style registry verbs."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import ClientSession
+
+from dynamo_tpu.llm.billing import BillingEvent, BillingPublisher, TOKEN_EVENTS_SUBJECT
+from dynamo_tpu.llm.discovery import MODEL_PREFIX, register_model
+from dynamo_tpu.llm.metrics_service import MetricsAggregatorService, MockWorker
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime import DistributedRuntime, HubServer
+
+
+@pytest.mark.asyncio
+async def test_model_card_publish_load_list():
+    hub = await HubServer().start()
+    rt = await DistributedRuntime.connect(hub.address)
+    try:
+        card = ModelDeploymentCard(
+            name="m1", context_length=4096, kv_block_size=32,
+            architecture="llama-3.1-8b",
+        )
+        await card.publish(rt)
+        loaded = await ModelDeploymentCard.load(rt, "m1")
+        assert loaded is not None
+        assert loaded.context_length == 4096 and loaded.kv_block_size == 32
+        all_cards = await ModelDeploymentCard.list_all(rt)
+        assert set(all_cards) == {"m1"}
+    finally:
+        await rt.close()
+        await hub.close()
+
+
+def test_model_card_from_local_path(tmp_path):
+    (tmp_path / "config.json").write_text(
+        json.dumps({"max_position_embeddings": 2048})
+    )
+    (tmp_path / "tokenizer_config.json").write_text(
+        json.dumps({"chat_template": "{{ messages }}"})
+    )
+    card = ModelDeploymentCard.from_local_path(str(tmp_path), name="local")
+    assert card.context_length == 2048
+    assert card.prompt_template == "{{ messages }}"
+
+
+@pytest.mark.asyncio
+async def test_billing_events_roundtrip():
+    hub = await HubServer().start()
+    rt = await DistributedRuntime.connect(hub.address)
+    try:
+        ns = rt.namespace("bill")
+        sub = await ns.subscribe(TOKEN_EVENTS_SUBJECT)
+        pub = BillingPublisher(ns)
+        await pub.publish(BillingEvent(10, 20, "m", organization_id="org1"))
+        subject, payload = await asyncio.wait_for(sub.__anext__(), 5)
+        ev = BillingEvent.from_dict(payload)
+        assert (ev.input_tokens, ev.output_tokens, ev.organization_id) == (10, 20, "org1")
+        await sub.aclose()
+    finally:
+        await rt.close()
+        await hub.close()
+
+
+@pytest.mark.asyncio
+async def test_metrics_aggregator_with_mock_worker():
+    hub = await HubServer().start()
+    rt = await DistributedRuntime.connect(hub.address)
+    try:
+        component = rt.namespace("obs").component("worker")
+        service = await MetricsAggregatorService(component, host="127.0.0.1", port=0).start()
+        port = service._runner.addresses[0][1]
+        mock = await MockWorker(component, worker_id=42, interval=0.05).start()
+        await asyncio.sleep(0.3)
+        async with ClientSession() as http:
+            async with http.get(f"http://127.0.0.1:{port}/metrics") as resp:
+                text = await resp.text()
+        assert 'dynamo_tpu_worker_kv_total_blocks{worker_id="42"} 256' in text
+        assert "dynamo_tpu_router_isl_blocks" in text
+        await mock.stop()
+        await service.stop()
+    finally:
+        await rt.close()
+        await hub.close()
+
+
+@pytest.mark.asyncio
+async def test_static_model_registration_survives_registrar():
+    """llmctl-style static registration persists after its runtime closes."""
+    hub = await HubServer().start()
+    rt1 = await DistributedRuntime.connect(hub.address)
+    await register_model(rt1, "static-m", "ns/comp/ep", static=True)
+    await rt1.close()
+    await asyncio.sleep(0.1)
+
+    rt2 = await DistributedRuntime.connect(hub.address)
+    try:
+        kvs = await rt2.hub.kv_get_prefix(MODEL_PREFIX)
+        assert any(e["name"] == "static-m" for e in kvs.values())
+    finally:
+        await rt2.close()
+        await hub.close()

@@ -50,11 +50,10 @@ def test_paged_attention_matches_dense():
     v = jax.random.normal(ks[2], (B, S, KV, D), jnp.float32)
 
     # Scatter k/v into a paged cache with arbitrary (non-contiguous) blocks.
-    kc = jnp.zeros((nblocks * BLOCK, KV, D), jnp.float32)
+    kc = jnp.zeros((KV, nblocks * BLOCK, D), jnp.float32)
     vc = jnp.zeros_like(kc)
-    tables = jnp.array([[3, 0, 6, -1], [5, 1, 2, -1]], jnp.int32)
+    tables = jnp.array([[3, 0, 6, 7], [5, 1, 2, 7]], jnp.int32)
     positions = jnp.tile(jnp.arange(S), (B, 1))
-    slot_map = tables[:, positions // BLOCK] * BLOCK + positions % BLOCK
     slot_map = jnp.take_along_axis(
         tables, positions // BLOCK, axis=1
     ) * BLOCK + positions % BLOCK
@@ -66,13 +65,33 @@ def test_paged_attention_matches_dense():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+def test_decode_attention_impls_agree():
+    """The Pallas decode kernel (interpret mode on CPU) must match the XLA
+    gather path bit-for-bit-ish."""
+    from dynamo_tpu.ops.attention import decode_attention
+
+    key = jax.random.PRNGKey(4)
+    B, H, KV, D = 2, 4, 2, 128  # head_dim 128 = TPU lane width
+    nblocks = 8
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (B, 1, H, D), jnp.float32)
+    kc = jax.random.normal(ks[1], (KV, nblocks * BLOCK, D), jnp.float32)
+    vc = jax.random.normal(ks[2], (KV, nblocks * BLOCK, D), jnp.float32)
+    tables = jnp.array([[3, 0, 6, 1], [5, 1, 2, 4]], jnp.int32)
+    ctx_len = jnp.array([9, 14], jnp.int32)
+
+    ref = decode_attention(q, kc, vc, tables, ctx_len, BLOCK, impl="xla")
+    pal = decode_attention(q, kc, vc, tables, ctx_len, BLOCK, impl="pallas")
+    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref), atol=2e-5)
+
+
 def test_write_kv_drops_padding():
-    kc = jnp.zeros((8, 1, 4), jnp.float32)
+    kc = jnp.zeros((1, 8, 4), jnp.float32)
     vc = jnp.zeros_like(kc)
     k_new = jnp.ones((1, 2, 1, 4))
     slot = jnp.array([[1, -1]], jnp.int32)  # second token is padding
     kc2, _ = write_kv(kc, vc, k_new, k_new, slot)
-    assert float(kc2[1].sum()) == 4.0
+    assert float(kc2[0, 1].sum()) == 4.0
     assert float(kc2.sum()) == 4.0  # nothing else written
 
 

@@ -1,0 +1,134 @@
+"""SDK tests: decorators/meta, graph discovery, config merge, allocator,
+and an in-process end-to-end service graph (mirrors the reference's SDK
+tests deploy/dynamo/sdk/tests/{test_config,test_link,test_e2e}.py)."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from dynamo_tpu.runtime import DistributedRuntime, HubServer
+from dynamo_tpu.runtime.engine import Context, collect
+from dynamo_tpu.sdk import (
+    Graph,
+    ServiceConfigStore,
+    async_on_start,
+    depends,
+    discover_services,
+    dynamo_endpoint,
+    service,
+)
+from dynamo_tpu.sdk.allocator import TpuAllocator
+from dynamo_tpu.sdk.config import ENV_VAR
+from dynamo_tpu.sdk.service import collect_dependencies
+
+
+@service(namespace="sdktest")
+class Lower:
+    @dynamo_endpoint
+    async def transform(self, request: Context):
+        yield {"text": request.data["text"].lower()}
+
+
+@service(namespace="sdktest")
+class Upper:
+    @dynamo_endpoint
+    async def transform(self, request: Context):
+        yield {"text": request.data["text"].upper()}
+
+
+@service(namespace="sdktest", workers=2)
+class Pipeline:
+    stage = depends(Lower, endpoint="transform")
+    started = False
+
+    @async_on_start
+    async def boot(self):
+        type(self).started = True
+
+    @dynamo_endpoint
+    async def run(self, request: Context):
+        stream = await self.stage.generate(request.data)
+        async for item in stream:
+            yield {"text": f"[{item['text']}]"}
+
+
+def test_service_meta_and_discovery():
+    meta = Pipeline._dynamo_meta
+    assert meta.name == "Pipeline" and meta.namespace == "sdktest"
+    assert meta.workers == 2
+    assert "run" in meta.endpoints and "boot" in meta.on_start
+    assert [c.__name__ for c in discover_services(Pipeline)] == ["Pipeline", "Lower"]
+    assert set(collect_dependencies(Pipeline)) == {"stage"}
+
+
+def test_graph_link_adds_edge():
+    g = Graph(Pipeline).link(Pipeline, Upper, endpoint="transform")
+    names = [c.__name__ for c in g.services()]
+    assert "Upper" in names
+
+
+def test_config_store_merge(tmp_path, monkeypatch):
+    cfg = tmp_path / "svc.yaml"
+    cfg.write_text(
+        "Pipeline:\n  workers: 3\n  model: llama-3.1-8b\nLower:\n  x: 1.5\n"
+    )
+    monkeypatch.setenv(ENV_VAR, json.dumps({"Pipeline": {"workers": 4}}))
+    store = ServiceConfigStore.load(str(cfg))
+    assert store.for_service("Pipeline") == {"workers": 4, "model": "llama-3.1-8b"}
+    assert store.for_service("Lower") == {"x": 1.5}
+    # env roundtrip
+    store2 = ServiceConfigStore(json.loads(store.to_env()))
+    assert store2.for_service("Pipeline")["workers"] == 4
+
+
+def test_allocator_assigns_and_oversubscribes():
+    alloc = TpuAllocator(total_chips=4)
+    a = alloc.assign({"tpu": 2})
+    b = alloc.assign({"tpu": 2})
+    assert a.chips == [0, 1] and b.chips == [2, 3]
+    cpu = alloc.assign({})
+    assert cpu.env.get("JAX_PLATFORMS") == "cpu"
+    with pytest.raises(RuntimeError):
+        alloc.assign({"tpu": 1})
+
+
+@pytest.mark.asyncio
+async def test_sdk_graph_end_to_end():
+    """Run Pipeline → Lower in-process via the worker bootstrap logic."""
+    from dynamo_tpu.sdk.worker_main import run_worker  # noqa: F401 (import check)
+
+    hub = await HubServer().start()
+    rts = []
+    try:
+        # Boot each service the way worker_main does, in one process.
+        for cls in reversed(discover_services(Pipeline)):  # deps first
+            rt = await DistributedRuntime.connect(hub.address)
+            rts.append(rt)
+            meta = cls._dynamo_meta
+            inst = cls()
+            inst.runtime = rt
+            for dep in collect_dependencies(cls).values():
+                await dep.resolve(rt)
+            comp = rt.namespace(meta.namespace).component(meta.name)
+            for ep in meta.endpoints:
+                await comp.endpoint(ep).serve_endpoint(getattr(inst, ep))
+            for hook in meta.on_start:
+                await getattr(inst, hook)()
+
+        assert Pipeline.started
+
+        caller = await DistributedRuntime.connect(hub.address)
+        rts.append(caller)
+        client = await (
+            caller.namespace("sdktest").component("Pipeline").endpoint("run").client()
+        )
+        await client.wait_for_instances(5)
+        out = await collect(await client.generate(Context({"text": "HeLLo"})))
+        assert out == [{"text": "[hello]"}]
+        await client.close()
+    finally:
+        for rt in rts:
+            await rt.close()
+        await hub.close()
