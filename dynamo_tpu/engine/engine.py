@@ -165,10 +165,25 @@ class TpuEngine(AsyncEngine):
             (cache, _, _), toks = jax.lax.scan(body, (cache, tok0, pos0), rngs)
             return toks, cache  # toks: [T, B]
 
+        def _inject(cache, slots, k_new, v_new):
+            # Donated in-place scatter: no transient second full-cache copy
+            # in HBM during KV imports (the out-of-jit .at[].set would
+            # materialise one per transferred prompt).  Padding rows carry an
+            # out-of-range slot and are dropped, so callers can bucket the
+            # slot count to bound recompiles.
+            ck = cache.k.at[:, :, slots].set(
+                k_new.astype(cache.k.dtype), mode="drop"
+            )
+            cv = cache.v.at[:, :, slots].set(
+                v_new.astype(cache.v.dtype), mode="drop"
+            )
+            return KVCache(ck, cv)
+
         donate = (1,)
         if self.mesh is None:
             self._step_fn = jax.jit(_step, donate_argnums=donate)
             self._multi_step_fn = jax.jit(_multi_step, donate_argnums=donate)
+            self._inject_fn = jax.jit(_inject, donate_argnums=(0,))
         else:
             cache_sh = sharding_tree(
                 cache, KVCache(cache_pspec(), cache_pspec()), self.mesh
@@ -183,9 +198,14 @@ class TpuEngine(AsyncEngine):
                 donate_argnums=donate,
                 out_shardings=(None, cache_sh),
             )
+            self._inject_fn = jax.jit(
+                _inject, donate_argnums=(0,), out_shardings=cache_sh
+            )
 
     # ------------------------------------------------------------ public API
     async def generate(self, request: Context) -> ResponseStream:
+        if self._closed:
+            raise RuntimeError("engine is closed")
         pre = PreprocessedRequest.from_dict(request.data)
         if len(pre.token_ids) > self.cfg.max_model_len:
             raise ValueError(
@@ -321,11 +341,22 @@ class TpuEngine(AsyncEngine):
         k = np.frombuffer(payload["k"], dtype=dt).reshape(shape)
         v = np.frombuffer(payload["v"], dtype=dt).reshape(shape)
         take = n * self.cfg.block_size
-        slots = jnp.asarray(self._kv_slots(ids))
+        # Pad the slot count to a power-of-two bucket so _inject_fn compiles
+        # once per bucket, not once per distinct imported prompt length.
+        pad = (1 << max(0, (n - 1).bit_length())) * self.cfg.block_size
+        oob = np.int32(self.cfg.num_blocks * self.cfg.block_size)  # dropped
+        slots = np.full((pad,), oob, np.int32)
+        slots[:take] = self._kv_slots(ids)
+        kp = np.zeros(k.shape[:2] + (pad,) + k.shape[3:], k.dtype)
+        vp = np.zeros_like(kp)
+        kp[:, :, :take] = k[:, :, :take]
+        vp[:, :, :take] = v[:, :, :take]
+
         async with self._device_lock:
-            ck = self.cache.k.at[:, :, slots].set(jnp.asarray(k[:, :, :take]))
-            cv = self.cache.v.at[:, :, slots].set(jnp.asarray(v[:, :, :take]))
-            self.cache = KVCache(ck, cv)
+            # to_thread: compile/execute must not stall the engine loop.
+            self.cache = await asyncio.to_thread(
+                self._inject_fn, self.cache, slots, kp, vp
+            )
         for bid, tb in zip(ids, blocks):
             self.kv.seal_block(bid, tb)
         self.kv.free_sequence(ids)
@@ -588,7 +619,7 @@ class TpuEngine(AsyncEngine):
             self._finish(seq, reason)
 
     def _check_stop(self, seq: SequenceState, token: int) -> Optional[FinishReason]:
-        n_out = len(seq.output)
+        n_out = seq.num_output_tokens  # survives preemption's prompt-folding
         min_ok = seq.min_new_tokens is None or n_out >= seq.min_new_tokens
         if min_ok and token in seq.stop_token_ids:
             return FinishReason.STOP
@@ -612,9 +643,9 @@ class TpuEngine(AsyncEngine):
             LLMEngineOutput.finished(
                 reason,
                 usage={
-                    "prompt_tokens": len(seq.prompt),
-                    "completion_tokens": len(seq.output),
-                    "total_tokens": len(seq.prompt) + len(seq.output),
+                    "prompt_tokens": seq.orig_prompt_len,
+                    "completion_tokens": seq.num_output_tokens,
+                    "total_tokens": seq.total_tokens,
                 },
             )
         )

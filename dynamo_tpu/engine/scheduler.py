@@ -42,6 +42,10 @@ class SequenceState:
     ignore_eos: bool = False
 
     output: List[int] = field(default_factory=list)
+    # Original request prompt length.  Preemption folds generated tokens into
+    # ``prompt`` for recompute, so stop checks and usage must count output as
+    # total_tokens - orig_prompt_len, never len(output).
+    orig_prompt_len: int = 0
     block_ids: List[int] = field(default_factory=list)
     num_computed: int = 0  # tokens whose KV is resident
     num_cached_prompt: int = 0  # prefix-cache hit length (metrics)
@@ -49,9 +53,18 @@ class SequenceState:
     # blocks sealed (hash-published) so far — index into block_seq.blocks
     num_sealed_blocks: int = 0
 
+    def __post_init__(self) -> None:
+        if self.orig_prompt_len == 0:
+            self.orig_prompt_len = len(self.prompt)
+
     @property
     def total_tokens(self) -> int:
         return len(self.prompt) + len(self.output)
+
+    @property
+    def num_output_tokens(self) -> int:
+        """Generated tokens across preemptions (see orig_prompt_len)."""
+        return self.total_tokens - self.orig_prompt_len
 
     @property
     def in_prefill(self) -> bool:

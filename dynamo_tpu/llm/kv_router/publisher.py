@@ -38,25 +38,55 @@ class KvEventPublisher:
     """Worker-side: stamp cache events with worker_id and publish them.
 
     Sync-callable (``__call__``) so it can be handed directly to the engine's
-    ``event_callback``; publishes are queued onto the running event loop.
+    ``event_callback``.  Publishes are serialized through one internal queue
+    drained by a single sender task: the indexer depends on Stored arriving
+    before its Removed (the reference preserves this via a single channel,
+    publisher.rs) — independent create_task per event could reorder over a
+    TCP hub.
     """
 
     def __init__(self, component, worker_id: WorkerId):
         self._component = component
         self.worker_id = worker_id
-        self._tasks: set = set()
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._sender: Optional[asyncio.Task] = None
+
+    def _enqueue(self, event: KvCacheEvent) -> "asyncio.Future":
+        done: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._queue.put_nowait(
+            ({"worker_id": self.worker_id, "event": event.to_dict()}, done)
+        )
+        if self._sender is None or self._sender.done():
+            self._sender = asyncio.get_event_loop().create_task(self._drain())
+        return done
 
     def __call__(self, event: KvCacheEvent) -> None:
-        payload = {"worker_id": self.worker_id, "event": event.to_dict()}
-        loop = asyncio.get_event_loop()
-        task = loop.create_task(self._component.publish(KV_EVENTS_TOPIC, payload))
-        self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
+        done = self._enqueue(event)
+        # Fire-and-forget path: failures are logged by _drain; mark the
+        # future's exception as retrieved so it doesn't warn at GC.
+        done.add_done_callback(lambda f: f.exception())
+
+    async def _drain(self) -> None:
+        while not self._queue.empty():
+            payload, done = self._queue.get_nowait()
+            try:
+                await self._component.publish(KV_EVENTS_TOPIC, payload)
+            except Exception as exc:
+                logger.exception("kv event publish failed")
+                if not done.done():
+                    done.set_exception(exc)
+            else:
+                if not done.done():
+                    done.set_result(None)
 
     async def publish(self, event: KvCacheEvent) -> None:
-        await self._component.publish(
-            KV_EVENTS_TOPIC, {"worker_id": self.worker_id, "event": event.to_dict()}
-        )
+        """Awaitable publish that preserves queue ordering AND propagates
+        transport failures to the caller (unlike the fire-and-forget path)."""
+        await self._enqueue(event)
+
+    async def flush(self) -> None:
+        if self._sender is not None and not self._sender.done():
+            await asyncio.shield(self._sender)
 
 
 class KvMetricsPublisher:

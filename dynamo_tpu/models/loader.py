@@ -31,7 +31,15 @@ _LAYER_MAP = {
     "mlp.gate_proj.weight": ("w_gate", True),
     "mlp.up_proj.weight": ("w_up", True),
     "mlp.down_proj.weight": ("w_down", True),
+    # Mixtral MoE router: torch [E, D] → transpose → router [D, E].
+    "block_sparse_moe.gate.weight": ("router", True),
 }
+
+# Mixtral expert sub-keys: block_sparse_moe.experts.{e}.{w}.weight.
+# w1 = gate proj [F, D], w2 = down proj [D, F], w3 = up proj [F, D];
+# all transpose into the [in, out] matmul layout moe_mlp consumes
+# (models/moe.py: moe_gate/moe_up [E, D, F], moe_down [E, F, D]).
+_EXPERT_MAP = {"w1": "moe_gate", "w2": "moe_down", "w3": "moe_up"}
 
 
 def _iter_safetensors(path: str):
@@ -53,8 +61,10 @@ def load_params(config: ModelConfig, path: str, dtype: Any = None) -> Dict[str, 
     import jax.numpy as jnp
 
     dt = jnp.dtype(dtype or config.dtype)
-    L = config.num_layers
+    L, E = config.num_layers, config.num_experts
     per_layer: Dict[str, List[Any]] = {}
+    # MoE expert tensors: name → [L][E] grid, stacked to [L, E, ...] at the end.
+    per_expert: Dict[str, List[List[Any]]] = {}
     params: Dict[str, Any] = {"layers": {}}
 
     def put_layer(name: str, idx: int, value: np.ndarray) -> None:
@@ -71,6 +81,20 @@ def load_params(config: ModelConfig, path: str, dtype: Any = None) -> Dict[str, 
         elif key.startswith("model.layers."):
             rest = key[len("model.layers.") :]
             idx_str, sub = rest.split(".", 1)
+            if sub.startswith("block_sparse_moe.experts."):
+                if not config.is_moe:
+                    raise ValueError(
+                        f"config {config.name!r} is dense but checkpoint has "
+                        f"MoE expert tensors ({key})"
+                    )
+                e_rest = sub[len("block_sparse_moe.experts.") :]
+                e_str, w_key = e_rest.split(".", 1)
+                name = _EXPERT_MAP.get(w_key.removesuffix(".weight"))
+                if name is None:
+                    continue
+                grid = per_expert.setdefault(name, [[None] * E for _ in range(L)])
+                grid[int(idx_str)][int(e_str)] = tensor.T
+                continue
             mapped = _LAYER_MAP.get(sub)
             if mapped is None:
                 continue  # rotary inv_freq buffers etc.
@@ -83,6 +107,26 @@ def load_params(config: ModelConfig, path: str, dtype: Any = None) -> Dict[str, 
             raise ValueError(f"checkpoint missing {name} for layers {missing}")
         params["layers"][name] = jnp.asarray(np.stack(tensors), dt)
 
+    for name, grid in per_expert.items():
+        missing = [
+            (i, e) for i in range(L) for e in range(E) if grid[i][e] is None
+        ]
+        if missing:
+            raise ValueError(f"checkpoint missing {name} for (layer, expert) {missing[:8]}")
+        params["layers"][name] = jnp.asarray(
+            np.stack([np.stack(row) for row in grid]), dt
+        )
+
+    if config.is_moe:
+        # Fail at load, not at first forward's KeyError (a dense checkpoint
+        # loaded into an MoE config would otherwise silently drop experts).
+        needed = {"router", "moe_gate", "moe_up", "moe_down"}
+        absent = needed - set(params["layers"])
+        if absent:
+            raise ValueError(
+                f"config {config.name!r} is MoE ({E} experts) but checkpoint is "
+                f"missing {sorted(absent)} (block_sparse_moe.gate/experts tensors)"
+            )
     if "embed" not in params:
         raise ValueError("checkpoint has no model.embed_tokens.weight")
     if config.tie_word_embeddings:
@@ -104,11 +148,20 @@ def save_params_hf(params: Dict[str, Any], path: str) -> None:
     if "lm_head" in params:
         out["lm_head.weight"] = np.ascontiguousarray(np.asarray(params["lm_head"]).T)
     inv = {v[0]: (k, v[1]) for k, v in _LAYER_MAP.items()}
+    inv_expert = {v: k for k, v in _EXPERT_MAP.items()}
     for name, stacked in params["layers"].items():
+        arr = np.asarray(stacked)
+        if name in inv_expert:
+            hf_w = inv_expert[name]
+            for i in range(arr.shape[0]):
+                for e in range(arr.shape[1]):
+                    out[
+                        f"model.layers.{i}.block_sparse_moe.experts.{e}.{hf_w}.weight"
+                    ] = np.ascontiguousarray(arr[i, e].T)
+            continue
         if name not in inv:
             continue
         hf_sub, transpose = inv[name]
-        arr = np.asarray(stacked)
         for i in range(arr.shape[0]):
             t = arr[i].T if transpose else arr[i]
             out[f"model.layers.{i}.{hf_sub}"] = np.ascontiguousarray(t)

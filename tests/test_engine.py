@@ -221,3 +221,36 @@ def test_kv_manager_shared_refcount():
     assert kv.active_blocks == 3  # still referenced by seq 2
     kv.free_sequence(ids2)
     assert kv.active_blocks == 0
+
+
+def test_engine_generate_after_close_raises():
+    async def main():
+        engine = TpuEngine(EngineConfig(**CFG))
+        await _generate(engine, [1, 2, 3], max_tokens=2)
+        await engine.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            await engine.generate(Context(_req([1, 2, 3])))
+
+    asyncio.run(main())
+
+
+def test_engine_preemption_respects_max_tokens():
+    """A preempted sequence must not restart its output budget: usage and
+    stop checks count generated tokens across preemptions (ADVICE r1)."""
+
+    async def main():
+        cfg = dict(CFG)
+        cfg.update(num_blocks=6, max_batch=2, max_model_len=64)
+        engine = TpuEngine(EngineConfig(**cfg))
+        prompts = [[i + 1, i + 2, i + 3] for i in (0, 10, 20)]
+        results = await asyncio.gather(
+            *[_generate(engine, p, max_tokens=12) for p in prompts]
+        )
+        assert engine.scheduler.preempted > 0, "test needs pool pressure"
+        for toks, final in results:
+            assert len(toks) <= 12
+            assert final["usage"]["completion_tokens"] == len(toks)
+            assert final["usage"]["prompt_tokens"] == 3
+        await engine.close()
+
+    asyncio.run(main())

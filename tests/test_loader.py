@@ -24,3 +24,33 @@ def test_save_load_roundtrip(tmp_path):
     for path, val in flat_a:
         key = jax.tree_util.keystr(path)
         np.testing.assert_array_equal(np.asarray(val), np.asarray(flat_b[key]), err_msg=key)
+
+
+def test_save_load_roundtrip_moe(tmp_path):
+    cfg = get_config("debug-tiny-moe").with_overrides(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    save_params_hf(params, str(tmp_path))
+    loaded = load_params(cfg, str(tmp_path), dtype=jnp.float32)
+
+    flat_a = jax.tree_util.tree_leaves_with_path(params)
+    flat_b = dict(
+        (jax.tree_util.keystr(p), v)
+        for p, v in jax.tree_util.tree_leaves_with_path(loaded)
+    )
+    assert len(flat_a) == len(flat_b)
+    for path, val in flat_a:
+        key = jax.tree_util.keystr(path)
+        np.testing.assert_array_equal(
+            np.asarray(val), np.asarray(flat_b[key]), err_msg=key
+        )
+
+
+def test_moe_config_rejects_dense_checkpoint(tmp_path):
+    import pytest
+
+    dense = get_config("debug-tiny").with_overrides(dtype="float32")
+    params = init_params(dense, jax.random.PRNGKey(0))
+    save_params_hf(params, str(tmp_path))
+    moe = get_config("debug-tiny-moe").with_overrides(dtype="float32")
+    with pytest.raises(ValueError, match="MoE"):
+        load_params(moe, str(tmp_path), dtype=jnp.float32)
