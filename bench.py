@@ -124,17 +124,37 @@ def main() -> None:
         # prefill bucket the timed run hits is already compiled (short osl —
         # warmup cost is compiles, not decode steps).
         await _run(engine, wl["isl"], 4, wl["requests"], model_cfg.vocab_size)
+        engine.step_trace.clear()
         t0 = time.perf_counter()
         total = await _run(
             engine, wl["isl"], wl["osl"], wl["requests"], model_cfg.vocab_size
         )
         dt = time.perf_counter() - t0
+        summary = engine.step_summary()
         await engine.close()
         print(
             f"bench: {total} output tokens in {dt:.2f}s "
             f"({wl['requests']} reqs, isl={wl['isl']} osl={wl['osl']})",
             file=sys.stderr,
         )
+        device_s = sum(v["wall_s"] for v in summary.values())
+        print(
+            f"bench: dispatch summary {json.dumps(summary)}", file=sys.stderr
+        )
+        print(
+            f"bench: host gap {dt - device_s:.2f}s of {dt:.2f}s wall "
+            f"({100 * (dt - device_s) / dt:.0f}%)",
+            file=sys.stderr,
+        )
+        # Decode MFU: 2 * params * tokens / (wall * peak_flops); v5e bf16
+        # peak ~197 TFLOP/s.  Rough param count from config.
+        c = model_cfg
+        p_layer = c.hidden_size * (c.q_size + 2 * c.kv_size + c.q_size) + (
+            3 * c.hidden_size * c.intermediate_size
+        )
+        n_params = c.num_layers * p_layer + 2 * c.vocab_size * c.hidden_size
+        mfu = 2 * n_params * total / (dt * 197e12)
+        print(f"bench: ~{n_params/1e9:.2f}B params, decode MFU {mfu*100:.2f}%", file=sys.stderr)
         return total / dt
 
     tps = asyncio.run(bench())

@@ -43,14 +43,21 @@ class EngineConfig:
     prefill_buckets: List[int] = field(default_factory=list)
     enable_prefix_caching: bool = True
     checkpoint_path: Optional[str] = None  # safetensors dir; None = random init
-    # Decode attention backend: auto (pallas on TPU, xla elsewhere) |
-    # xla | pallas | jax (jax's built-in paged_attention kernel).
+    # Attention backend: auto (ragged pallas kernel on TPU, xla gather
+    # fallback elsewhere) | tpu | xla.
     attn_impl: str = "auto"
     # Decode iterations fused into one device dispatch (lax.scan feeding
     # sampled tokens forward in HBM).  >1 amortises host→device dispatch
     # latency at the cost of token-delivery granularity; essential when the
     # chip is reached over a network tunnel, still useful locally.
     decode_steps: int = 4
+    # Fused decode dispatches kept in flight before their token fetch is
+    # awaited (the sampled-token carry stays ON DEVICE between dispatches, so
+    # chunk k+1 runs while chunk k's tokens stream back).  Hides the full
+    # device→host round trip behind compute; stop conditions are applied with
+    # up to pipeline_depth*decode_steps tokens of lag (over-decoded tokens
+    # are discarded host-side and never corrupt sealed KV blocks).
+    pipeline_depth: int = 2
 
     def __post_init__(self) -> None:
         if not self.batch_buckets:
@@ -77,3 +84,15 @@ class EngineConfig:
             if n <= b:
                 return b
         return self.prefill_buckets[-1]
+
+    @property
+    def max_step_tokens(self) -> int:
+        """Token capacity of one unified (ragged) step: a full prefill
+        budget plus a decode token for every batch slot."""
+        n = self.prefill_chunk + self.max_batch
+        return 1 << (n - 1).bit_length()
+
+    def bucket_tokens(self, n: int) -> int:
+        """Power-of-two token-count bucket for the unified ragged step."""
+        b = max(16, 1 << (max(1, n) - 1).bit_length())
+        return min(b, self.max_step_tokens)

@@ -92,17 +92,17 @@ class SequenceState:
 
 
 @dataclass
-class PrefillWork:
-    """One prefill step: per-seq (state, chunk_start, chunk_len)."""
+class StepPlan:
+    """One unified device step: per-row (state, start, n_tokens).
+
+    Decode rows have n_tokens == 1; prefill rows carry their next prompt
+    chunk.  ``pure_decode`` marks a steady state (every running sequence is
+    decoding, nothing waiting) where the engine can switch to the fused
+    multi-step decode pipeline instead of single unified steps.
+    """
 
     items: List[Tuple[SequenceState, int, int]]
-
-
-@dataclass
-class DecodeWork:
-    """One decode step over running sequences."""
-
-    items: List[SequenceState]
+    pure_decode: bool = False
 
 
 class Scheduler:
@@ -134,22 +134,37 @@ class Scheduler:
             seq.block_ids = []
 
     # --------------------------------------------------------------- planning
-    def schedule(self) -> Optional[PrefillWork | DecodeWork]:
-        """Pick the next device step.  Prefill-priority (matches vLLM default
-        + the reference's TTFT-oriented disagg design): admit/advance prompts
-        first, decode only when no prefill work is pending."""
-        prefill = self._schedule_prefill()
-        if prefill is not None:
-            return prefill
-        return self._schedule_decode()
-
-    def _schedule_prefill(self) -> Optional[PrefillWork]:
+    def schedule(self) -> Optional[StepPlan]:
+        """Plan the next unified device step: decode tokens FIRST (every
+        decoding sequence advances — no ITL starvation behind prefills), then
+        prompt chunks fill the remaining token budget (chunked prefill mixed
+        into the same step, vLLM-chunked-prefill style).  Returns None when
+        nothing is runnable."""
         budget = self.cfg.prefill_chunk
         items: List[Tuple[SequenceState, int, int]] = []
 
-        # Continue part-way prefills already running (chunked prefill).
+        # Decode rows: one token per running decoded sequence.  On block
+        # exhaustion preempt the YOUNGEST running sequence (vLLM recompute
+        # policy: protect older requests' progress) and retry.
+        for seq in [s for s in self.running if not s.in_prefill and not s.finished]:
+            if seq not in self.running:
+                continue  # preempted as a victim below
+            ok = self._ensure_slot(seq)
+            while not ok:
+                victims = [s for s in self.running if s is not seq]
+                if not victims:
+                    break
+                self._preempt(victims[-1])
+                ok = self._ensure_slot(seq)
+            if not ok:
+                self._preempt(seq)
+                continue
+            items.append((seq, seq.num_computed, 1))
+            budget -= 1
+
+        # Prefill continuations (chunked prefill of already-running prompts).
         for seq in self.running:
-            if budget <= 0:
+            if budget <= 0 or len(items) >= self.cfg.max_batch:
                 break
             if seq.in_prefill and not seq.finished:
                 chunk = min(budget, len(seq.prompt) - seq.num_computed)
@@ -157,7 +172,7 @@ class Scheduler:
                 budget -= chunk
 
         # Admit newcomers while slots + blocks + budget allow.
-        while budget > 0 and self.waiting:
+        while budget > 0 and self.waiting and len(items) < self.cfg.max_batch:
             if len(self.running) >= self.cfg.max_batch:
                 break
             seq = self.waiting[0]
@@ -171,13 +186,20 @@ class Scheduler:
                 break
             self.waiting.popleft()
             self.running.append(seq)
-            if seq.in_prefill:
-                chunk = min(budget, len(seq.prompt) - seq.num_computed)
-                items.append((seq, seq.num_computed, chunk))
-                budget -= chunk
-            # else: fully prefix-cached; it will decode next step.
+            # Admission always leaves >= 1 prompt token to compute (a fully
+            # cached prompt still recomputes its last token for logits).
+            chunk = min(budget, len(seq.prompt) - seq.num_computed)
+            items.append((seq, seq.num_computed, chunk))
+            budget -= chunk
 
-        return PrefillWork(items) if items else None
+        if not items:
+            return None
+        pure = (
+            not self.waiting
+            and all(n == 1 for _, _, n in items)
+            and not any(s.in_prefill for s in self.running)
+        )
+        return StepPlan(items, pure_decode=pure)
 
     def _try_admit(self, seq: SequenceState) -> bool:
         """Allocate prompt blocks (sharing any cached prefix)."""
@@ -198,23 +220,11 @@ class Scheduler:
         seq.num_sealed_blocks = cached_tokens // self.cfg.block_size
         return True
 
-    def _schedule_decode(self) -> Optional[DecodeWork]:
-        ready = [s for s in self.running if not s.in_prefill and not s.finished]
-        if not ready:
-            return None
-        # Ensure every decoding seq has a slot for its next position; preempt
-        # the youngest sequences if the pool is dry.
-        for seq in list(reversed(ready)):
-            if not self._ensure_slot(seq):
-                self._preempt(seq)
-                ready.remove(seq)
-        return DecodeWork(ready[: self.cfg.max_batch]) if ready else None
-
-    def _ensure_slot(self, seq: SequenceState) -> bool:
-        # Allocate ahead for the whole fused decode chunk (decode_steps);
-        # the device-side `limits` guard keeps any tail steps past the
-        # allocation from writing.
-        lookahead = max(1, getattr(self.cfg, "decode_steps", 1))
+    def _ensure_slot(self, seq: SequenceState, lookahead: int = 1) -> bool:
+        """Allocate KV blocks so ``lookahead`` tokens past num_computed have
+        slots (the decode pipeline asks for its whole in-flight window; the
+        device-side `limits` guard keeps steps past the allocation from
+        writing)."""
         needed_blocks = min(
             (seq.num_computed + lookahead + self.cfg.block_size - 1)
             // self.cfg.block_size,

@@ -26,11 +26,52 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import decode_attention, paged_attention, write_kv
+from ..ops.ragged_attention import ragged_attention, write_kv_ragged
 from ..ops.rope import apply_rope, rope_frequencies
 from .config import ModelConfig
 from .moe import init_moe_params, moe_mlp
 
 Params = Dict[str, Any]
+
+
+class PagedKVCache(NamedTuple):
+    """Page-major per-layer KV slabs in the TPU ragged-attention layout:
+    ``[num_layers, num_pages, page_size, 2*kv_heads, head_dim]`` with K at
+    even combined-head indices and V at odd (ops/ragged_attention.py).
+    Sequences own pages; a page table maps logical to physical page ids, so
+    any physical order works — allocation never moves data."""
+
+    pages: jnp.ndarray
+
+    @classmethod
+    def create(
+        cls, config: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16
+    ) -> "PagedKVCache":
+        shape = (
+            config.num_layers,
+            num_pages,
+            page_size,
+            2 * config.num_kv_heads,
+            config.head_dim,
+        )
+        return cls(pages=jnp.zeros(shape, dtype))
+
+
+class RaggedBatch(NamedTuple):
+    """One unified step: a flat token run of mixed prefill chunks and decode
+    tokens (static T per bucket; row boundaries via cu_q_lens).
+
+    Padding: tokens at/past cu_q_lens[num_seqs] carry slot -1 (write dropped)
+    and produce zero attention; rows at/past num_seqs have kv_len 0.
+    """
+
+    token_ids: jnp.ndarray  # [T] int32
+    positions: jnp.ndarray  # [T] int32
+    slot_mapping: jnp.ndarray  # [T] int32 (-1 = padding)
+    kv_lens: jnp.ndarray  # [S] int32
+    page_indices: jnp.ndarray  # [S, pages_per_seq] int32
+    cu_q_lens: jnp.ndarray  # [S+1] int32
+    num_seqs: jnp.ndarray  # [1] int32
 
 
 class KVCache(NamedTuple):
@@ -196,3 +237,98 @@ def forward(
         head = params["embed"].T
     logits = (h_last @ head).astype(jnp.float32)  # [B, vocab]
     return logits, KVCache(k_new, v_new)
+
+
+def forward_ragged(
+    params: Params,
+    config: ModelConfig,
+    rb: RaggedBatch,
+    cache: PagedKVCache,
+    *,
+    attn_impl: str = "xla",  # "tpu" (pallas kernel) | "xla" (gather fallback)
+    mesh=None,
+) -> Tuple[jnp.ndarray, PagedKVCache]:
+    """Unified mixed prefill+decode forward over a flat ragged token run.
+
+    Returns (logits [S, vocab] f32 — each row's LAST token's logits — and the
+    updated cache).  Rows past num_seqs produce garbage logits the caller
+    ignores.  One compiled program per token-count bucket serves every
+    prefill/decode mix (the round-2 anti-recompile design; see
+    ops/ragged_attention.py).
+
+    With ``mesh``, the KV write + attention run under shard_map over the
+    "tp" axis: each shard owns its heads' pages, so paged attention is fully
+    local per chip and works with the opaque pallas kernel (XLA's auto-SPMD
+    cannot partition a pallas call).  Everything else (projections, FFN,
+    MoE, logits) auto-shards from the param PartitionSpecs.
+    """
+    (T,) = rb.token_ids.shape
+    H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
+    inv_freq = rope_frequencies(hd, config.rope_theta, config.rope_scaling)
+    scale = hd**-0.5
+
+    def attn_and_write(q, k, v, pages, slots, kv_lens, tables, cu, num):
+        pages = write_kv_ragged(pages, k, v, slots)
+        out = ragged_attention(
+            q,
+            pages,
+            kv_lens,
+            tables,
+            cu,
+            num,
+            sm_scale=scale,
+            impl=attn_impl,
+        )
+        return out, pages
+
+    if mesh is not None:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        heads = P(None, "tp", None)  # [T, heads, hd]
+        pages_s = P(None, None, "tp", None)  # [pages, page_size, 2KV, hd]
+        rep = P()  # ragged metadata: replicated on every shard
+        attn_and_write = shard_map(
+            attn_and_write,
+            mesh=mesh,
+            in_specs=(heads, heads, heads, pages_s, rep, rep, rep, rep, rep),
+            out_specs=(heads, pages_s),
+            # Outputs are tp-sharded only — skip the strict replication
+            # (varying-mesh-axes) check for the dp/ep axes.
+            check_vma=False,
+        )
+
+    h = params["embed"][rb.token_ids]  # [T, D]
+
+    def layer(carry, xs):
+        h = carry
+        lp, pages = xs
+        x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(T, H, hd)
+        k = (x @ lp["wk"]).reshape(T, KV, hd)
+        v = (x @ lp["wv"]).reshape(T, KV, hd)
+        q = apply_rope(q, rb.positions, inv_freq)
+        k = apply_rope(k, rb.positions, inv_freq)
+        attn, pages = attn_and_write(
+            q, k, v, pages, rb.slot_mapping, rb.kv_lens,
+            rb.page_indices, rb.cu_q_lens, rb.num_seqs,
+        )
+        h = h + attn.reshape(T, H * hd) @ lp["wo"]
+        x = rms_norm(h, lp["mlp_norm"], config.rms_norm_eps)
+        if config.is_moe:
+            h = h + moe_mlp(x[None], lp, config)[0]
+        else:
+            gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+            h = h + ((gate * (x @ lp["w_up"])) @ lp["w_down"])
+        return h, pages
+
+    h, pages = jax.lax.scan(layer, h, (params["layers"], cache.pages))
+
+    h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
+    rows = jnp.clip(rb.cu_q_lens[1:] - 1, 0, T - 1)  # [S] last token per row
+    h_last = h[rows]
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = (h_last @ head).astype(jnp.float32)  # [S, vocab]
+    return logits, PagedKVCache(pages)

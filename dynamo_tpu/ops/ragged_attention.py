@@ -1,0 +1,127 @@
+"""Unified ragged paged attention: mixed prefill + decode in ONE kernel call.
+
+This is the engine's core op from round 2 on.  A step is a flat run of
+tokens — any mix of prompt chunks (many tokens of one sequence) and decode
+tokens (one token each) — described by ``cu_q_lens`` row boundaries.  One
+compiled program per *token-count bucket* covers every batch composition,
+which is what keeps XLA recompiles rare (the round-1 design had separate
+prefill/decode programs per (batch, seq-len) bucket pair and still hit
+cold shapes in production mixes).
+
+Two implementations behind one contract:
+- TPU: ``jax.experimental.pallas.ops.tpu.ragged_paged_attention`` — the
+  vLLM-TPU kernel (multi-page async-copy DMA, heads-block grid, online
+  softmax in VMEM).  This is the measured-fastest decode AND prefill path
+  and never materialises O(T · window) logits in HBM.
+- XLA fallback (CPU tests / virtual meshes): static-shape gather + masked
+  softmax.  Memory O(T · window · kv_heads · head_dim) — fine for the tiny
+  test shapes, deliberately not used on real hardware.
+
+Cache layout per layer (kernel contract): ``[num_pages, page_size,
+2 * kv_heads, head_dim]`` with K at even combined-head indices and V at odd.
+Layout reference: the reference's block storage is also page-major slabs
+(lib/llm/src/kv/layer.rs:100-772); the combined-KV interleave is the TPU
+kernel's requirement.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def write_kv_ragged(
+    pages: jnp.ndarray,  # [num_pages, page_size, 2*kv_heads, head_dim]
+    k_new: jnp.ndarray,  # [T, kv_heads, head_dim]
+    v_new: jnp.ndarray,  # [T, kv_heads, head_dim]
+    slot_mapping: jnp.ndarray,  # [T] int32 flat slot ids; -1 = padding (dropped)
+) -> jnp.ndarray:
+    """Scatter new K/V rows into their cache slots (one combined scatter)."""
+    P, ps, KV2, D = pages.shape
+    T = k_new.shape[0]
+    # Interleave to the combined layout: [T, KV, 2, D] -> [T, 2KV, D]
+    # puts k_h at combined index 2h and v_h at 2h+1.
+    comb = jnp.stack([k_new, v_new], axis=2).reshape(T, KV2, D)
+    slots = jnp.where(jnp.asarray(slot_mapping) < 0, P * ps, slot_mapping)
+    flat = pages.reshape(P * ps, KV2, D)
+    flat = flat.at[slots].set(comb.astype(flat.dtype), mode="drop")
+    return flat.reshape(P, ps, KV2, D)
+
+
+def ragged_attention(
+    q: jnp.ndarray,  # [T, num_heads, head_dim]
+    pages: jnp.ndarray,  # [num_pages, page_size, 2*kv_heads, head_dim]
+    kv_lens: jnp.ndarray,  # [S] int32 context length per sequence row
+    page_indices: jnp.ndarray,  # [S, pages_per_seq] int32
+    cu_q_lens: jnp.ndarray,  # [S+1] int32 cumulative query lengths
+    num_seqs: jnp.ndarray,  # [1] int32 valid rows of the above
+    *,
+    sm_scale: float,
+    impl: str = "xla",  # "tpu" | "xla"
+) -> jnp.ndarray:
+    """Causal attention of each token against its sequence's paged context.
+
+    Row i's queries are the LAST (cu_q_lens[i+1]-cu_q_lens[i]) tokens of its
+    kv_lens[i]-token context (their K/V must already be written — callers run
+    write_kv_ragged first).  Tokens at or past cu_q_lens[num_seqs] are
+    padding and produce zeros.
+    """
+    if impl == "tpu":
+        from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
+            ragged_paged_attention,
+        )
+
+        return ragged_paged_attention(
+            q,
+            pages,
+            kv_lens,
+            page_indices,
+            cu_q_lens,
+            num_seqs,
+            sm_scale=sm_scale,
+        )
+    if impl != "xla":
+        raise ValueError(f"unknown ragged attention impl {impl!r}")
+
+    # Coerce metadata to jnp: callers may hand numpy arrays outside jit,
+    # and mixing numpy containers with traced indices fails inside scan.
+    kv_lens = jnp.asarray(kv_lens)
+    page_indices = jnp.asarray(page_indices)
+    cu_q_lens = jnp.asarray(cu_q_lens)
+    num_seqs = jnp.asarray(num_seqs)
+
+    T, H, D = q.shape
+    S, PP = page_indices.shape
+    ps = pages.shape[1]
+    KV = pages.shape[2] // 2
+    G = H // KV
+    W = PP * ps
+
+    tok = jnp.arange(T, dtype=jnp.int32)
+    # Sequence row of each token; padding tokens clamp to the last row and
+    # are masked out below.
+    seq = jnp.searchsorted(cu_q_lens[1:], tok, side="right").astype(jnp.int32)
+    seq = jnp.minimum(seq, S - 1)
+    valid = tok < cu_q_lens[num_seqs[0]]
+    q_len = cu_q_lens[seq + 1] - cu_q_lens[seq]
+    # Global context position of each query token (queries are the tail).
+    qpos = kv_lens[seq] - q_len + (tok - cu_q_lens[seq])
+
+    ctx = jnp.arange(W, dtype=jnp.int32)
+    slots = page_indices[seq][:, ctx // ps] * ps + ctx % ps  # [T, W]
+    kv = pages.reshape(-1, 2 * KV, D)[slots]  # [T, W, 2KV, D]
+    k = kv[:, :, 0::2].astype(jnp.float32)  # [T, W, KV, D]
+    v = kv[:, :, 1::2].astype(jnp.float32)
+
+    qf = q.reshape(T, KV, G, D).astype(jnp.float32) * sm_scale
+    logits = jnp.einsum("tkgd,twkd->tkgw", qf, k)  # [T, KV, G, W]
+    mask = (ctx[None, :] <= qpos[:, None]) & valid[:, None]  # [T, W]
+    logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m) * mask[:, None, None, :]
+    out = jnp.einsum("tkgw,twkd->tkgd", p, v) / (
+        jnp.sum(p, axis=-1, keepdims=True) + 1e-30
+    )
+    return out.reshape(T, H, D).astype(q.dtype)

@@ -11,6 +11,7 @@ from .mesh import (  # noqa: F401
     batch_pspecs,
     cache_pspec,
     make_mesh,
+    pages_pspec,
     param_pspecs,
     shard_tree,
     sharding_tree,

@@ -102,6 +102,13 @@ def cache_pspec() -> P:
     return P(None, "tp", None, None)
 
 
+def pages_pspec() -> P:
+    """PagedKVCache slabs [L, pages, page_size, 2*kv_heads, head_dim]: the
+    combined K/V head axis shards on tp (tp | kv_heads keeps each K/V pair
+    on one shard)."""
+    return P(None, None, None, "tp", None)
+
+
 def batch_pspecs() -> Any:
     """ModelBatch arrays: batch dim shards on dp, rest replicated."""
     from ..models.llama import ModelBatch

@@ -1,0 +1,81 @@
+"""ragged_attention XLA fallback vs jax's reference implementation, and
+write_kv_ragged layout checks (K even / V odd combined heads)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.ops.ragged_attention import ragged_attention, write_kv_ragged
+
+
+def _rand_case(key, T, S, PP, ps, KV, G, D, q_lens, kv_extra):
+    """Build a ragged batch: q_lens per row, kv_lens = q_len + kv_extra."""
+    keys = jax.random.split(key, 3)
+    H = KV * G
+    P = S * PP  # enough distinct pages for disjoint tables
+    q = jax.random.normal(keys[0], (T, H, D), jnp.float32)
+    pages = jax.random.normal(keys[1], (P, ps, 2 * KV, D), jnp.float32)
+    cu = np.zeros(S + 1, np.int32)
+    cu[1 : len(q_lens) + 1] = np.cumsum(q_lens)
+    cu[len(q_lens) + 1 :] = cu[len(q_lens)]
+    kv_lens = np.zeros(S, np.int32)
+    kv_lens[: len(q_lens)] = np.asarray(q_lens) + np.asarray(kv_extra)
+    tables = np.arange(S * PP, dtype=np.int32).reshape(S, PP)
+    num = np.asarray([len(q_lens)], np.int32)
+    return q, pages, jnp.asarray(kv_lens), jnp.asarray(tables), jnp.asarray(cu), jnp.asarray(num)
+
+
+def test_fallback_matches_reference():
+    from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
+        ref_ragged_paged_attention,
+    )
+
+    T, S, PP, ps, KV, G, D = 24, 4, 3, 4, 2, 2, 16
+    q, pages, kv_lens, tables, cu, num = _rand_case(
+        jax.random.PRNGKey(0), T, S, PP, ps, KV, G, D,
+        q_lens=[5, 1, 8, 1], kv_extra=[3, 6, 0, 11],
+    )
+    scale = D**-0.5
+    got = ragged_attention(
+        q, pages, kv_lens, tables, cu, num, sm_scale=scale, impl="xla"
+    )
+    want = ref_ragged_paged_attention(
+        q, pages, kv_lens, tables, cu, num, sm_scale=scale
+    )
+    n_valid = int(cu[num[0]])
+    np.testing.assert_allclose(
+        np.asarray(got)[:n_valid], np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+    # Padding tokens produce zeros.
+    np.testing.assert_array_equal(np.asarray(got)[n_valid:], 0.0)
+
+
+def test_fallback_under_jit_and_empty_rows():
+    T, S, PP, ps, KV, G, D = 8, 3, 2, 2, 1, 2, 8
+    q, pages, kv_lens, tables, cu, num = _rand_case(
+        jax.random.PRNGKey(1), T, S, PP, ps, KV, G, D,
+        q_lens=[2, 1], kv_extra=[1, 0],
+    )
+    f = jax.jit(
+        lambda *a: ragged_attention(*a, sm_scale=D**-0.5, impl="xla")
+    )
+    out = f(q, pages, kv_lens, tables, cu, num)
+    assert out.shape == (T, KV * G, D)
+    assert not np.any(np.isnan(np.asarray(out)))
+
+
+def test_write_kv_ragged_interleave():
+    P, ps, KV, D, T = 3, 2, 2, 4, 4
+    pages = jnp.zeros((P, ps, 2 * KV, D), jnp.float32)
+    k = jnp.arange(T * KV * D, dtype=jnp.float32).reshape(T, KV, D)
+    v = -jnp.arange(T * KV * D, dtype=jnp.float32).reshape(T, KV, D)
+    slots = jnp.asarray([0, 3, 5, -1], jnp.int32)  # one padding row
+    out = write_kv_ragged(pages, k, v, slots)
+    flat = np.asarray(out).reshape(P * ps, 2 * KV, D)
+    np.testing.assert_array_equal(flat[0, 0::2], np.asarray(k[0]))
+    np.testing.assert_array_equal(flat[0, 1::2], np.asarray(v[0]))
+    np.testing.assert_array_equal(flat[3, 0::2], np.asarray(k[1]))
+    np.testing.assert_array_equal(flat[5, 1::2], np.asarray(v[2]))
+    # Padding slot -1 dropped; untouched slots stay zero.
+    np.testing.assert_array_equal(flat[1], 0.0)
+    np.testing.assert_array_equal(flat[4], 0.0)
