@@ -74,6 +74,15 @@ class TpuEngine(AsyncEngine):
         self.model_config: ModelConfig = get_config(cfg.model).with_overrides(
             dtype=cfg.dtype
         )
+        if cfg.tp > 1 and self.model_config.num_kv_heads % cfg.tp != 0:
+            # pages_pspec shards the combined 2*kv_heads axis over tp; a tp
+            # that doesn't divide num_kv_heads would split a K/V pair of one
+            # head across shards (XLA's divisibility check alone would let
+            # e.g. tp == 2*num_kv_heads through).
+            raise ValueError(
+                f"tp={cfg.tp} must divide num_kv_heads="
+                f"{self.model_config.num_kv_heads} (KV pages shard by head)"
+            )
         self.kv = KvBlockManager(
             cfg.num_blocks,
             cfg.block_size,
@@ -572,11 +581,9 @@ class TpuEngine(AsyncEngine):
                         len(seq.block_ids) * bs,
                         cfg.max_blocks_per_seq * bs,
                     )
-                if not ok and len(inflight) > 0:
-                    rebuild = True  # drain, then let the scheduler preempt
-                    break
-                if not ok and not inflight:
-                    # Nothing in flight: safe to let schedule() preempt now.
+                if not ok:
+                    # Out of KV headroom: drain any in-flight work, then
+                    # return so schedule() can preempt with nothing pending.
                     rebuild = True
                     break
                 rngs = jax.random.split(self._next_rng(), T)

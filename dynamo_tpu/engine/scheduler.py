@@ -145,21 +145,31 @@ class Scheduler:
 
         # Decode rows: one token per running decoded sequence.  On block
         # exhaustion preempt the YOUNGEST running sequence (vLLM recompute
-        # policy: protect older requests' progress) and retry.
+        # policy: protect older requests' progress) and retry.  Victims must
+        # come from sequences NOT yet scheduled this step: preempting one
+        # already in ``items`` would leave a stale row whose blocks were
+        # freed (block_ids=[]) and crash _build_ragged downstream.
+        scheduled: set = set()
         for seq in [s for s in self.running if not s.in_prefill and not s.finished]:
             if seq not in self.running:
                 continue  # preempted as a victim below
             ok = self._ensure_slot(seq)
             while not ok:
-                victims = [s for s in self.running if s is not seq]
+                victims = [
+                    s
+                    for s in self.running
+                    if s is not seq and id(s) not in scheduled
+                ]
                 if not victims:
                     break
                 self._preempt(victims[-1])
                 ok = self._ensure_slot(seq)
             if not ok:
+                # No unscheduled victim left: self-preempt and recompute later.
                 self._preempt(seq)
                 continue
             items.append((seq, seq.num_computed, 1))
+            scheduled.add(id(seq))
             budget -= 1
 
         # Prefill continuations (chunked prefill of already-running prompts).

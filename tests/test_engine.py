@@ -254,3 +254,51 @@ def test_engine_preemption_respects_max_tokens():
         await engine.close()
 
     asyncio.run(main())
+
+
+def test_scheduler_never_preempts_already_scheduled_rows():
+    """ADVICE r2 (high): block-exhaustion preemption must not victimize a
+    sequence already planned into this step — its freed blocks (block_ids=[])
+    would leave a stale item that crashes _build_ragged and fails every
+    in-flight request.  With running=[A(slot ok), B(needs a block)] and the
+    pool dry, B must self-preempt, never preempt A."""
+    from dynamo_tpu.engine.scheduler import Scheduler, SequenceState
+    from dynamo_tpu.tokens import TokenBlockSequence
+
+    cfg = EngineConfig(
+        model="debug-tiny",
+        block_size=4,
+        num_blocks=3,
+        max_batch=4,
+        max_model_len=64,
+        prefill_chunk=32,
+        dtype="float32",
+    )
+    kv = KvBlockManager(3, 4)
+    sched = Scheduler(cfg, kv)
+
+    def mk(rid, n_blocks, num_computed):
+        seq = SequenceState(
+            request_id=rid,
+            prompt=[1, 2, 3, 4],
+            block_seq=TokenBlockSequence(block_size=4),
+            num_computed=num_computed,
+        )
+        seq.output = [42]  # decoding: one sampled token pending
+        seq.block_ids = [kv.allocate_block() for _ in range(n_blocks)]
+        assert all(b is not None for b in seq.block_ids)
+        return seq
+
+    a = mk("a", 2, 4)  # slot for position 4 already allocated
+    b = mk("b", 1, 4)  # position 4 needs a 2nd block; pool is dry
+    sched.running = [a, b]
+    assert kv.free_blocks == 0
+
+    plan = sched.schedule()
+    assert plan is not None
+    for seq, start, n in plan.items:
+        assert seq in sched.running
+        assert seq.block_ids, f"{seq.request_id} scheduled with freed blocks"
+        assert len(seq.block_ids) * cfg.block_size >= start + n
+    assert [s.request_id for s, _, _ in plan.items] == ["a"]
+    assert b in sched.waiting and sched.preempted == 1
