@@ -214,6 +214,90 @@ class TpuEngine(AsyncEngine):
                 _inject, donate_argnums=(0,), out_shardings=cache_sh
             )
 
+    # ---------------------------------------------------------------- warmup
+    def compile_counts(self) -> Dict[str, int]:
+        """Compiled-program count per jitted entry (cache sizes).  The bench
+        asserts these do not grow inside its timed window."""
+        out: Dict[str, int] = {}
+        for name, fn in (
+            ("step", self._step_fn),
+            ("multi", self._multi_fn),
+            ("inject", self._inject_fn),
+        ):
+            try:
+                out[name] = fn._cache_size()
+            except AttributeError:  # older jax: best-effort
+                out[name] = -1
+        return out
+
+    def reachable_token_buckets(self) -> List[int]:
+        """Every token bucket the scheduler can hand _run_unified: decode
+        rows and prefill chunks share one prefill_chunk budget, so totals
+        range 1..max(prefill_chunk, max_batch)."""
+        hi = self.cfg.bucket_tokens(max(self.cfg.prefill_chunk, self.cfg.max_batch))
+        buckets, b = [], self.cfg.bucket_tokens(1)
+        while b < hi:
+            buckets.append(b)
+            b *= 2
+        buckets.append(hi)
+        return buckets
+
+    def warmup(self) -> Dict[str, int]:
+        """Pre-compile every device program the serving loop can dispatch —
+        one unified step per reachable token bucket plus the fused decode
+        program — so no cold XLA compile (~15s on TPU) ever lands inside a
+        request.  All runs carry slot/pos = -1 so cache writes are dropped
+        (write_kv_ragged) and contents are untouched.  Returns compile_counts.
+        """
+        cfg = self.cfg
+        S, PP = cfg.max_batch, cfg.max_blocks_per_seq
+        temp = np.zeros((S,), np.float32)
+        topk = np.zeros((S,), np.int32)
+        topp = np.ones((S,), np.float32)
+        rng = jax.random.PRNGKey(0)
+        for T in self.reachable_token_buckets():
+            cu = np.zeros((S + 1,), np.int32)
+            cu[1:] = T  # one row owns every token; others empty
+            rb = RaggedBatch(
+                token_ids=np.zeros((T,), np.int32),
+                positions=np.zeros((T,), np.int32),
+                slot_mapping=np.full((T,), -1, np.int32),  # writes dropped
+                # kv_len == q_len: the ragged contract (and the pallas
+                # kernel's validation) requires q_len <= kv_len per row.
+                kv_lens=np.asarray([T] + [0] * (S - 1), np.int32),
+                page_indices=np.zeros((S, PP), np.int32),
+                cu_q_lens=cu,
+                num_seqs=np.asarray([1], np.int32),
+            )
+            tokens, self.cache = self._step_fn(
+                self.params, self.cache, rb, temp, topk, topp, rng
+            )
+        if cfg.decode_steps > 1:
+            rngs = jax.random.split(rng, cfg.decode_steps)
+            args = (
+                np.full((S,), -1, np.int32),  # every row inactive
+                np.zeros((S, PP), np.int32),
+                np.zeros((S,), np.int32),
+                temp,
+                topk,
+                topp,
+                rngs,
+            )
+            _, last, self.cache = self._multi_fn(
+                self.params, self.cache, np.zeros((S,), np.int32), *args
+            )
+            # Chain once more with the DEVICE carry as tok0: pipeline
+            # dispatches 2+ feed the previous output back in, and a committed
+            # device array keys a different executable-cache entry than the
+            # uncommitted numpy first dispatch.
+            _, last, self.cache = self._multi_fn(
+                self.params, self.cache, last, *args
+            )
+            last.block_until_ready()
+        else:
+            tokens.block_until_ready()
+        return self.compile_counts()
+
     # ------------------------------------------------------------ public API
     async def generate(self, request: Context) -> ResponseStream:
         if self._closed:
@@ -566,6 +650,15 @@ class TpuEngine(AsyncEngine):
         while True:
             # Top up the dispatch window.
             while not rebuild and len(inflight) < cfg.pipeline_depth:
+                # Don't dispatch chunks no row can still use: once every
+                # member's in-flight frontier covers its remaining token
+                # budget, further chunks are pure waste (their tokens would
+                # all be discarded host-side).  Checked BEFORE allocating
+                # lookahead blocks below — a never-dispatched chunk must not
+                # take KV capacity from other sequences.
+                if not self._any_useful_rows(members, pos_disp):
+                    rebuild = True
+                    break
                 # Ensure every active member has KV room for this chunk.
                 limits = np.zeros((S,), np.int32)
                 ok = True
@@ -602,6 +695,14 @@ class TpuEngine(AsyncEngine):
                 self.step_trace.append(
                     ("decode_dispatch", time.perf_counter() - t0, n, n * T)
                 )
+                # Start the D2H copy NOW: it proceeds in the background while
+                # later chunks compute, so the drain fetch below pays ~zero
+                # round-trip instead of compute + full link latency (round-2
+                # measured 323ms per serial fetch over the tunneled chip).
+                try:
+                    toks_dev.copy_to_host_async()
+                except AttributeError:
+                    pass
                 inflight.append((toks_dev, pos0))
                 dispatched_any = True
                 pos_disp = np.where(pos_disp >= 0, pos_disp + T, pos_disp)
@@ -647,6 +748,23 @@ class TpuEngine(AsyncEngine):
         for seq in finished_members:
             self.scheduler.remove(seq)
         return dispatched_any
+
+    def _any_useful_rows(
+        self, members: List[SequenceState], pos_disp: np.ndarray
+    ) -> bool:
+        """True if any active member could still accept a token from one more
+        fused chunk, given how far its dispatch frontier already overshoots
+        its accepted position (in-flight tokens count against the budget)."""
+        for i, seq in enumerate(members):
+            if seq.finished or pos_disp[i] < 0:
+                continue
+            overshoot = int(pos_disp[i]) - seq.num_computed
+            budget = self.cfg.max_model_len - seq.total_tokens
+            if seq.max_new_tokens is not None:
+                budget = min(budget, seq.max_new_tokens - seq.num_output_tokens)
+            if budget - overshoot > 0:
+                return True
+        return False
 
     # ------------------------------------------------------------ per-token
     def _seal_completed_blocks(self, seq: SequenceState) -> None:

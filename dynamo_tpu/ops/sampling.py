@@ -3,14 +3,53 @@
 All requests in a decode batch sample in one fused op with per-request
 parameters as arrays — no host round-trip per request.  temperature == 0
 means greedy regardless of the other knobs.
+
+Cost shape matters here: this runs inside every decode step, and a full-vocab
+sort (bitonic on TPU) of [B, 128k] costs more than an entire memory-bound
+decode layer.  So the filtered path uses ONE sort (top-k and top-p both read
+the same descending-sorted copy), and runtime ``lax.cond`` branches skip the
+sort entirely when no row needs filtering and skip sampling when every row is
+greedy — HLO conditionals execute only the taken branch on device.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 NEG_INF = -1e30
+
+
+def _filtered_logits(
+    scaled: jnp.ndarray,  # [B, V] temperature-scaled logits
+    top_k: jnp.ndarray,  # [B] int32; 0 → disabled
+    top_p: jnp.ndarray,  # [B] f32; 1.0 → disabled
+) -> jnp.ndarray:
+    """Apply top-k then top-p masks using a single descending sort."""
+    B, V = scaled.shape
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V]
+
+    # top-k: mask everything below the k-th largest logit.
+    k = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)  # [B, 1]
+
+    # The top-k-masked copy stays sorted: positions >= k become NEG_INF.
+    idx = jnp.arange(V, dtype=jnp.int32)[None, :]
+    sorted_masked = jnp.where(idx < k[:, None], sorted_desc, NEG_INF)
+
+    # top-p: keep the smallest prefix of the sorted distribution with
+    # cumulative probability >= top_p (the kept set always includes argmax).
+    probs_sorted = jax.nn.softmax(sorted_masked, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    cutoff_count = jnp.sum(cum - probs_sorted < top_p[:, None], axis=-1)  # [B]
+    cutoff_count = jnp.clip(cutoff_count, 1, V)
+    thresh = jnp.take_along_axis(
+        sorted_masked, (cutoff_count - 1)[:, None], axis=-1
+    )
+
+    scaled = jnp.where(scaled >= kth, scaled, NEG_INF)
+    return jnp.where(scaled >= thresh, scaled, NEG_INF)
 
 
 def sample_tokens(
@@ -23,26 +62,22 @@ def sample_tokens(
     """Returns sampled token ids [B] int32."""
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
     temp = jnp.maximum(temperature, 1e-6)[:, None]
-    scaled = logits / temp
 
-    # top-k: mask everything below the k-th largest logit.
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V]
-    k = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
-    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)  # [B, 1]
-    scaled = jnp.where(scaled >= kth, scaled, NEG_INF)
+    def sample_filtered() -> jnp.ndarray:
+        scaled = _filtered_logits(logits / temp, top_k, top_p)
+        sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+        return jnp.where(temperature <= 0.0, greedy, sampled)
 
-    # top-p: keep the smallest prefix of the sorted distribution with
-    # cumulative probability >= top_p (the kept set always includes argmax).
-    probs_sorted = jax.nn.softmax(jnp.sort(scaled, axis=-1)[:, ::-1], axis=-1)
-    cum = jnp.cumsum(probs_sorted, axis=-1)
-    cutoff_count = jnp.sum(cum - probs_sorted < top_p[:, None], axis=-1)  # [B]
-    cutoff_count = jnp.clip(cutoff_count, 1, V)
-    thresh = jnp.take_along_axis(
-        jnp.sort(scaled, axis=-1)[:, ::-1], (cutoff_count - 1)[:, None], axis=-1
+    def sample_plain() -> jnp.ndarray:
+        sampled = jax.random.categorical(rng, logits / temp, axis=-1)
+        return jnp.where(temperature <= 0.0, greedy, sampled.astype(jnp.int32))
+
+    need_filter = jnp.any(
+        (temperature > 0.0) & ((top_k > 0) | (top_p < 1.0))
     )
-    scaled = jnp.where(scaled >= thresh, scaled, NEG_INF)
-
-    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
-    return jnp.where(temperature <= 0.0, greedy, sampled)
+    return lax.cond(
+        jnp.any(temperature > 0.0),
+        lambda: lax.cond(need_filter, sample_filtered, sample_plain),
+        lambda: greedy,
+    )
