@@ -78,6 +78,28 @@ async def _run(args) -> None:
     engine, level = _build_engine(args.out, args)
     tokenizer = make_tokenizer(_tokenizer_spec(args))
 
+    # Multi-host: followers only replay the leader's dispatch stream; the
+    # leader broadcasts every dispatch before enqueueing its own.
+    nnodes = getattr(args, "nnodes", 1)
+    if nnodes > 1:
+        from .engine.multihost import StepPublisher, follower_serve
+
+        if not hasattr(engine, "mirror_step"):
+            raise SystemExit("--nnodes > 1 requires out=tpu")
+        if getattr(args, "node_rank", 0) > 0:
+            leader_host = args.coordinator.rsplit(":", 1)[0]
+            print(
+                f"follower node {args.node_rank}/{nnodes} replaying "
+                f"{leader_host}:{args.step_port}",
+                flush=True,
+            )
+            await follower_serve(engine, f"{leader_host}:{args.step_port}")
+            return
+        publisher = await StepPublisher(
+            "0.0.0.0", args.step_port, nnodes - 1
+        ).start()
+        engine.attach_publisher(publisher)
+
     if inp == "http":
         service = HttpService(host=args.host, port=args.port)
         if level == "core":
@@ -325,6 +347,26 @@ def main(argv: Optional[list] = None) -> None:
         dest="max_local_prefill",
         help="prefills longer than this (minus prefix hit) go remote",
     )
+    # multi-host scale-out (reference: MultiNodeConfig, engines.rs:40-105)
+    p_run.add_argument(
+        "--nnodes", type=int, default=1, help="total hosts in this engine"
+    )
+    p_run.add_argument(
+        "--node-rank", type=int, default=0, dest="node_rank",
+        help="this host's rank (0 = leader)",
+    )
+    p_run.add_argument(
+        "--coordinator", default="",
+        help="host:port of rank 0's jax.distributed coordinator",
+    )
+    p_run.add_argument(
+        "--step-port", type=int, default=6651, dest="step_port",
+        help="leader port for the follower dispatch stream",
+    )
+    p_run.add_argument(
+        "--cpu-devices", type=int, default=None, dest="cpu_devices",
+        help="TEST ONLY: use N virtual CPU devices per process",
+    )
 
     p_model = sub.add_parser("model", help="model registry (llmctl equivalent)")
     p_model.add_argument("verb", choices=["add", "list", "remove"])
@@ -358,6 +400,18 @@ def main(argv: Optional[list] = None) -> None:
         if "in" not in kv or "out" not in kv:
             raise SystemExit("run requires in=… out=…")
         args.inp, args.out = kv["in"], kv["out"]
+        if args.nnodes > 1 or args.cpu_devices:
+            # Must run before anything initializes a jax backend.
+            from .parallel.distributed import MultiHostConfig, init_multihost
+
+            init_multihost(
+                MultiHostConfig(
+                    coordinator=args.coordinator,
+                    nnodes=args.nnodes,
+                    node_rank=args.node_rank,
+                    cpu_devices=args.cpu_devices,
+                )
+            )
 
     try:
         if args.cmd == "hub":

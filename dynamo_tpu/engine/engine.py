@@ -100,6 +100,10 @@ class TpuEngine(AsyncEngine):
         self._device_lock = asyncio.Lock()
         self._rng = jax.random.PRNGKey(cfg.seed)
         self._steps = 0
+        # Multi-host: leader broadcasts every dispatch over this plane so
+        # followers keep their device queues in SPMD lockstep (multihost.py).
+        self._publisher = None
+        self._mirror_carry: Any = None
         # Per-dispatch trace: (kind, wall_s, rows, device_tokens); the
         # pipeline records dispatch and fetch separately since they overlap.
         self.step_trace: List[Tuple[str, float, int, int]] = []
@@ -107,6 +111,19 @@ class TpuEngine(AsyncEngine):
         # --- device state -------------------------------------------------
         mesh_cfg = MeshConfig(dp=cfg.dp, tp=cfg.tp, ep=cfg.ep)
         self.mesh = make_mesh(mesh_cfg) if mesh_cfg.num_devices > 1 else None
+        # In a multi-process (multi-host) run, host-side step inputs must be
+        # assembled into replicated GLOBAL arrays before they can feed a jit
+        # over the global mesh.
+        self._rep_sharding = None
+        if jax.process_count() > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            if self.mesh is None:
+                raise ValueError(
+                    "multi-process run needs a device mesh (dp*tp*ep == "
+                    f"global devices, got {mesh_cfg.num_devices})"
+                )
+            self._rep_sharding = NamedSharding(self.mesh, PartitionSpec())
         if params is None:
             if cfg.checkpoint_path:
                 from ..models.loader import load_params
@@ -214,6 +231,77 @@ class TpuEngine(AsyncEngine):
                 _inject, donate_argnums=(0,), out_shardings=cache_sh
             )
 
+    # ------------------------------------------------------------ multi-host
+    def attach_publisher(self, publisher) -> None:
+        """Leader side: broadcast every device dispatch to the followers
+        (engine/multihost.py StepPublisher)."""
+        self._publisher = publisher
+
+    def _prep(self, tree: Any) -> Any:
+        """Host arrays → replicated global arrays when multi-process."""
+        if self._rep_sharding is None:
+            return tree
+        from ..parallel.distributed import global_array
+
+        return jax.tree_util.tree_map(
+            lambda x: global_array(x, self._rep_sharding), tree
+        )
+
+    async def run_warmup(self) -> Dict[str, int]:
+        """warmup() that keeps followers in lockstep (use in serving paths;
+        plain warmup() is fine single-process)."""
+        async with self._device_lock:
+            if self._publisher is not None:
+                await self._publisher.publish("warmup")
+            return await asyncio.to_thread(self.warmup)
+
+    async def mirror_step(self, kind: str, payload: Tuple) -> None:
+        """Follower side: replay one leader dispatch (same jitted fns, same
+        global arrays, same order → SPMD lockstep)."""
+        if kind == "warmup":
+            await asyncio.to_thread(self.warmup)
+        elif kind == "unified":
+            rb, temp, topk, topp, rng = payload
+
+            def run_u():
+                _, self.cache = self._step_fn(
+                    self.params,
+                    self.cache,
+                    self._prep(rb),
+                    *self._prep((temp, topk, topp, rng)),
+                )
+
+            async with self._device_lock:
+                await asyncio.to_thread(run_u)
+        elif kind == "multi":
+            tok0, pos0, tables, limits, temp, topk, topp, rngs = payload
+            carry = self._mirror_carry if tok0 is None else None
+
+            def run_m():
+                tok = self._prep(tok0) if carry is None else carry
+                _, new_carry, self.cache = self._multi_fn(
+                    self.params,
+                    self.cache,
+                    tok,
+                    *self._prep((pos0, tables, limits, temp, topk, topp, rngs)),
+                )
+                return new_carry
+
+            async with self._device_lock:
+                self._mirror_carry = await asyncio.to_thread(run_m)
+        elif kind == "inject":
+            page_ids, comb_p = payload
+
+            def run_i():
+                self.cache = self._inject_fn(
+                    self.cache, *self._prep((page_ids, comb_p))
+                )
+
+            async with self._device_lock:
+                await asyncio.to_thread(run_i)
+        else:
+            raise ValueError(f"unknown mirror step kind {kind!r}")
+
     # ---------------------------------------------------------------- warmup
     def compile_counts(self) -> Dict[str, int]:
         """Compiled-program count per jitted entry (cache sizes).  The bench
@@ -255,6 +343,8 @@ class TpuEngine(AsyncEngine):
         topk = np.zeros((S,), np.int32)
         topp = np.ones((S,), np.float32)
         rng = jax.random.PRNGKey(0)
+        if self._rep_sharding is not None:
+            rng = self._prep(np.asarray(rng))
         for T in self.reachable_token_buckets():
             cu = np.zeros((S + 1,), np.int32)
             cu[1:] = T  # one row owns every token; others empty
@@ -270,21 +360,27 @@ class TpuEngine(AsyncEngine):
                 num_seqs=np.asarray([1], np.int32),
             )
             tokens, self.cache = self._step_fn(
-                self.params, self.cache, rb, temp, topk, topp, rng
+                self.params, self.cache, self._prep(rb),
+                *self._prep((temp, topk, topp)), rng
             )
         if cfg.decode_steps > 1:
             rngs = jax.random.split(rng, cfg.decode_steps)
-            args = (
-                np.full((S,), -1, np.int32),  # every row inactive
-                np.zeros((S, PP), np.int32),
-                np.zeros((S,), np.int32),
-                temp,
-                topk,
-                topp,
-                rngs,
+            args = self._prep(
+                (
+                    np.full((S,), -1, np.int32),  # every row inactive
+                    np.zeros((S, PP), np.int32),
+                    np.zeros((S,), np.int32),
+                    temp,
+                    topk,
+                    topp,
+                    np.asarray(rngs) if self._rep_sharding is not None else rngs,
+                )
             )
             _, last, self.cache = self._multi_fn(
-                self.params, self.cache, np.zeros((S,), np.int32), *args
+                self.params,
+                self.cache,
+                self._prep(np.zeros((S,), np.int32)),
+                *args,
             )
             # Chain once more with the DEVICE carry as tok0: pipeline
             # dispatches 2+ feed the previous output back in, and a committed
@@ -353,6 +449,9 @@ class TpuEngine(AsyncEngine):
         if self._loop_task is not None:
             await self._loop_task
             self._loop_task = None
+        if self._publisher is not None:
+            await self._publisher.close()
+            self._publisher = None
         # Fail whatever is still in flight so no generate() stream hangs.
         self._fail_all()
 
@@ -444,9 +543,13 @@ class TpuEngine(AsyncEngine):
         comb_p[:, :n] = comb
 
         async with self._device_lock:
+            # Publish under the device lock (broadcast order == enqueue
+            # order; see _run_unified).
+            if self._publisher is not None:
+                await self._publisher.publish("inject", (page_ids, comb_p))
             # to_thread: compile/execute must not stall the engine loop.
             self.cache = await asyncio.to_thread(
-                self._inject_fn, self.cache, page_ids, comb_p
+                self._inject_fn, self.cache, *self._prep((page_ids, comb_p))
             )
         for bid, tb in zip(ids, blocks):
             self.kv.seal_block(bid, tb)
@@ -575,16 +678,30 @@ class TpuEngine(AsyncEngine):
         rb = self._build_ragged(plan.items)
         temp, topk, topp = self._sampling_arrays([s for s, _, _ in plan.items])
         rng = self._next_rng()
+        if self._rep_sharding is not None:
+            rng_np = np.asarray(rng)
+            rb_d, temp_d, topk_d, topp_d, rng_d = self._prep(
+                (rb, temp, topk, topp, rng_np)
+            )
+        else:
+            rb_d, temp_d, topk_d, topp_d, rng_d = rb, temp, topk, topp, rng
         step = self._step_fn
 
         def run() -> np.ndarray:
             tokens_dev, self.cache = step(
-                self.params, self.cache, rb, temp, topk, topp, rng
+                self.params, self.cache, rb_d, temp_d, topk_d, topp_d, rng_d
             )
             return np.asarray(tokens_dev)
 
         t0 = time.perf_counter()
         async with self._device_lock:
+            # Publish INSIDE the device lock: broadcast order must equal
+            # device enqueue order or followers replay a different program
+            # sequence than the leader ran (SPMD divergence).
+            if self._publisher is not None:
+                await self._publisher.publish(
+                    "unified", (rb, temp, topk, topp, np.asarray(rng))
+                )
             sampled = await asyncio.to_thread(run)
         self.step_trace.append(
             ("unified", time.perf_counter() - t0, len(plan.items), len(rb.token_ids))
@@ -681,16 +798,39 @@ class TpuEngine(AsyncEngine):
                     break
                 rngs = jax.random.split(self._next_rng(), T)
                 pos0 = pos_disp.copy()
+                first = isinstance(carry_tok, np.ndarray)
+                pub_payload = (
+                    carry_tok if first else None,  # None → follower's carry
+                    pos0,
+                    tables.copy(),
+                    limits,
+                    temp,
+                    topk,
+                    topp,
+                    np.asarray(rngs),
+                )
+                if self._rep_sharding is not None:
+                    if first:
+                        carry_tok = self._prep(carry_tok)
+                    d_args = self._prep(
+                        (pos0, tables.copy(), limits, temp, topk, topp,
+                         np.asarray(rngs))
+                    )
+                else:
+                    d_args = (pos0, tables, limits, temp, topk, topp, rngs)
 
-                def dispatch():
+                def dispatch(args=d_args, tok_in=carry_tok):
                     toks_dev, carry, self.cache = multi(
-                        self.params, self.cache, carry_tok, pos0, tables,
-                        limits, temp, topk, topp, rngs,
+                        self.params, self.cache, tok_in, *args
                     )
                     return toks_dev, carry
 
                 t0 = time.perf_counter()
                 async with self._device_lock:
+                    # Broadcast order must equal enqueue order (see
+                    # _run_unified) — publish under the device lock.
+                    if self._publisher is not None:
+                        await self._publisher.publish("multi", pub_payload)
                     toks_dev, carry_tok = await asyncio.to_thread(dispatch)
                 self.step_trace.append(
                     ("decode_dispatch", time.perf_counter() - t0, n, n * T)
@@ -717,7 +857,9 @@ class TpuEngine(AsyncEngine):
             t0 = time.perf_counter()
             sampled = await asyncio.to_thread(np.asarray, toks_dev)  # [T, S]
             self.step_trace.append(
-                ("decode_fetch", time.perf_counter() - t0, n, n * T)
+                # "wait" not "fetch": the D2H copy was started at dispatch,
+                # so this wall is dominated by the chunk's device compute.
+                ("decode_wait", time.perf_counter() - t0, n, n * T)
             )
             for t in range(T):
                 for i, seq in enumerate(members):

@@ -1,0 +1,125 @@
+"""Leader→follower dispatch plane for multi-host SPMD serving.
+
+In multi-controller JAX every process must enqueue the SAME device programs
+in the SAME order over the global mesh.  The leader (process 0) runs the
+full serving stack — HTTP frontend, router, scheduler, KV manager; the
+followers (one per additional host) run ``follower_serve``, which replays
+the leader's dispatch stream: each message carries only small host metadata
+(ragged batch arrays, sampling params, rng keys) — params and KV pages
+already live sharded across every host's devices.
+
+Reference counterpart: the vLLM Ray leader/follower processes and sglang's
+``nnodes/node_rank/dist_init_addr`` bootstrap
+(/root/reference/lib/engines/vllm0_7/src/ray.rs,
+/root/reference/lib/engines/sglang/src/sglang_inc.py).  Like those, this is
+a trusted intra-deployment plane (same trust domain as the NCCL/gloo
+sockets themselves), so frames are pickled numpy payloads with length
+framing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import pickle
+import struct
+from typing import Any, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">I")
+
+
+async def _send(writer: asyncio.StreamWriter, obj: Any) -> None:
+    blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    writer.write(_LEN.pack(len(blob)) + blob)
+    await writer.drain()
+
+
+async def _recv(reader: asyncio.StreamReader) -> Any:
+    head = await reader.readexactly(_LEN.size)
+    blob = await reader.readexactly(_LEN.unpack(head)[0])
+    return pickle.loads(blob)
+
+
+class StepPublisher:
+    """Leader side: accepts one connection per follower, then broadcasts
+    every dispatch in order.  ``publish`` completes only after the frame is
+    flushed to every follower, so stream order == dispatch order."""
+
+    def __init__(self, host: str, port: int, num_followers: int):
+        self.host, self.port = host, port
+        self.num_followers = num_followers
+        self._writers: list = []
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._connected = asyncio.Event()
+
+    async def start(self, timeout: float = 120.0) -> "StepPublisher":
+        async def on_conn(reader, writer):
+            self._writers.append((reader, writer))
+            logger.info(
+                "step follower %d/%d connected",
+                len(self._writers),
+                self.num_followers,
+            )
+            if len(self._writers) >= self.num_followers:
+                self._connected.set()
+
+        self._server = await asyncio.start_server(
+            on_conn, host=self.host, port=self.port
+        )
+        if self.num_followers == 0:
+            self._connected.set()
+        await asyncio.wait_for(self._connected.wait(), timeout)
+        return self
+
+    async def publish(self, kind: str, payload: Tuple = ()) -> None:
+        # One serialization, concurrent drains: this sits in the dispatch
+        # hot path, once per device step.
+        blob = pickle.dumps((kind, payload), protocol=pickle.HIGHEST_PROTOCOL)
+        frame = _LEN.pack(len(blob)) + blob
+        for _, writer in self._writers:
+            writer.write(frame)
+        await asyncio.gather(*(w.drain() for _, w in self._writers))
+
+    async def close(self) -> None:
+        try:
+            await self.publish("close")
+        except Exception:
+            pass
+        for _, writer in self._writers:
+            writer.close()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+async def follower_serve(
+    engine, leader: str, *, retry_s: float = 0.5, timeout: float = 120.0
+) -> None:
+    """Run this process as a dispatch follower of ``leader`` ("host:port").
+
+    ``engine`` is a TpuEngine built with the SAME EngineConfig (and params
+    source) as the leader's — identical seeds/checkpoints give identical
+    global arrays, so replaying the dispatch stream keeps every process's
+    device queue in SPMD lockstep.  Returns when the leader closes.
+    """
+    host, port = leader.rsplit(":", 1)
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        try:
+            reader, writer = await asyncio.open_connection(host, int(port))
+            break
+        except OSError:
+            if asyncio.get_event_loop().time() > deadline:
+                raise
+            await asyncio.sleep(retry_s)
+    logger.info("connected to step leader %s", leader)
+    try:
+        while True:
+            kind, payload = await _recv(reader)
+            if kind == "close":
+                return
+            await engine.mirror_step(kind, payload)
+    finally:
+        writer.close()

@@ -1,0 +1,79 @@
+"""Multi-host scale-out: jax.distributed bootstrap + global-array helpers.
+
+Reference counterpart: ``MultiNodeConfig {num_nodes, node_rank,
+leader_addr}`` (/root/reference/lib/llm/src/engines.rs:40-105) and the vLLM
+Ray leader/follower bootstrap (/root/reference/lib/engines/vllm0_7/src/
+ray.rs).  The TPU-native translation is jax multi-controller SPMD: one
+process per host, every process runs the same program over one global
+``Mesh``; XLA collectives ride ICI within a slice and DCN across slices.
+Nothing like NCCL bootstrap exists to port — the coordinator handshake and
+device exchange are jax.distributed's job.
+
+``init_multihost`` must run before anything initializes a jax backend.
+For CI (no multi-host TPU hardware) the same code path runs as N processes
+x M virtual CPU devices with gloo collectives — tests/test_multihost.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class MultiHostConfig:
+    """--nnodes/--node-rank/--coordinator (reference: MultiNodeConfig)."""
+
+    coordinator: str = ""  # host:port of the rank-0 process
+    nnodes: int = 1
+    node_rank: int = 0
+    # Test/CI only: force this many virtual CPU devices per process (with
+    # gloo cross-process collectives) instead of local TPU chips.
+    cpu_devices: Optional[int] = None
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.nnodes > 1
+
+    @property
+    def is_leader(self) -> bool:
+        return self.node_rank == 0
+
+
+def init_multihost(cfg: MultiHostConfig) -> None:
+    """Bring this process into the global jax runtime.  Call exactly once,
+    before any jax backend initialization."""
+    import jax
+
+    if cfg.cpu_devices:
+        jax.config.update("jax_num_cpu_devices", int(cfg.cpu_devices))
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    if cfg.nnodes > 1:
+        if not cfg.coordinator:
+            raise ValueError("multi-host run needs --coordinator host:port")
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator,
+            num_processes=cfg.nnodes,
+            process_id=cfg.node_rank,
+        )
+
+
+def is_multiprocess() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def global_array(x, sharding):
+    """Assemble a global jax.Array from a full per-host copy of ``x``.
+
+    Every process calls this with identical host data (the SPMD contract for
+    replicated inputs and same-seed params); the callback hands each local
+    device its slice.  Works for any PartitionSpec, single- or multi-host.
+    """
+    import jax
+
+    x = np.asarray(x)
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])

@@ -158,6 +158,15 @@ def sharding_tree(tree: Any, specs: Any, mesh: Mesh) -> Any:
 
 
 def shard_tree(tree: Any, specs: Any, mesh: Mesh) -> Any:
-    """Place a pytree's arrays onto the mesh per the spec tree."""
+    """Place a pytree's arrays onto the mesh per the spec tree.
+
+    Multi-process: the mesh spans devices this process cannot address, so
+    each leaf is assembled from the full per-host copy via
+    ``jax.make_array_from_callback`` (every process holds identical host
+    values — same init seed / same checkpoint)."""
     shardings = sharding_tree(tree, specs, mesh)
+    if jax.process_count() > 1:
+        from .distributed import global_array
+
+        return jax.tree_util.tree_map(global_array, tree, shardings)
     return jax.tree_util.tree_map(jax.device_put, tree, shardings)

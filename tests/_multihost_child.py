@@ -1,0 +1,91 @@
+"""Child process for tests/test_multihost.py.
+
+Usage: python _multihost_child.py <role> <coordinator_port> <step_port>
+Roles: leader (rank 0 of 2), follower (rank 1 of 2), single (one process,
+8 local devices — the reference output the 2-process run must match).
+Prints one JSON line with the generated tokens (leader/single).
+"""
+
+import asyncio
+import json
+import sys
+
+ROLE, COORD_PORT, STEP_PORT = sys.argv[1], sys.argv[2], sys.argv[3]
+
+from dynamo_tpu.parallel.distributed import MultiHostConfig, init_multihost
+
+if ROLE == "single":
+    init_multihost(MultiHostConfig(nnodes=1, cpu_devices=8))
+else:
+    init_multihost(
+        MultiHostConfig(
+            coordinator=f"127.0.0.1:{COORD_PORT}",
+            nnodes=2,
+            node_rank=0 if ROLE == "leader" else 1,
+            cpu_devices=4,
+        )
+    )
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.llm.protocols import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context, collect
+
+CFG = EngineConfig(
+    model="debug-tiny",
+    block_size=4,
+    num_blocks=64,
+    max_batch=4,
+    max_model_len=64,
+    prefill_chunk=32,
+    dp=4,
+    tp=2,
+    dtype="float32",
+    decode_steps=4,
+)
+
+PROMPTS = [[1, 2, 3, 4, 5], [9, 8, 7]]
+
+
+async def generate_all(engine):
+    async def one(p):
+        req = PreprocessedRequest(
+            token_ids=p,
+            stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+        ).to_dict()
+        stream = await engine.generate(Context(req))
+        out = await collect(stream)
+        return [t for item in out for t in item["token_ids"]]
+
+    return await asyncio.gather(*[one(p) for p in PROMPTS])
+
+
+async def main() -> None:
+    engine = TpuEngine(CFG)
+    if ROLE == "leader":
+        from dynamo_tpu.engine.multihost import StepPublisher
+
+        pub = await StepPublisher("127.0.0.1", int(STEP_PORT), 1).start()
+        engine.attach_publisher(pub)
+        await engine.run_warmup()
+        toks = await generate_all(engine)
+        await engine.close()
+        print("RESULT " + json.dumps(toks), flush=True)
+    elif ROLE == "follower":
+        from dynamo_tpu.engine.multihost import follower_serve
+
+        await follower_serve(engine, f"127.0.0.1:{STEP_PORT}")
+        print("RESULT follower-done", flush=True)
+    else:  # single
+        await engine.run_warmup()
+        toks = await generate_all(engine)
+        await engine.close()
+        print("RESULT " + json.dumps(toks), flush=True)
+
+
+asyncio.run(main())
