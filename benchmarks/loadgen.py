@@ -1,0 +1,293 @@
+"""North-star HTTP load harness: ISL/OSL workload, concurrency sweep,
+TTFT/ITL percentiles — the reference's perf.sh methodology
+(/root/reference/examples/llm/benchmarks/perf.sh:19-50: ISL 3000 / OSL 150,
+concurrency 1→256, request count 10x concurrency, streaming).
+
+Targets any OpenAI-compatible deployment of this framework:
+
+  aggregated (self-hosted, default):  python benchmarks/loadgen.py
+  aggregated (external):   python -m dynamo_tpu.cli run in=http out=tpu ... ;
+                           python benchmarks/loadgen.py --url http://H:P
+  routed:                  cli hub; cli run in=dyn://… out=tpu --hub …;
+                           cli http --hub … --router kv;  loadgen --url …
+  disagg:                  cli hub; cli run … --disagg prefill / --disagg
+                           decode;  cli http --hub …;  loadgen --url …
+
+Requests POST token-id prompts to /v1/completions (exact ISL, no tokenizer
+noise), stream=True, nvext.ignore_eos so every request produces exactly OSL
+tokens.  Reported per concurrency level: output tok/s, TTFT p50/p99, ITL
+p50/p99.  One JSON line per level on stdout; a markdown table on stderr.
+
+Env knobs for the self-hosted engine: LOADGEN_MODEL, LOADGEN_LAYERS,
+LOADGEN_MAX_BATCH, LOADGEN_DECODE_STEPS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from aiohttp import ClientSession, ClientTimeout
+
+
+@dataclass
+class RequestResult:
+    ttft_s: float
+    itls_s: List[float] = field(default_factory=list)
+    tokens: int = 0
+    wall_s: float = 0.0
+    error: Optional[str] = None
+
+
+def _pct(xs: List[float], p: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * p))]
+
+
+def _prompt_tokens(i: int, isl: int, vocab: int) -> List[int]:
+    # Distinct per request (defeats prefix caching, like random ISL corpora).
+    return [(i * 7919 + j * 104729 + 11) % (vocab - 2) + 1 for j in range(isl)]
+
+
+async def _one(session: ClientSession, url: str, model: str, prompt: List[int],
+               osl: int) -> RequestResult:
+    payload = {
+        "model": model,
+        "prompt": prompt,
+        "stream": True,
+        "max_tokens": osl,
+        "temperature": 0.0,
+        "nvext": {"ignore_eos": True},
+    }
+    t0 = time.perf_counter()
+    ttft = 0.0
+    last = t0
+    ntok = 0
+    itls: List[float] = []
+    try:
+        async with session.post(f"{url}/v1/completions", json=payload) as resp:
+            if resp.status != 200:
+                body = (await resp.text())[:200]
+                return RequestResult(0, error=f"HTTP {resp.status}: {body}")
+            buf = b""
+            done = False
+            async for raw in resp.content:
+                # SSE events can coalesce into one network chunk (or split
+                # across two) — split on real line boundaries, and stamp one
+                # arrival time per network chunk (events in the same chunk
+                # arrived together: a fused-decode burst).
+                now = time.perf_counter()
+                buf += raw
+                while b"\n" in buf:
+                    head, buf = buf.split(b"\n", 1)
+                    line = head.decode().strip()
+                    if not line.startswith("data:"):
+                        continue
+                    data = line[5:].strip()
+                    if data == "[DONE]":
+                        done = True
+                        break
+                    chunk = json.loads(data)
+                    ch = (chunk.get("choices") or [{}])[0]
+                    if ch.get("finish_reason"):
+                        # Authoritative count from the final usage chunk
+                        # (tokens outside the byte tokenizer's range decode
+                        # to "" but still arrive one chunk per token).
+                        usage = chunk.get("usage") or {}
+                        ntok = max(ntok, usage.get("completion_tokens", ntok))
+                        continue
+                    if "text" not in ch and "delta" not in ch:
+                        continue
+                    if ntok == 0:
+                        ttft = now - t0
+                    else:
+                        itls.append(now - last)
+                    last = now
+                    ntok += 1
+                if done:
+                    break
+    except Exception as e:  # connection errors count as failures, not crashes
+        return RequestResult(0, error=f"{type(e).__name__}: {e}")
+    return RequestResult(ttft, itls, ntok, time.perf_counter() - t0)
+
+
+async def _sweep_level(url: str, model: str, conc: int, n_requests: int,
+                       isl: int, osl: int, vocab: int) -> dict:
+    queue: asyncio.Queue = asyncio.Queue()
+    for i in range(n_requests):
+        queue.put_nowait(i)
+    results: List[RequestResult] = []
+
+    async def worker(session):
+        while True:
+            try:
+                i = queue.get_nowait()
+            except asyncio.QueueEmpty:
+                return
+            results.append(
+                await _one(session, url, model, _prompt_tokens(i, isl, vocab), osl)
+            )
+
+    timeout = ClientTimeout(total=3600, sock_read=600)
+    t0 = time.perf_counter()
+    async with ClientSession(timeout=timeout) as session:
+        await asyncio.gather(*[worker(session) for _ in range(conc)])
+    wall = time.perf_counter() - t0
+
+    ok = [r for r in results if r.error is None]
+    errors = [r.error for r in results if r.error is not None]
+    all_itls = [x for r in ok for x in r.itls_s]
+    total_tokens = sum(r.tokens for r in ok)
+    return {
+        "concurrency": conc,
+        "requests": n_requests,
+        "ok": len(ok),
+        "errors": len(errors),
+        "error_sample": errors[0] if errors else None,
+        "isl": isl,
+        "osl": osl,
+        "wall_s": round(wall, 2),
+        "output_tok_s": round(total_tokens / wall, 2) if wall else 0.0,
+        "req_s": round(len(ok) / wall, 3) if wall else 0.0,
+        "ttft_p50_ms": round(_pct([r.ttft_s for r in ok], 0.5) * 1e3, 1),
+        "ttft_p99_ms": round(_pct([r.ttft_s for r in ok], 0.99) * 1e3, 1),
+        "itl_p50_ms": round(_pct(all_itls, 0.5) * 1e3, 2),
+        "itl_p99_ms": round(_pct(all_itls, 0.99) * 1e3, 2),
+    }
+
+
+# --------------------------------------------------------- self-hosted mode
+async def _self_host(args):
+    """In-process aggregated deployment: TPU engine + HTTP frontend."""
+    import jax
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.llm.backend import Backend
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.discovery import make_tokenizer
+    from dynamo_tpu.models import get_config
+    from dynamo_tpu.runtime.pipeline import build_pipeline
+
+    backend = jax.default_backend()
+    model = os.environ.get(
+        "LOADGEN_MODEL", "llama-3.1-8b" if backend != "cpu" else "debug-tiny"
+    )
+    model_cfg = get_config(model)
+    layers = int(os.environ.get("LOADGEN_LAYERS", "0"))
+    if layers <= 0 and model == "llama-3.1-8b":
+        try:
+            mem = jax.devices()[0].memory_stats().get("bytes_limit", 16 << 30)
+        except Exception:
+            mem = 16 << 30
+        # Leave room for the KV pool: weights ~0.52 GB/layer + ~2 GB fixed
+        # + KV (max_batch * ctx * 72 KB/token at 8 kv-heads).
+        layers = max(2, min(32, int((mem * 0.62 - (2 << 30)) / (520 << 20))))
+    if layers and layers != model_cfg.num_layers:
+        import dynamo_tpu.models.config as mc
+
+        mc.register_config(
+            model_cfg.with_overrides(name=model + "-loadgen", num_layers=layers)
+        )
+        model = model + "-loadgen"
+        model_cfg = get_config(model)
+
+    ctx = 1 << (args.isl + args.osl + 16 - 1).bit_length()
+    max_batch = int(os.environ.get("LOADGEN_MAX_BATCH", "16"))
+    blocks_per_seq = (ctx + 15) // 16
+    cfg = EngineConfig(
+        model=model,
+        block_size=16,
+        num_blocks=max_batch * blocks_per_seq + 64,
+        max_batch=max_batch,
+        max_model_len=ctx,
+        prefill_chunk=512,
+        decode_steps=int(os.environ.get("LOADGEN_DECODE_STEPS", "16")),
+        pipeline_depth=4,
+        dtype="float32" if backend == "cpu" else "bfloat16",
+    )
+    print(
+        f"loadgen: self-hosted agg — model={model} layers={model_cfg.num_layers} "
+        f"ctx={ctx} max_batch={max_batch} backend={backend}",
+        file=sys.stderr,
+    )
+    engine = TpuEngine(cfg)
+    t0 = time.perf_counter()
+    await engine.run_warmup()
+    print(f"loadgen: warmup {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+    tokenizer = make_tokenizer({"kind": "byte"})
+    pipeline = build_pipeline(
+        [OpenAIPreprocessor(tokenizer, "bench"), Backend(tokenizer)], engine
+    )
+    service = HttpService(host="127.0.0.1", port=args.port)
+    service.models.add_completion_model("bench", pipeline)
+    service.models.add_chat_model("bench", pipeline)
+    await service.start()
+    return engine, service, f"http://127.0.0.1:{service.port}", model_cfg.vocab_size
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", default=None, help="existing deployment; default self-host")
+    ap.add_argument("--model", default="bench")
+    ap.add_argument("--isl", type=int, default=3000)
+    ap.add_argument("--osl", type=int, default=150)
+    ap.add_argument("--conc", default="1,4,16",
+                    help="comma list; north-star full ladder: 1,2,4,...,256")
+    ap.add_argument("--requests-per-conc", type=int, default=10, dest="rpc",
+                    help="requests = this x concurrency (reference: 10x)")
+    ap.add_argument("--max-requests", type=int, default=64, dest="max_requests")
+    ap.add_argument("--vocab", type=int, default=128256)
+    ap.add_argument("--port", type=int, default=18723)
+    ap.add_argument("--out", default=None, help="write JSON results here")
+    args = ap.parse_args()
+
+    engine = service = None
+    url, vocab = args.url, args.vocab
+    if url is None:
+        engine, service, url, vocab = await _self_host(args)
+
+    levels = [int(c) for c in args.conc.split(",")]
+    rows = []
+    try:
+        for conc in levels:
+            n = min(args.rpc * conc, args.max_requests)
+            print(f"loadgen: conc={conc} n={n} ...", file=sys.stderr)
+            row = await _sweep_level(url, args.model, conc, n, args.isl,
+                                     args.osl, vocab)
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    finally:
+        if service is not None:
+            await service.close()
+        if engine is not None:
+            await engine.close()
+
+    hdr = ("| conc | reqs | ok | tok/s | req/s | TTFT p50 | TTFT p99 "
+           "| ITL p50 | ITL p99 |")
+    print("\n" + hdr + "\n|" + "---|" * 9, file=sys.stderr)
+    for r in rows:
+        print(
+            f"| {r['concurrency']} | {r['requests']} | {r['ok']} "
+            f"| {r['output_tok_s']} | {r['req_s']} | {r['ttft_p50_ms']}ms "
+            f"| {r['ttft_p99_ms']}ms | {r['itl_p50_ms']}ms "
+            f"| {r['itl_p99_ms']}ms |",
+            file=sys.stderr,
+        )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"isl": args.isl, "osl": args.osl, "rows": rows}, f, indent=1)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    asyncio.run(main())

@@ -58,6 +58,13 @@ class EngineConfig:
     # up to pipeline_depth*decode_steps tokens of lag (over-decoded tokens
     # are discarded host-side and never corrupt sealed KV blocks).
     pipeline_depth: int = 2
+    # Host (CPU RAM) KV offload tier: sealed blocks are write-behind copied
+    # to host so HBM eviction keeps contents; prompts restore evicted
+    # prefixes with one scatter instead of recomputing (engine/host_cache.py;
+    # reference kv/storage.rs + block_copy.cu).  0 disables.
+    host_cache_bytes: int = 0
+    # Seconds between offload pump cycles (device gather + async D2H).
+    host_offload_interval: float = 0.05
 
     def __post_init__(self) -> None:
         if not self.batch_buckets:

@@ -42,7 +42,7 @@ from ..llm.kv_router.protocols import ForwardPassMetrics, KvCacheEvent
 from ..llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
 from ..models.config import ModelConfig, get_config
 from ..models.llama import PagedKVCache, RaggedBatch, forward_ragged, init_params
-from ..ops.sampling import sample_tokens
+from ..ops.sampling import SamplingParams, sample_tokens
 from ..parallel.mesh import (
     MeshConfig,
     make_mesh,
@@ -104,6 +104,18 @@ class TpuEngine(AsyncEngine):
         # followers keep their device queues in SPMD lockstep (multihost.py).
         self._publisher = None
         self._mirror_carry: Any = None
+        # Host KV offload tier (engine/host_cache.py).
+        self.host_kv = None
+        self._offload_queue: List[Tuple[int, Any]] = []
+        self._offload_task: Optional[asyncio.Task] = None
+        if cfg.host_cache_bytes > 0:
+            if jax.process_count() > 1:
+                # Sharded pages can't be gathered to one host's RAM; a
+                # per-host sharded store is future work.
+                raise ValueError("host_cache_bytes requires a single process")
+            from .host_cache import HostKvStore
+
+            self.host_kv = HostKvStore(cfg.host_cache_bytes)
         # Per-dispatch trace: (kind, wall_s, rows, device_tokens); the
         # pipeline records dispatch and fetch separately since they overlap.
         self.step_trace: List[Tuple[str, float, int, int]] = []
@@ -146,21 +158,34 @@ class TpuEngine(AsyncEngine):
         model_config, bs = self.model_config, cfg.block_size
         attn_impl = cfg.attn_impl
         if attn_impl == "auto":
-            from ..ops.attention import on_tpu
+            from ..ops.ragged_attention import on_tpu
 
             attn_impl = "tpu" if on_tpu() else "xla"
         self.attn_impl = attn_impl
         S = cfg.max_batch
         mesh = self.mesh
 
-        def _step(params, cache, rb, temp, topk, topp, rng):
+        def _step(params, cache, rb, samp):
             logits, cache = forward_ragged(
                 params, model_config, rb, cache, attn_impl=attn_impl, mesh=mesh
             )
-            tokens = sample_tokens(logits, rng, temp, topk, topp)
-            return tokens, cache
+            out = sample_tokens(
+                logits,
+                samp.seeds,
+                samp.steps,
+                samp.temperature,
+                samp.top_k,
+                samp.top_p,
+                samp.freq_penalty,
+                samp.pres_penalty,
+                samp.counts,
+                samp.need_logprobs,
+            )
+            return out, cache
 
-        def _multi(params, cache, tok0, pos0, tables, limits, temp, topk, topp, rngs):
+        T_steps = cfg.decode_steps
+
+        def _multi(params, cache, tok0, steps0, counts0, pos0, tables, limits, samp):
             """``decode_steps`` fused decode iterations: one dispatch, the
             sampled token feeds the next step ON DEVICE, and the final token
             carry is returned un-fetched so the next dispatch can chain to it
@@ -168,14 +193,16 @@ class TpuEngine(AsyncEngine):
 
             ``pos0[s]`` is -1 for padding rows; ``limits[s]`` is the
             allocated KV capacity — steps whose position reaches it skip the
-            cache write (their tokens are discarded host-side).
+            cache write (their tokens are discarded host-side).  Output-token
+            counts (penalties) and per-row rng stream positions advance ON
+            DEVICE across the fused steps.
             """
             cu = jnp.arange(S + 1, dtype=jnp.int32)
             num = jnp.full((1,), S, jnp.int32)
             active = pos0 >= 0
 
-            def body(carry, step_rng):
-                cache, tok, pos = carry
+            def body(carry, _):
+                cache, tok, pos, steps, counts = carry
                 posc = jnp.maximum(pos, 0)
                 slot = (
                     tables[jnp.arange(S), posc // bs] * bs + posc % bs
@@ -197,11 +224,45 @@ class TpuEngine(AsyncEngine):
                     params, model_config, rb, cache, attn_impl=attn_impl,
                     mesh=mesh,
                 )
-                nxt = sample_tokens(logits, step_rng, temp, topk, topp)
-                return (cache, nxt, jnp.where(active, pos + 1, pos)), nxt
+                out = sample_tokens(
+                    logits,
+                    samp.seeds,
+                    steps,
+                    samp.temperature,
+                    samp.top_k,
+                    samp.top_p,
+                    samp.freq_penalty,
+                    samp.pres_penalty,
+                    counts,
+                    samp.need_logprobs,
+                )
+                nxt = out.tokens
+                counts = counts.at[jnp.arange(S), nxt].add(
+                    active.astype(counts.dtype)
+                )
+                carry = (
+                    cache,
+                    nxt,
+                    jnp.where(active, pos + 1, pos),
+                    jnp.where(active, steps + 1, steps),
+                    counts,
+                )
+                return carry, out
 
-            (cache, last, _), toks = jax.lax.scan(body, (cache, tok0, pos0), rngs)
-            return toks, last, cache  # toks: [decode_steps, S]
+            (cache, last, _, steps_f, counts_f), outs = jax.lax.scan(
+                body,
+                (cache, tok0, pos0, steps0, counts0),
+                None,
+                length=T_steps,
+            )
+            # outs: SampleOut of [decode_steps, ...]; (last, steps_f,
+            # counts_f) is the ON-DEVICE carry the next dispatch chains to.
+            return outs, last, steps_f, counts_f, cache
+
+        def _gather(cache, page_ids):
+            # Batched block gather for host offload; OOB padding ids clamp
+            # (their slices are ignored at store time).
+            return cache.pages[:, page_ids]
 
         def _inject(cache, page_ids, new_pages):
             # Donated in-place page scatter for KV imports; padding ids are
@@ -225,10 +286,23 @@ class TpuEngine(AsyncEngine):
             self._multi_fn = jax.jit(
                 _multi,
                 donate_argnums=donate,
-                out_shardings=(None, None, cache_sh),
+                out_shardings=(None, None, None, None, cache_sh),
             )
             self._inject_fn = jax.jit(
                 _inject, donate_argnums=(0,), out_shardings=cache_sh
+            )
+        self._gather_fn = jax.jit(_gather)  # host offload (no donation)
+        # Cached all-zeros penalty-counts buffer (see _sampling_arrays).
+        self._zero_counts = jnp.zeros(
+            (S, self.model_config.vocab_size), jnp.int16
+        )
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._zero_counts = jax.device_put(
+                self._zero_counts, NamedSharding(self.mesh, PartitionSpec())
+            ) if jax.process_count() == 1 else self._prep(
+                np.zeros((S, self.model_config.vocab_size), np.int16)
             )
 
     # ------------------------------------------------------------ multi-host
@@ -261,31 +335,40 @@ class TpuEngine(AsyncEngine):
         if kind == "warmup":
             await asyncio.to_thread(self.warmup)
         elif kind == "unified":
-            rb, temp, topk, topp, rng = payload
+            rb, samp = payload
 
             def run_u():
                 _, self.cache = self._step_fn(
                     self.params,
                     self.cache,
                     self._prep(rb),
-                    *self._prep((temp, topk, topp, rng)),
+                    self._prep(samp),
                 )
 
             async with self._device_lock:
                 await asyncio.to_thread(run_u)
         elif kind == "multi":
-            tok0, pos0, tables, limits, temp, topk, topp, rngs = payload
+            tok0, pos0, tables, limits, samp = payload
             carry = self._mirror_carry if tok0 is None else None
 
             def run_m():
-                tok = self._prep(tok0) if carry is None else carry
-                _, new_carry, self.cache = self._multi_fn(
+                samp_d = self._prep(samp)
+                if carry is None:
+                    tok, steps0, counts0 = (
+                        self._prep(tok0), samp_d.steps, samp_d.counts
+                    )
+                else:
+                    tok, steps0, counts0 = carry
+                _, last, steps_f, counts_f, self.cache = self._multi_fn(
                     self.params,
                     self.cache,
                     tok,
-                    *self._prep((pos0, tables, limits, temp, topk, topp, rngs)),
+                    steps0,
+                    counts0,
+                    *self._prep((pos0, tables, limits)),
+                    samp_d,
                 )
-                return new_carry
+                return (last, steps_f, counts_f)
 
             async with self._device_lock:
                 self._mirror_carry = await asyncio.to_thread(run_m)
@@ -339,12 +422,7 @@ class TpuEngine(AsyncEngine):
         """
         cfg = self.cfg
         S, PP = cfg.max_batch, cfg.max_blocks_per_seq
-        temp = np.zeros((S,), np.float32)
-        topk = np.zeros((S,), np.int32)
-        topp = np.ones((S,), np.float32)
-        rng = jax.random.PRNGKey(0)
-        if self._rep_sharding is not None:
-            rng = self._prep(np.asarray(rng))
+        samp = self._sampling_arrays([])  # greedy defaults, cached counts
         for T in self.reachable_token_buckets():
             cu = np.zeros((S + 1,), np.int32)
             cu[1:] = T  # one row owns every token; others empty
@@ -359,39 +437,37 @@ class TpuEngine(AsyncEngine):
                 cu_q_lens=cu,
                 num_seqs=np.asarray([1], np.int32),
             )
-            tokens, self.cache = self._step_fn(
-                self.params, self.cache, self._prep(rb),
-                *self._prep((temp, topk, topp)), rng
+            out, self.cache = self._step_fn(
+                self.params, self.cache, self._prep(rb), self._prep(samp)
             )
         if cfg.decode_steps > 1:
-            rngs = jax.random.split(rng, cfg.decode_steps)
             args = self._prep(
                 (
                     np.full((S,), -1, np.int32),  # every row inactive
                     np.zeros((S, PP), np.int32),
                     np.zeros((S,), np.int32),
-                    temp,
-                    topk,
-                    topp,
-                    np.asarray(rngs) if self._rep_sharding is not None else rngs,
                 )
             )
-            _, last, self.cache = self._multi_fn(
+            _, last, steps_f, counts_f, self.cache = self._multi_fn(
                 self.params,
                 self.cache,
                 self._prep(np.zeros((S,), np.int32)),
+                self._prep(samp.steps),
+                samp.counts,
                 *args,
+                self._prep(samp),
             )
-            # Chain once more with the DEVICE carry as tok0: pipeline
-            # dispatches 2+ feed the previous output back in, and a committed
-            # device array keys a different executable-cache entry than the
-            # uncommitted numpy first dispatch.
-            _, last, self.cache = self._multi_fn(
-                self.params, self.cache, last, *args
+            # Chain once more with the DEVICE carry: pipeline dispatches 2+
+            # feed the previous outputs back in, and committed device arrays
+            # key a different executable-cache entry than the uncommitted
+            # numpy first dispatch.
+            _, last, _, _, self.cache = self._multi_fn(
+                self.params, self.cache, last, steps_f, counts_f,
+                *args, self._prep(samp)
             )
             last.block_until_ready()
         else:
-            tokens.block_until_ready()
+            out.tokens.block_until_ready()
         return self.compile_counts()
 
     # ------------------------------------------------------------ public API
@@ -405,6 +481,11 @@ class TpuEngine(AsyncEngine):
                 f"{self.cfg.max_model_len}"
             )
         self._ensure_loop()
+        if self.host_kv is not None and len(self.host_kv):
+            # Pull any evicted prefix blocks back from host RAM BEFORE
+            # admission, so the scheduler sees them as prefix-cache hits
+            # (the reference's restore-ahead-of-prefill TTFT win).
+            await self._restore_from_host(list(pre.token_ids))
         seq = SequenceState.from_request(request.id, pre, self.cfg)
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[request.id] = queue
@@ -449,6 +530,13 @@ class TpuEngine(AsyncEngine):
         if self._loop_task is not None:
             await self._loop_task
             self._loop_task = None
+        if self._offload_task is not None:
+            self._offload_task.cancel()
+            try:
+                await self._offload_task
+            except asyncio.CancelledError:
+                pass
+            self._offload_task = None
         if self._publisher is not None:
             await self._publisher.close()
             self._publisher = None
@@ -567,6 +655,12 @@ class TpuEngine(AsyncEngine):
     def _ensure_loop(self) -> None:
         if self._loop_task is None or self._loop_task.done():
             self._loop_task = asyncio.get_running_loop().create_task(self._run_loop())
+        if self.host_kv is not None and (
+            self._offload_task is None or self._offload_task.done()
+        ):
+            self._offload_task = asyncio.get_running_loop().create_task(
+                self._offload_pump()
+            )
 
     async def _run_loop(self) -> None:
         while not self._closed:
@@ -622,16 +716,56 @@ class TpuEngine(AsyncEngine):
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
-    def _sampling_arrays(self, seqs: List[SequenceState]):
+    def _sampling_arrays(self, seqs: List[SequenceState]) -> SamplingParams:
+        """Build the per-row device sampling state for this step.
+
+        The counts matrix ([S, V], penalties) is the engine's cached
+        all-zeros DEVICE buffer unless some row actually uses a penalty —
+        the common path never pays the [S, V] host→device transfer."""
         S = self.cfg.max_batch
+        V = self.model_config.vocab_size
+        seeds = np.zeros((S,), np.uint32)
+        steps = np.zeros((S,), np.int32)
         temp = np.zeros((S,), np.float32)
         topk = np.zeros((S,), np.int32)
         topp = np.ones((S,), np.float32)
+        fpen = np.zeros((S,), np.float32)
+        ppen = np.zeros((S,), np.float32)
+        need_lp = False
+        any_pen = False
         for i, seq in enumerate(seqs):
+            seeds[i] = seq.sampling_seed
+            steps[i] = seq.num_output_tokens
             temp[i] = seq.sampling_temperature
             topk[i] = seq.sampling_top_k
             topp[i] = seq.sampling_top_p
-        return temp, topk, topp
+            fpen[i] = seq.freq_penalty
+            ppen[i] = seq.pres_penalty
+            need_lp = need_lp or seq.logprobs is not None
+            any_pen = any_pen or seq.freq_penalty != 0 or seq.pres_penalty != 0
+        if any_pen:
+            counts_np = np.zeros((S, V), np.int16)
+            for i, seq in enumerate(seqs):
+                out = np.asarray(seq.output, np.int64)
+                if out.size:
+                    np.add.at(counts_np[i], out % V, 1)
+            if self._rep_sharding is not None:
+                counts = self._prep(counts_np)
+            else:
+                counts = jnp.asarray(counts_np)  # committed, key matches cache
+        else:
+            counts = self._zero_counts
+        return SamplingParams(
+            seeds=seeds,
+            steps=steps,
+            temperature=temp,
+            top_k=topk,
+            top_p=topp,
+            freq_penalty=fpen,
+            pres_penalty=ppen,
+            counts=counts,
+            need_logprobs=np.asarray(need_lp),
+        )
 
     def _tables_row(self, out: np.ndarray, i: int, seq: SequenceState) -> None:
         ids = seq.block_ids[: out.shape[1]]
@@ -676,22 +810,24 @@ class TpuEngine(AsyncEngine):
     # ------------------------------------------------------ unified step path
     async def _run_unified(self, plan: StepPlan) -> None:
         rb = self._build_ragged(plan.items)
-        temp, topk, topp = self._sampling_arrays([s for s, _, _ in plan.items])
-        rng = self._next_rng()
+        samp = self._sampling_arrays([s for s, _, _ in plan.items])
+        need_lp = bool(samp.need_logprobs)
         if self._rep_sharding is not None:
-            rng_np = np.asarray(rng)
-            rb_d, temp_d, topk_d, topp_d, rng_d = self._prep(
-                (rb, temp, topk, topp, rng_np)
-            )
+            rb_d, samp_d = self._prep((rb, samp))
         else:
-            rb_d, temp_d, topk_d, topp_d, rng_d = rb, temp, topk, topp, rng
+            rb_d, samp_d = rb, samp
         step = self._step_fn
 
-        def run() -> np.ndarray:
-            tokens_dev, self.cache = step(
-                self.params, self.cache, rb_d, temp_d, topk_d, topp_d, rng_d
-            )
-            return np.asarray(tokens_dev)
+        def run():
+            out, self.cache = step(self.params, self.cache, rb_d, samp_d)
+            if need_lp:
+                return (
+                    np.asarray(out.tokens),
+                    np.asarray(out.logprob),
+                    np.asarray(out.top_ids),
+                    np.asarray(out.top_logprobs),
+                )
+            return np.asarray(out.tokens), None, None, None
 
         t0 = time.perf_counter()
         async with self._device_lock:
@@ -700,9 +836,10 @@ class TpuEngine(AsyncEngine):
             # sequence than the leader ran (SPMD divergence).
             if self._publisher is not None:
                 await self._publisher.publish(
-                    "unified", (rb, temp, topk, topp, np.asarray(rng))
+                    "unified",
+                    (rb, jax.tree_util.tree_map(np.asarray, samp)),
                 )
-            sampled = await asyncio.to_thread(run)
+            sampled, logp, top_ids, top_lp = await asyncio.to_thread(run)
         self.step_trace.append(
             ("unified", time.perf_counter() - t0, len(plan.items), len(rb.token_ids))
         )
@@ -716,7 +853,11 @@ class TpuEngine(AsyncEngine):
             seq.num_computed = start + n
             self._seal_completed_blocks(seq)
             if not seq.in_prefill:
-                self._accept_token(seq, int(sampled[i]))
+                self._accept_token(
+                    seq,
+                    int(sampled[i]),
+                    logprobs=self._lp_info(seq, i, logp, top_ids, top_lp),
+                )
 
     # -------------------------------------------------- fused decode pipeline
     async def _decode_pipeline(self, members: List[SequenceState]) -> bool:
@@ -743,8 +884,19 @@ class TpuEngine(AsyncEngine):
         tables = np.zeros((S, cfg.max_blocks_per_seq), np.int32)
         for i, seq in enumerate(members):
             self._tables_row(tables, i, seq)
-        temp, topk, topp = self._sampling_arrays(members)
-        carry_tok: Any = tok0  # device array after the first dispatch
+        samp = self._sampling_arrays(members)
+        # Host copy only needed for the follower broadcast — np.asarray on
+        # samp.counts would otherwise drag the [S, V] device buffer to host
+        # on every pipeline build.
+        samp_np = (
+            jax.tree_util.tree_map(np.asarray, samp)
+            if self._publisher is not None
+            else None
+        )
+        need_lp = bool(samp.need_logprobs)
+        # (token, rng-step, penalty-counts) carry: numpy seeds for the first
+        # dispatch, then the previous dispatch's on-device outputs.
+        carry: Optional[Tuple[Any, Any, Any]] = None
         multi = self._multi_fn
 
         inflight: deque = deque()
@@ -796,34 +948,31 @@ class TpuEngine(AsyncEngine):
                     # return so schedule() can preempt with nothing pending.
                     rebuild = True
                     break
-                rngs = jax.random.split(self._next_rng(), T)
                 pos0 = pos_disp.copy()
-                first = isinstance(carry_tok, np.ndarray)
+                first = carry is None
                 pub_payload = (
-                    carry_tok if first else None,  # None → follower's carry
+                    tok0 if first else None,  # None → follower's own carry
                     pos0,
                     tables.copy(),
                     limits,
-                    temp,
-                    topk,
-                    topp,
-                    np.asarray(rngs),
+                    samp_np,
                 )
-                if self._rep_sharding is not None:
-                    if first:
-                        carry_tok = self._prep(carry_tok)
-                    d_args = self._prep(
-                        (pos0, tables.copy(), limits, temp, topk, topp,
-                         np.asarray(rngs))
-                    )
+                if first:
+                    c_tok, c_steps, c_counts = tok0, samp.steps, samp.counts
+                    if self._rep_sharding is not None:
+                        c_tok, c_steps = self._prep((c_tok, c_steps))
                 else:
-                    d_args = (pos0, tables, limits, temp, topk, topp, rngs)
+                    c_tok, c_steps, c_counts = carry
+                if self._rep_sharding is not None:
+                    d_args = self._prep((pos0, tables.copy(), limits, samp))
+                else:
+                    d_args = (pos0, tables, limits, samp)
 
-                def dispatch(args=d_args, tok_in=carry_tok):
-                    toks_dev, carry, self.cache = multi(
-                        self.params, self.cache, tok_in, *args
+                def dispatch(args=d_args, tok_in=c_tok, st=c_steps, ct=c_counts):
+                    outs, last, steps_f, counts_f, self.cache = multi(
+                        self.params, self.cache, tok_in, st, ct, *args
                     )
-                    return toks_dev, carry
+                    return outs, (last, steps_f, counts_f)
 
                 t0 = time.perf_counter()
                 async with self._device_lock:
@@ -831,7 +980,7 @@ class TpuEngine(AsyncEngine):
                     # _run_unified) — publish under the device lock.
                     if self._publisher is not None:
                         await self._publisher.publish("multi", pub_payload)
-                    toks_dev, carry_tok = await asyncio.to_thread(dispatch)
+                    outs, carry = await asyncio.to_thread(dispatch)
                 self.step_trace.append(
                     ("decode_dispatch", time.perf_counter() - t0, n, n * T)
                 )
@@ -840,10 +989,14 @@ class TpuEngine(AsyncEngine):
                 # round-trip instead of compute + full link latency (round-2
                 # measured 323ms per serial fetch over the tunneled chip).
                 try:
-                    toks_dev.copy_to_host_async()
+                    outs.tokens.copy_to_host_async()
+                    if need_lp:
+                        outs.logprob.copy_to_host_async()
+                        outs.top_ids.copy_to_host_async()
+                        outs.top_logprobs.copy_to_host_async()
                 except AttributeError:
                     pass
-                inflight.append((toks_dev, pos0))
+                inflight.append((outs, pos0))
                 dispatched_any = True
                 pos_disp = np.where(pos_disp >= 0, pos_disp + T, pos_disp)
                 if want_rebuild():
@@ -853,9 +1006,20 @@ class TpuEngine(AsyncEngine):
                 break
 
             # Await the oldest chunk's tokens and apply them.
-            toks_dev, pos0 = inflight.popleft()
+            outs, pos0 = inflight.popleft()
             t0 = time.perf_counter()
-            sampled = await asyncio.to_thread(np.asarray, toks_dev)  # [T, S]
+
+            def fetch(o=outs):
+                if need_lp:
+                    return (
+                        np.asarray(o.tokens),
+                        np.asarray(o.logprob),
+                        np.asarray(o.top_ids),
+                        np.asarray(o.top_logprobs),
+                    )
+                return np.asarray(o.tokens), None, None, None
+
+            sampled, logp, top_ids, top_lp = await asyncio.to_thread(fetch)
             self.step_trace.append(
                 # "wait" not "fetch": the D2H copy was started at dispatch,
                 # so this wall is dominated by the chunk's device compute.
@@ -876,7 +1040,16 @@ class TpuEngine(AsyncEngine):
                     seq.num_computed += 1
                     self._seal_completed_blocks(seq)
                     self._accept_token(
-                        seq, int(sampled[t, i]), defer_removal=True
+                        seq,
+                        int(sampled[t, i]),
+                        defer_removal=True,
+                        logprobs=self._lp_info(
+                            seq,
+                            i,
+                            None if logp is None else logp[t],
+                            None if top_ids is None else top_ids[t],
+                            None if top_lp is None else top_lp[t],
+                        ),
                     )
                     if seq.finished:
                         finished_members.append(seq)
@@ -914,11 +1087,137 @@ class TpuEngine(AsyncEngine):
         hashed = len(seq.block_seq.blocks)
         while seq.num_sealed_blocks < min(complete, hashed):
             idx = seq.num_sealed_blocks
-            self.kv.seal_block(seq.block_ids[idx], seq.block_seq.blocks[idx])
+            tb = seq.block_seq.blocks[idx]
+            self.kv.seal_block(seq.block_ids[idx], tb)
             seq.num_sealed_blocks += 1
+            if self.host_kv is not None and not self.host_kv.contains(
+                tb.sequence_hash
+            ):
+                self._offload_queue.append((seq.block_ids[idx], tb))
+
+    # ------------------------------------------------------- host KV offload
+    async def _offload_pump(self) -> None:
+        """Write-behind: batch-gather queued sealed blocks to the host tier
+        (one device gather + one D2H per cycle, not per block)."""
+        while not self._closed:
+            await asyncio.sleep(self.cfg.host_offload_interval)
+            if self._offload_queue:
+                try:
+                    await self.drain_offload()
+                except Exception:
+                    # Offload is an optimization; never let it kill serving.
+                    logger.exception("host KV offload cycle failed")
+
+    async def drain_offload(self, max_blocks: int = 64) -> int:
+        """Copy up to ``max_blocks`` queued sealed blocks to host RAM.
+        Returns how many were stored (public so tests can force a cycle)."""
+        if self.host_kv is None or not self._offload_queue:
+            return 0
+        batch, self._offload_queue = (
+            self._offload_queue[:max_blocks],
+            self._offload_queue[max_blocks:],
+        )
+        async with self._device_lock:
+            # A block may have been recycled since sealing; only blocks
+            # still holding their hash are snapshotted.
+            live = [
+                (bid, tb)
+                for bid, tb in batch
+                if self.kv._blocks[bid].sequence_hash == tb.sequence_hash
+            ]
+            if not live:
+                return 0
+            pad = 1 << max(0, (len(live) - 1).bit_length())
+            ids = np.zeros((pad,), np.int32)
+            ids[: len(live)] = [bid for bid, _ in live]
+            pages = await asyncio.to_thread(
+                lambda: np.asarray(self._gather_fn(self.cache, ids))
+            )
+        for i, (_, tb) in enumerate(live):
+            self.host_kv.put(tb.sequence_hash, np.ascontiguousarray(pages[:, i]))
+        return len(live)
+
+    async def _restore_from_host(self, token_ids: List[int]) -> int:
+        """Scatter host-tier blocks beyond the HBM-resident prefix back into
+        the device cache (sealed + released to the reuse pool), so admission
+        sees them as ordinary prefix-cache hits.  Returns restored blocks."""
+        if self.host_kv is None:
+            return 0
+        from ..tokens import hash_token_blocks
+
+        blocks = hash_token_blocks(token_ids, self.cfg.block_size)
+        resident = len(self.kv.match_prefix(blocks))
+        run: List[Tuple[Any, np.ndarray]] = []
+        for tb in blocks[resident:]:
+            host = self.host_kv.get(tb.sequence_hash)
+            if host is None:
+                break
+            run.append((tb, host))
+        run = run[: max(0, self.kv.free_blocks - 1)]
+        if not run:
+            return 0
+        # PIN the resident prefix (take references) while allocating the
+        # tail: the prefix blocks sit in the reuse pool and are otherwise
+        # legitimate LRU eviction victims for our own allocations — which
+        # would replace recompute-the-tail with recompute-everything.
+        prefix_ids: List[int] = []
+        if resident:
+            alloc = self.kv.allocate_sequence(blocks[:resident], resident)
+            if alloc is not None:
+                prefix_ids = alloc[0]
+        try:
+            ids: List[int] = []
+            for _ in run:
+                bid = self.kv.allocate_block()
+                if bid is None:
+                    break
+                ids.append(bid)
+            run = run[: len(ids)]
+            if not run:
+                self.kv.free_sequence(ids)
+                return 0
+            n = len(run)
+            pad = 1 << max(0, (n - 1).bit_length())
+            page_ids = np.full((pad,), self.cfg.num_blocks, np.int32)  # OOB pad
+            page_ids[:n] = ids
+            comb = np.stack([h for _, h in run], axis=1)  # [L, n, ps, 2KV, hd]
+            comb_p = np.zeros(comb.shape[:1] + (pad,) + comb.shape[2:], comb.dtype)
+            comb_p[:, :n] = comb
+            async with self._device_lock:
+                if self._publisher is not None:
+                    await self._publisher.publish("inject", (page_ids, comb_p))
+                self.cache = await asyncio.to_thread(
+                    self._inject_fn, self.cache, *self._prep((page_ids, comb_p))
+                )
+            for bid, (tb, _) in zip(ids, run):
+                self.kv.seal_block(bid, tb)
+            self.kv.free_sequence(ids)
+            self.host_kv.restored_blocks += n
+            return n
+        finally:
+            if prefix_ids:
+                self.kv.free_sequence(prefix_ids)
+
+    def _lp_info(
+        self, seq: SequenceState, i: int, logp, top_ids, top_lp
+    ) -> Optional[Dict[str, Any]]:
+        """Per-token logprob payload for row ``i`` (None unless requested)."""
+        if seq.logprobs is None or logp is None:
+            return None
+        k = min(int(seq.logprobs), top_ids.shape[-1])
+        return {
+            "logprob": float(logp[i]),
+            "top": [
+                (int(top_ids[i, j]), float(top_lp[i, j])) for j in range(k)
+            ],
+        }
 
     def _accept_token(
-        self, seq: SequenceState, token: int, defer_removal: bool = False
+        self,
+        seq: SequenceState,
+        token: int,
+        defer_removal: bool = False,
+        logprobs: Optional[Dict[str, Any]] = None,
     ) -> None:
         seq.output.append(token)
         reason = self._check_stop(seq, token)
@@ -926,7 +1225,10 @@ class TpuEngine(AsyncEngine):
         # Stop-triggering tokens (eos / stop_token_ids) are not emitted,
         # matching the reference Backend's stop handling (backend.rs:234-423).
         if queue is not None and reason is not FinishReason.STOP:
-            queue.put_nowait(LLMEngineOutput.token(token))
+            item = LLMEngineOutput.token(token)
+            if logprobs is not None:
+                item["logprobs"] = logprobs
+            queue.put_nowait(item)
         if reason is not None:
             seq.finished = True
             if not defer_removal:

@@ -1,0 +1,65 @@
+"""Host (CPU RAM) KV offload tier: evicted HBM blocks keep their contents.
+
+Reference counterpart: the pinned host block pool + device↔host block copies
+(lib/llm/src/kv/storage.rs:48-316, kernels/block_copy.cu, layer.rs:100-772)
+behind the published ~40% TTFT win for multi-turn workloads
+(docs/architecture.md:91-95).  The TPU translation: sealed blocks are
+write-behind copied to host as soon as they are published (one batched
+device gather + async D2H per pump cycle — no per-block copy kernel), so
+HBM eviction never loses reusable contents; a prompt whose prefix fell out
+of HBM restores it with one scatter (the same donated in-place path KV
+transfer uses) instead of recomputing prefill.
+
+Keyed by chained sequence hash (tokens.py), LRU-bounded by bytes.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+
+class HostKvStore:
+    """hash → one block's pages [L, page_size, 2*kv_heads, head_dim]."""
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity_bytes = capacity_bytes
+        self._data: "OrderedDict[int, np.ndarray]" = OrderedDict()
+        self._bytes = 0
+        # counters (metrics / tests)
+        self.stored_blocks = 0
+        self.restored_blocks = 0
+        self.evicted_blocks = 0
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    @property
+    def used_bytes(self) -> int:
+        return self._bytes
+
+    def contains(self, seq_hash: int) -> bool:
+        return seq_hash in self._data
+
+    def put(self, seq_hash: int, block: np.ndarray) -> None:
+        if seq_hash in self._data:
+            self._data.move_to_end(seq_hash)
+            return
+        nbytes = block.nbytes
+        if nbytes > self.capacity_bytes:
+            return
+        while self._bytes + nbytes > self.capacity_bytes and self._data:
+            _, old = self._data.popitem(last=False)  # LRU
+            self._bytes -= old.nbytes
+            self.evicted_blocks += 1
+        self._data[seq_hash] = block
+        self._bytes += nbytes
+        self.stored_blocks += 1
+
+    def get(self, seq_hash: int) -> Optional[np.ndarray]:
+        blk = self._data.get(seq_hash)
+        if blk is not None:
+            self._data.move_to_end(seq_hash)  # touch
+        return blk

@@ -16,6 +16,7 @@ matching the reference engines' recompute-style preemption).
 
 from __future__ import annotations
 
+import zlib
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, List, Optional, Sequence, Tuple
@@ -36,6 +37,11 @@ class SequenceState:
     sampling_temperature: float = 0.0
     sampling_top_k: int = 0
     sampling_top_p: float = 1.0
+    sampling_seed: int = 0  # per-request rng stream (engine fills default)
+    freq_penalty: float = 0.0
+    pres_penalty: float = 0.0
+    # None = no logprobs; 0 = chosen-token only; N = chosen + top-N
+    logprobs: Optional[int] = None
     max_new_tokens: Optional[int] = None
     min_new_tokens: Optional[int] = None
     stop_token_ids: frozenset = frozenset()
@@ -84,6 +90,19 @@ class SequenceState:
             sampling_temperature=samp.temperature or 0.0,
             sampling_top_k=samp.top_k or 0,
             sampling_top_p=samp.top_p if samp.top_p is not None else 1.0,
+            sampling_seed=(
+                # Masked to uint32 either way: a user seed of -1 or 2**64
+                # must not blow up the numpy cast in _sampling_arrays.
+                samp.seed & 0xFFFFFFFF
+                if samp.seed is not None
+                # Engine-assigned deterministic default: stable per request
+                # id (crc32 — not Python's salted hash), so replays
+                # reproduce without a global stream.
+                else (zlib.crc32(request_id.encode()) ^ cfg.seed) & 0xFFFFFFFF
+            ),
+            freq_penalty=samp.frequency_penalty or 0.0,
+            pres_penalty=samp.presence_penalty or 0.0,
+            logprobs=getattr(samp, "logprobs", None),
             max_new_tokens=stop.max_tokens,
             min_new_tokens=stop.min_tokens,
             stop_token_ids=frozenset(stop.stop_token_ids or ()),

@@ -131,6 +131,22 @@ class Backend(Operator):
                     item = dict(out)
                     item["text"] = emit_text
                     item["finish_reason"] = None
+                    lp = out.get("logprobs")
+                    if lp is not None:
+                        # Render token ids to strings here — the only layer
+                        # holding the tokenizer (OpenAI logprobs carry text).
+                        toks = out.get("token_ids") or [0]
+                        item["logprobs"] = {
+                            "token": self._tokenizer.decode([toks[0]]),
+                            "logprob": lp["logprob"],
+                            "top": [
+                                {
+                                    "token": self._tokenizer.decode([tid]),
+                                    "logprob": l,
+                                }
+                                for tid, l in lp.get("top", [])
+                            ],
+                        }
                     yield item
                 if finish is not None:
                     finished = True

@@ -86,8 +86,15 @@ class CommonFields(BaseModel):
 class ChatCompletionRequest(CommonFields):
     messages: List[ChatMessage]
     logprobs: Optional[bool] = None
+    top_logprobs: Optional[int] = None
     tools: Optional[List[Dict[str, Any]]] = None
     stream_options: Optional[Dict[str, Any]] = None
+
+    def sampling_options(self) -> SamplingOptions:
+        opts = super().sampling_options()
+        if self.logprobs:
+            opts.logprobs = self.top_logprobs or 0
+        return opts
 
 
 class CompletionRequest(CommonFields):
@@ -95,6 +102,12 @@ class CompletionRequest(CommonFields):
     echo: Optional[bool] = None
     logprobs: Optional[int] = None
     stream_options: Optional[Dict[str, Any]] = None
+
+    def sampling_options(self) -> SamplingOptions:
+        opts = super().sampling_options()
+        if self.logprobs is not None:
+            opts.logprobs = self.logprobs
+        return opts
 
 
 def _now() -> int:
@@ -109,12 +122,19 @@ class DeltaGenerator:
     first chunk, finish_reason on the last, optional usage chunk.
     """
 
-    def __init__(self, model: str, chat: bool = True, request_id: Optional[str] = None):
+    def __init__(
+        self,
+        model: str,
+        chat: bool = True,
+        request_id: Optional[str] = None,
+        index: int = 0,
+    ):
         self.chat = chat
         self.model = model
         self.id = ("chatcmpl-" if chat else "cmpl-") + (request_id or uuid.uuid4().hex)
         self.created = _now()
         self.object = "chat.completion.chunk" if chat else "text_completion"
+        self.index = index  # choice index (n > 1 fan-out)
         self._first = True
 
     def _base(self) -> Dict[str, Any]:
@@ -125,24 +145,48 @@ class DeltaGenerator:
             "model": self.model,
         }
 
-    def text_chunk(self, text: str) -> Dict[str, Any]:
+    def text_chunk(
+        self, text: str, logprobs: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
         out = self._base()
         if self.chat:
             delta: Dict[str, Any] = {"content": text}
             if self._first:
                 delta["role"] = "assistant"
                 self._first = False
-            out["choices"] = [{"index": 0, "delta": delta, "finish_reason": None}]
+            choice: Dict[str, Any] = {
+                "index": self.index, "delta": delta, "finish_reason": None
+            }
+            if logprobs is not None:
+                choice["logprobs"] = {
+                    "content": [
+                        {
+                            "token": logprobs["token"],
+                            "logprob": logprobs["logprob"],
+                            "top_logprobs": logprobs.get("top", []),
+                        }
+                    ]
+                }
+            out["choices"] = [choice]
         else:
-            out["choices"] = [{"index": 0, "text": text, "finish_reason": None}]
+            choice = {"index": self.index, "text": text, "finish_reason": None}
+            if logprobs is not None:
+                choice["logprobs"] = {
+                    "tokens": [logprobs["token"]],
+                    "token_logprobs": [logprobs["logprob"]],
+                    "top_logprobs": [
+                        {t["token"]: t["logprob"] for t in logprobs.get("top", [])}
+                    ],
+                }
+            out["choices"] = [choice]
         return out
 
     def finish_chunk(self, finish_reason: str) -> Dict[str, Any]:
         out = self._base()
         if self.chat:
-            out["choices"] = [{"index": 0, "delta": {}, "finish_reason": finish_reason}]
+            out["choices"] = [{"index": self.index, "delta": {}, "finish_reason": finish_reason}]
         else:
-            out["choices"] = [{"index": 0, "text": "", "finish_reason": finish_reason}]
+            out["choices"] = [{"index": self.index, "text": "", "finish_reason": finish_reason}]
         return out
 
     def usage_chunk(self, usage: Dict[str, int]) -> Dict[str, Any]:
@@ -162,42 +206,79 @@ def aggregate_chunks(chunks: List[Dict[str, Any]]) -> Dict[str, Any]:
         raise ValueError("empty stream")
     first = chunks[0]
     chat = first.get("object") == "chat.completion.chunk"
-    text_parts: List[str] = []
-    finish_reason: Optional[str] = None
+
+    class _Acc:
+        def __init__(self):
+            self.text: List[str] = []
+            self.finish: Optional[str] = None
+            self.role = "assistant"
+            self.lp_content: List[Dict[str, Any]] = []  # chat logprobs
+            self.lp_tokens: List[str] = []  # completions logprobs
+            self.lp_vals: List[float] = []
+            self.lp_top: List[Dict[str, float]] = []
+
+    accs: Dict[int, _Acc] = {}
     usage: Optional[Dict[str, int]] = None
-    role = "assistant"
     for ch in chunks:
         if ch.get("usage"):
-            usage = ch["usage"]
+            u = ch["usage"]
+            if usage is None:
+                usage = dict(u)
+            else:  # n > 1: completions sum, the shared prompt counts once
+                usage["completion_tokens"] = usage.get(
+                    "completion_tokens", 0
+                ) + u.get("completion_tokens", 0)
+                usage["total_tokens"] = (
+                    usage.get("prompt_tokens", 0) + usage["completion_tokens"]
+                )
         for choice in ch.get("choices", []):
+            acc = accs.setdefault(int(choice.get("index", 0)), _Acc())
+            lp = choice.get("logprobs")
             if chat:
                 delta = choice.get("delta", {})
                 if delta.get("role"):
-                    role = delta["role"]
+                    acc.role = delta["role"]
                 if delta.get("content"):
-                    text_parts.append(delta["content"])
+                    acc.text.append(delta["content"])
+                if lp and lp.get("content"):
+                    acc.lp_content.extend(lp["content"])
             else:
                 if choice.get("text"):
-                    text_parts.append(choice["text"])
+                    acc.text.append(choice["text"])
+                if lp:
+                    acc.lp_tokens.extend(lp.get("tokens", []))
+                    acc.lp_vals.extend(lp.get("token_logprobs", []))
+                    acc.lp_top.extend(lp.get("top_logprobs", []))
             if choice.get("finish_reason"):
-                finish_reason = choice["finish_reason"]
-    full_text = "".join(text_parts)
+                acc.finish = choice["finish_reason"]
     out = {
         "id": first["id"],
         "object": "chat.completion" if chat else "text_completion",
         "created": first["created"],
         "model": first["model"],
     }
-    if chat:
-        out["choices"] = [
-            {
-                "index": 0,
-                "message": {"role": role, "content": full_text},
-                "finish_reason": finish_reason,
+    choices = []
+    for idx in sorted(accs) or [0]:
+        acc = accs.get(idx, _Acc())
+        full_text = "".join(acc.text)
+        if chat:
+            c: Dict[str, Any] = {
+                "index": idx,
+                "message": {"role": acc.role, "content": full_text},
+                "finish_reason": acc.finish,
             }
-        ]
-    else:
-        out["choices"] = [{"index": 0, "text": full_text, "finish_reason": finish_reason}]
+            if acc.lp_content:
+                c["logprobs"] = {"content": acc.lp_content}
+        else:
+            c = {"index": idx, "text": full_text, "finish_reason": acc.finish}
+            if acc.lp_tokens:
+                c["logprobs"] = {
+                    "tokens": acc.lp_tokens,
+                    "token_logprobs": acc.lp_vals,
+                    "top_logprobs": acc.lp_top,
+                }
+        choices.append(c)
+    out["choices"] = choices
     if usage is not None:
         out["usage"] = usage
     return out

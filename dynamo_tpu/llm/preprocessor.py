@@ -77,12 +77,111 @@ class OpenAIPreprocessor(Operator):
         raw = request.data
         chat = "messages" in raw if isinstance(raw, dict) else True
         pre = self.preprocess(raw)
-        stream = await next.generate(request.transfer(pre.to_dict()))
         model = pre.model or self.model_name
+        n = int(raw.get("n") or 1) if isinstance(raw, dict) else 1
+        if n <= 1:
+            stream = await next.generate(request.transfer(pre.to_dict()))
+            return ResponseStream(
+                self._to_chunks(stream, model, chat, request.id, pre.annotations),
+                request.ctx,
+            )
+        # n > 1: one engine request per choice — the prefix cache shares the
+        # prompt KV across them; streams merge with per-choice indices.
+        # Reference: protocols/openai (n) + multiple SSE choice indices.
+        import dataclasses
+
+        from ..runtime.engine import AsyncEngineContext
+
+        streams = []
+        for i in range(n):
+            child = AsyncEngineContext(f"{request.id}-c{i}")
+            request.ctx.link_child(child)
+            pre_i = pre
+            if pre.sampling_options.seed is not None:
+                so = dataclasses.replace(
+                    pre.sampling_options, seed=pre.sampling_options.seed + i
+                )
+                pre_i = dataclasses.replace(pre, sampling_options=so)
+            streams.append(
+                await next.generate(Context(pre_i.to_dict(), child))
+            )
         return ResponseStream(
-            self._to_chunks(stream, model, chat, request.id, pre.annotations),
+            self._merge_choices(
+                streams, model, chat, request.id, pre.annotations
+            ),
             request.ctx,
         )
+
+    async def _merge_choices(
+        self,
+        streams,
+        model: str,
+        chat: bool,
+        request_id: str,
+        annotations: Dict[str, Any],
+    ) -> AsyncIterator[Dict[str, Any]]:
+        """Interleave n sub-request streams into one chunk stream with
+        per-choice indices; one summed usage chunk at the end."""
+        import asyncio
+
+        queue: "asyncio.Queue" = asyncio.Queue()
+
+        async def pump(i: int, stream) -> None:
+            gen = DeltaGenerator(model, chat=chat, request_id=request_id, index=i)
+            try:
+                async for item in stream:
+                    reason = item.get("finish_reason")
+                    if reason is not None:
+                        await queue.put((gen.finish_chunk(reason), item.get("usage")))
+                        return
+                    if item.get("text") or item.get("logprobs"):
+                        await queue.put(
+                            (
+                                gen.text_chunk(
+                                    item.get("text") or "",
+                                    logprobs=item.get("logprobs"),
+                                ),
+                                None,
+                            )
+                        )
+            except Exception as e:  # surface, don't truncate silently
+                await queue.put((e, None))
+            finally:
+                await stream.aclose()
+                await queue.put((None, None))  # stream-done marker
+
+        tasks = [asyncio.ensure_future(pump(i, s)) for i, s in enumerate(streams)]
+        try:
+            if annotations:
+                yield {"__annotations__": annotations}
+            done = 0
+            usages = []
+            while done < len(streams):
+                chunk, usage = await queue.get()
+                if usage:
+                    usages.append(usage)
+                if chunk is None:
+                    done += 1
+                    continue
+                if isinstance(chunk, Exception):
+                    # A failed choice fails the request, matching n=1.
+                    raise chunk
+                yield chunk
+            if usages:
+                merged = {
+                    "prompt_tokens": usages[0].get("prompt_tokens", 0),
+                    "completion_tokens": sum(
+                        u.get("completion_tokens", 0) for u in usages
+                    ),
+                }
+                merged["total_tokens"] = (
+                    merged["prompt_tokens"] + merged["completion_tokens"]
+                )
+                gen = DeltaGenerator(model, chat=chat, request_id=request_id)
+                yield gen.usage_chunk(merged)
+        finally:
+            for t in tasks:
+                t.cancel()
 
     async def _to_chunks(
         self,
@@ -108,7 +207,9 @@ class OpenAIPreprocessor(Operator):
                     else:
                         yield gen.finish_chunk(reason)
                     return
-                if item.get("text"):
-                    yield gen.text_chunk(item["text"])
+                if item.get("text") or item.get("logprobs"):
+                    yield gen.text_chunk(
+                        item.get("text") or "", logprobs=item.get("logprobs")
+                    )
         finally:
             await stream.aclose()

@@ -67,6 +67,8 @@ class SamplingOptions:
     frequency_penalty: Optional[float] = None
     presence_penalty: Optional[float] = None
     seed: Optional[int] = None
+    # None = no logprobs; 0 = chosen-token only; N = chosen + top-N
+    logprobs: Optional[int] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -76,6 +78,7 @@ class SamplingOptions:
             "frequency_penalty": self.frequency_penalty,
             "presence_penalty": self.presence_penalty,
             "seed": self.seed,
+            "logprobs": self.logprobs,
         }
 
     @classmethod
@@ -87,6 +90,7 @@ class SamplingOptions:
             frequency_penalty=d.get("frequency_penalty"),
             presence_penalty=d.get("presence_penalty"),
             seed=d.get("seed"),
+            logprobs=d.get("logprobs"),
         )
 
 

@@ -25,7 +25,6 @@ from typing import Any, Dict, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import decode_attention, paged_attention, write_kv
 from ..ops.ragged_attention import ragged_attention, write_kv_ragged
 from ..ops.rope import apply_rope, rope_frequencies
 from .config import ModelConfig
@@ -72,43 +71,6 @@ class RaggedBatch(NamedTuple):
     page_indices: jnp.ndarray  # [S, pages_per_seq] int32
     cu_q_lens: jnp.ndarray  # [S+1] int32
     num_seqs: jnp.ndarray  # [1] int32
-
-
-class KVCache(NamedTuple):
-    """Per-layer head-major slot slabs:
-    [num_layers, kv_heads, num_slots, head_dim] — reshapes for free to the
-    pages layout [kv_heads, num_pages, page_size, head_dim] the decode
-    kernels stream (ops/attention.py module doc)."""
-
-    k: jnp.ndarray
-    v: jnp.ndarray
-
-    @classmethod
-    def create(
-        cls, config: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16
-    ) -> "KVCache":
-        shape = (
-            config.num_layers,
-            config.num_kv_heads,
-            num_blocks * block_size,
-            config.head_dim,
-        )
-        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
-
-
-class ModelBatch(NamedTuple):
-    """One device step's worth of work (static shapes per bucket).
-
-    Padding convention: unused query positions have slot_mapping -1 and
-    position 0; unused batch rows have context_len 0.
-    """
-
-    token_ids: jnp.ndarray  # [B, Sq] int32
-    positions: jnp.ndarray  # [B, Sq] int32
-    slot_mapping: jnp.ndarray  # [B, Sq] int32 (-1 = padding)
-    block_tables: jnp.ndarray  # [B, max_blocks] int32
-    context_lens: jnp.ndarray  # [B] int32
-    logits_idx: jnp.ndarray  # [B] int32 — query index whose logits we keep
 
 
 def _dtype(config: ModelConfig):
@@ -164,79 +126,6 @@ def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
-
-
-def forward(
-    params: Params,
-    config: ModelConfig,
-    batch: ModelBatch,
-    kv_cache: KVCache,
-    block_size: int,
-    attn_impl: str = "xla",
-) -> Tuple[jnp.ndarray, KVCache]:
-    """Run the decoder; returns (logits [B, vocab] f32, updated cache).
-
-    The cache arrays should be donated by the caller's jit so the scatter
-    updates happen in place in HBM.  ``attn_impl`` selects the decode-path
-    attention backend (xla gather | custom pallas | jax built-in); prefill
-    (Sq > 1) always uses the XLA gather path.
-    """
-    B, Sq = batch.token_ids.shape
-    H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
-    inv_freq = rope_frequencies(hd, config.rope_theta, config.rope_scaling)
-
-    h = params["embed"][batch.token_ids]  # [B, Sq, D]
-
-    def layer(carry, xs):
-        h = carry
-        lp, kc, vc = xs
-        x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
-        q = (x @ lp["wq"]).reshape(B, Sq, H, hd)
-        k = (x @ lp["wk"]).reshape(B, Sq, KV, hd)
-        v = (x @ lp["wv"]).reshape(B, Sq, KV, hd)
-        q = apply_rope(q, batch.positions, inv_freq)
-        k = apply_rope(k, batch.positions, inv_freq)
-        kc, vc = write_kv(kc, vc, k, v, batch.slot_mapping)
-        if Sq == 1 and attn_impl != "xla":
-            attn = decode_attention(
-                q,
-                kc,
-                vc,
-                batch.block_tables,
-                batch.context_lens,
-                block_size,
-                impl=attn_impl,
-            )
-        else:
-            attn = paged_attention(
-                q,
-                kc,
-                vc,
-                batch.block_tables,
-                batch.context_lens,
-                batch.positions,
-                block_size,
-            )
-        h = h + attn.reshape(B, Sq, H * hd) @ lp["wo"]
-        x = rms_norm(h, lp["mlp_norm"], config.rms_norm_eps)
-        if config.is_moe:
-            h = h + moe_mlp(x, lp, config)
-        else:
-            gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-            h = h + ((gate * (x @ lp["w_up"])) @ lp["w_down"])
-        return h, (kc, vc)
-
-    h, (k_new, v_new) = jax.lax.scan(
-        layer, h, (params["layers"], kv_cache.k, kv_cache.v)
-    )
-
-    h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
-    h_last = h[jnp.arange(B), batch.logits_idx]  # [B, D]
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    logits = (h_last @ head).astype(jnp.float32)  # [B, vocab]
-    return logits, KVCache(k_new, v_new)
 
 
 def forward_ragged(

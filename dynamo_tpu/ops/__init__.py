@@ -6,6 +6,10 @@ inside vLLM.  Here the whole compute path is native: XLA-fused reference
 implementations first, Pallas kernels for the hot paths.
 """
 
-from .attention import paged_attention, write_kv  # noqa: F401
+from .ragged_attention import (  # noqa: F401
+    on_tpu,
+    ragged_attention,
+    write_kv_ragged,
+)
 from .rope import apply_rope, rope_frequencies  # noqa: F401
 from .sampling import sample_tokens  # noqa: F401

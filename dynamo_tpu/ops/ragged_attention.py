@@ -32,6 +32,16 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def on_tpu() -> bool:
+    """True when default execution actually lands on a TPU — accounts for a
+    jax_default_device override (tests pin CPU while a TPU plugin is still
+    registered as the default backend)."""
+    if jax.default_backend() != "tpu":
+        return False
+    dev = jax.config.jax_default_device
+    return dev is None or getattr(dev, "platform", None) == "tpu"
+
+
 def write_kv_ragged(
     pages: jnp.ndarray,  # [num_pages, page_size, 2*kv_heads, head_dim]
     k_new: jnp.ndarray,  # [T, kv_heads, head_dim]
@@ -73,6 +83,13 @@ def ragged_attention(
             ragged_paged_attention,
         )
 
+        # The kernel's default KV block spans all of pages_per_seq; at long
+        # context (e.g. 256 pages = 4k tokens) its double-buffered VMEM
+        # scratch exceeds the 16MB scoped limit.  Cap the per-block page
+        # count so 2 x nkv x page_size x 2KV x head_dim x 2B stays ~4MB.
+        ps, KV2, hd = pages.shape[1], pages.shape[2], pages.shape[3]
+        nkv = max(1, (4 << 20) // max(1, 2 * ps * KV2 * hd * 2))
+        nkv = min(page_indices.shape[1], nkv)
         return ragged_paged_attention(
             q,
             pages,
@@ -81,6 +98,11 @@ def ragged_attention(
             cu_q_lens,
             num_seqs,
             sm_scale=sm_scale,
+            num_kv_pages_per_block=nkv,
+            # The default 16MB scoped-vmem budget is a compiler default, not
+            # the hardware ceiling; long-context shapes need headroom (vLLM's
+            # TPU backend raises it the same way).
+            vmem_limit_bytes=64 << 20,
         )
     if impl != "xla":
         raise ValueError(f"unknown ragged attention impl {impl!r}")

@@ -1,24 +1,57 @@
-"""Batched on-device token sampling: greedy / temperature / top-k / top-p.
+"""Batched on-device token sampling: greedy / temperature / top-k / top-p /
+frequency+presence penalties / per-request seeds / logprobs.
 
 All requests in a decode batch sample in one fused op with per-request
 parameters as arrays — no host round-trip per request.  temperature == 0
 means greedy regardless of the other knobs.
 
+Reference semantics: lib/llm/src/protocols/common.rs SamplingOptions
+(temperature/top_p/top_k/frequency_penalty/presence_penalty/seed) — the
+reference hands these to vLLM's sampler; this is the TPU-native sampler.
+
 Cost shape matters here: this runs inside every decode step, and a full-vocab
 sort (bitonic on TPU) of [B, 128k] costs more than an entire memory-bound
 decode layer.  So the filtered path uses ONE sort (top-k and top-p both read
 the same descending-sorted copy), and runtime ``lax.cond`` branches skip the
-sort entirely when no row needs filtering and skip sampling when every row is
-greedy — HLO conditionals execute only the taken branch on device.
+sort / penalties / logprobs work entirely when no row needs them — HLO
+conditionals execute only the taken branch on device.
+
+Randomness: each row draws from ``fold_in(PRNGKey(seed), step)`` where
+``step`` is the row's output-token index — a request's sampled tokens are
+reproducible regardless of how it was batched or preempted.
 """
 
 from __future__ import annotations
+
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 NEG_INF = -1e30
+TOPK_LOGPROBS = 8  # top-k logprobs returned when logprobs are requested
+
+
+class SampleOut(NamedTuple):
+    tokens: jnp.ndarray  # [B] int32
+    logprob: jnp.ndarray  # [B] f32 — raw log p(sampled token)
+    top_ids: jnp.ndarray  # [B, TOPK_LOGPROBS] int32
+    top_logprobs: jnp.ndarray  # [B, TOPK_LOGPROBS] f32
+
+
+class SamplingParams(NamedTuple):
+    """Per-row sampling state for one device step (host-built)."""
+
+    seeds: object  # [B] uint32
+    steps: object  # [B] int32 — output-token index (rng stream position)
+    temperature: object  # [B] f32
+    top_k: object  # [B] int32
+    top_p: object  # [B] f32
+    freq_penalty: object  # [B] f32
+    pres_penalty: object  # [B] f32
+    counts: object  # [B, V] int16 output-token histogram
+    need_logprobs: object  # [] bool
 
 
 def _filtered_logits(
@@ -52,32 +85,86 @@ def _filtered_logits(
     return jnp.where(scaled >= thresh, scaled, NEG_INF)
 
 
+def _row_keys(seeds: jnp.ndarray, steps: jnp.ndarray) -> jnp.ndarray:
+    """[B] independent PRNG keys: fold_in(PRNGKey(seed), step)."""
+
+    def one(seed, step):
+        return jax.random.fold_in(jax.random.PRNGKey(seed), step)
+
+    return jax.vmap(one)(seeds.astype(jnp.uint32), steps.astype(jnp.uint32))
+
+
 def sample_tokens(
     logits: jnp.ndarray,  # [B, V] f32
-    rng: jax.Array,
+    seeds: jnp.ndarray,  # [B] uint32 per-request seed
+    steps: jnp.ndarray,  # [B] int32 output-token index (rng stream position)
     temperature: jnp.ndarray,  # [B] f32; 0 → greedy
     top_k: jnp.ndarray,  # [B] int32; 0 → disabled
     top_p: jnp.ndarray,  # [B] f32; 1.0 → disabled
-) -> jnp.ndarray:
-    """Returns sampled token ids [B] int32."""
+    freq_penalty: jnp.ndarray,  # [B] f32; 0 → disabled
+    pres_penalty: jnp.ndarray,  # [B] f32; 0 → disabled
+    counts: jnp.ndarray,  # [B, V] int16 output-token counts (penalties)
+    need_logprobs: jnp.ndarray,  # [] bool — any row wants logprobs
+) -> SampleOut:
+    """Sample one token per row; optionally raw logprobs of the choice."""
     B, V = logits.shape
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def penalized() -> jnp.ndarray:
+        c = counts.astype(jnp.float32)
+        return logits - freq_penalty[:, None] * c - pres_penalty[:, None] * (
+            c > 0
+        )
+
+    any_pen = jnp.any((freq_penalty != 0.0) | (pres_penalty != 0.0))
+    eff = lax.cond(any_pen, penalized, lambda: logits)
+
+    greedy = jnp.argmax(eff, axis=-1).astype(jnp.int32)
     temp = jnp.maximum(temperature, 1e-6)[:, None]
+    keys = _row_keys(seeds, steps)
+
+    def cat(scaled: jnp.ndarray) -> jnp.ndarray:
+        return jax.vmap(
+            lambda k, row: jax.random.categorical(k, row)
+        )(keys, scaled).astype(jnp.int32)
 
     def sample_filtered() -> jnp.ndarray:
-        scaled = _filtered_logits(logits / temp, top_k, top_p)
-        sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+        sampled = cat(_filtered_logits(eff / temp, top_k, top_p))
         return jnp.where(temperature <= 0.0, greedy, sampled)
 
     def sample_plain() -> jnp.ndarray:
-        sampled = jax.random.categorical(rng, logits / temp, axis=-1)
-        return jnp.where(temperature <= 0.0, greedy, sampled.astype(jnp.int32))
+        sampled = cat(eff / temp)
+        return jnp.where(temperature <= 0.0, greedy, sampled)
 
     need_filter = jnp.any(
         (temperature > 0.0) & ((top_k > 0) | (top_p < 1.0))
     )
-    return lax.cond(
+    tokens = lax.cond(
         jnp.any(temperature > 0.0),
         lambda: lax.cond(need_filter, sample_filtered, sample_plain),
         lambda: greedy,
     )
+
+    def with_logprobs():
+        # Raw model distribution (pre-penalty, pre-temperature) — the
+        # OpenAI-reported quantity.
+        k = min(TOPK_LOGPROBS, V)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        chosen = jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]
+        top_lp, top_ids = lax.top_k(logp, k)
+        pad = TOPK_LOGPROBS - k  # tiny test vocabs: stable output width
+        if pad:
+            top_lp = jnp.pad(top_lp, ((0, 0), (0, pad)), constant_values=NEG_INF)
+            top_ids = jnp.pad(top_ids, ((0, 0), (0, pad)))
+        return chosen, top_ids.astype(jnp.int32), top_lp
+
+    def without_logprobs():
+        return (
+            jnp.zeros((B,), jnp.float32),
+            jnp.zeros((B, TOPK_LOGPROBS), jnp.int32),
+            jnp.zeros((B, TOPK_LOGPROBS), jnp.float32),
+        )
+
+    chosen, top_ids, top_lp = lax.cond(
+        need_logprobs, with_logprobs, without_logprobs
+    )
+    return SampleOut(tokens, chosen, top_ids, top_lp)

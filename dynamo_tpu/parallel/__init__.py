@@ -6,10 +6,13 @@ named axes, PartitionSpec trees per params structure, and XLA-generated ICI
 collectives.
 """
 
+from .distributed import (  # noqa: F401
+    MultiHostConfig,
+    global_array,
+    init_multihost,
+)
 from .mesh import (  # noqa: F401
     MeshConfig,
-    batch_pspecs,
-    cache_pspec,
     make_mesh,
     pages_pspec,
     param_pspecs,

@@ -97,30 +97,11 @@ def param_pspecs(config: ModelConfig) -> Any:
     return specs
 
 
-def cache_pspec() -> P:
-    """KVCache slabs [L, kv_heads, slots, head_dim]: heads shard on tp."""
-    return P(None, "tp", None, None)
-
-
 def pages_pspec() -> P:
     """PagedKVCache slabs [L, pages, page_size, 2*kv_heads, head_dim]: the
     combined K/V head axis shards on tp (tp | kv_heads keeps each K/V pair
     on one shard)."""
     return P(None, None, None, "tp", None)
-
-
-def batch_pspecs() -> Any:
-    """ModelBatch arrays: batch dim shards on dp, rest replicated."""
-    from ..models.llama import ModelBatch
-
-    return ModelBatch(
-        token_ids=P("dp", None),
-        positions=P("dp", None),
-        slot_mapping=P("dp", None),
-        block_tables=P("dp", None),
-        context_lens=P("dp"),
-        logits_idx=P("dp"),
-    )
 
 
 def _trim(spec: P, ndim: int) -> P:

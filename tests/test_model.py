@@ -1,161 +1,14 @@
-"""Model/ops layer tests: paged attention vs dense reference, prefill/decode
-consistency, MoE, TP-sharded forward equivalence on the virtual CPU mesh."""
+"""Primitive-op tests: RoPE scaling and the fused batched sampler.  The
+forward path itself (prefill/decode/MoE/TP) is covered against a dense
+oracle in test_ragged_forward.py; the attention op against the pallas
+reference in test_ragged_attention.py."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
-from dynamo_tpu.models import get_config
-from dynamo_tpu.models.llama import KVCache, ModelBatch, forward, init_params
-from dynamo_tpu.ops.attention import paged_attention, write_kv
 from dynamo_tpu.ops.rope import rope_frequencies
 from dynamo_tpu.ops.sampling import sample_tokens
-from dynamo_tpu.parallel import (
-    MeshConfig,
-    cache_pspec,
-    make_mesh,
-    param_pspecs,
-    shard_tree,
-)
-
-BLOCK = 4
-
-
-def dense_attention(q, k, v, positions, context_len):
-    """Straightforward causal softmax attention (float32, GQA)."""
-    B, Sq, H, D = q.shape
-    KV = k.shape[2]
-    G = H // KV
-    qf = q.astype(jnp.float32).reshape(B, Sq, KV, G, D) * (D**-0.5)
-    logits = jnp.einsum("bqkgd,blkd->bkgql", qf, k.astype(jnp.float32))
-    L = k.shape[1]
-    ctx = jnp.arange(L)
-    mask = (ctx[None, None, :] <= positions[:, :, None]) & (
-        ctx[None, None, :] < context_len[:, None, None]
-    )
-    logits = jnp.where(mask[:, None, None], logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bkgql,blkd->bqkgd", probs, v.astype(jnp.float32))
-    return out.reshape(B, Sq, H, D)
-
-
-def test_paged_attention_matches_dense():
-    key = jax.random.PRNGKey(0)
-    B, S, H, KV, D = 2, 10, 4, 2, 16
-    nblocks = 8
-    ks = jax.random.split(key, 3)
-    q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
-    k = jax.random.normal(ks[1], (B, S, KV, D), jnp.float32)
-    v = jax.random.normal(ks[2], (B, S, KV, D), jnp.float32)
-
-    # Scatter k/v into a paged cache with arbitrary (non-contiguous) blocks.
-    kc = jnp.zeros((KV, nblocks * BLOCK, D), jnp.float32)
-    vc = jnp.zeros_like(kc)
-    tables = jnp.array([[3, 0, 6, 7], [5, 1, 2, 7]], jnp.int32)
-    positions = jnp.tile(jnp.arange(S), (B, 1))
-    slot_map = jnp.take_along_axis(
-        tables, positions // BLOCK, axis=1
-    ) * BLOCK + positions % BLOCK
-    kc, vc = write_kv(kc, vc, k, v, slot_map)
-
-    ctx_len = jnp.array([S, S], jnp.int32)
-    out = paged_attention(q, kc, vc, tables, ctx_len, positions, BLOCK)
-    ref = dense_attention(q, k, v, positions, ctx_len)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
-
-
-def test_decode_attention_impls_agree():
-    """The Pallas decode kernel (interpret mode on CPU) must match the XLA
-    gather path bit-for-bit-ish."""
-    from dynamo_tpu.ops.attention import decode_attention
-
-    key = jax.random.PRNGKey(4)
-    B, H, KV, D = 2, 4, 2, 128  # head_dim 128 = TPU lane width
-    nblocks = 8
-    ks = jax.random.split(key, 3)
-    q = jax.random.normal(ks[0], (B, 1, H, D), jnp.float32)
-    kc = jax.random.normal(ks[1], (KV, nblocks * BLOCK, D), jnp.float32)
-    vc = jax.random.normal(ks[2], (KV, nblocks * BLOCK, D), jnp.float32)
-    tables = jnp.array([[3, 0, 6, 1], [5, 1, 2, 4]], jnp.int32)
-    ctx_len = jnp.array([9, 14], jnp.int32)
-
-    ref = decode_attention(q, kc, vc, tables, ctx_len, BLOCK, impl="xla")
-    pal = decode_attention(q, kc, vc, tables, ctx_len, BLOCK, impl="pallas")
-    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref), atol=2e-5)
-
-
-def test_write_kv_drops_padding():
-    kc = jnp.zeros((1, 8, 4), jnp.float32)
-    vc = jnp.zeros_like(kc)
-    k_new = jnp.ones((1, 2, 1, 4))
-    slot = jnp.array([[1, -1]], jnp.int32)  # second token is padding
-    kc2, _ = write_kv(kc, vc, k_new, k_new, slot)
-    assert float(kc2[0, 1].sum()) == 4.0
-    assert float(kc2.sum()) == 4.0  # nothing else written
-
-
-def _make_batch(tokens_np, tables, start_pos=None):
-    B, Sq = tokens_np.shape
-    positions = jnp.tile(jnp.arange(Sq), (B, 1))
-    if start_pos is not None:
-        positions = positions + jnp.asarray(start_pos)[:, None]
-    slot_map = (
-        jnp.take_along_axis(tables, positions // BLOCK, axis=1) * BLOCK
-        + positions % BLOCK
-    )
-    return ModelBatch(
-        token_ids=jnp.asarray(tokens_np, jnp.int32),
-        positions=positions.astype(jnp.int32),
-        slot_mapping=slot_map.astype(jnp.int32),
-        block_tables=tables,
-        context_lens=(positions[:, -1] + 1).astype(jnp.int32),
-        logits_idx=jnp.full((B,), Sq - 1, jnp.int32),
-    )
-
-
-@pytest.mark.parametrize("name", ["debug-tiny", "debug-tiny-moe"])
-def test_prefill_decode_consistency(name):
-    """Prefilling N tokens at once must equal feeding them one by one."""
-    cfg = get_config(name).with_overrides(dtype="float32")
-    params = init_params(cfg, jax.random.PRNGKey(1))
-    rng = np.random.default_rng(2)
-    B, S = 2, 7
-    tokens = rng.integers(0, cfg.vocab_size, (B, S))
-    tables = jnp.array([[0, 1, 2], [3, 4, 5]], jnp.int32)
-
-    cache = KVCache.create(cfg, num_blocks=8, block_size=BLOCK, dtype=jnp.float32)
-    logits_pre, _ = forward(params, cfg, _make_batch(tokens, tables), cache, BLOCK)
-
-    cache = KVCache.create(cfg, num_blocks=8, block_size=BLOCK, dtype=jnp.float32)
-    for i in range(S):
-        batch = _make_batch(tokens[:, i : i + 1], tables, start_pos=[i, i])
-        logits_dec, cache = forward(params, cfg, batch, cache, BLOCK)
-
-    np.testing.assert_allclose(
-        np.asarray(logits_pre), np.asarray(logits_dec), atol=2e-4, rtol=2e-4
-    )
-
-
-def test_tp_sharded_forward_matches_single_device():
-    cfg = get_config("debug-tiny").with_overrides(dtype="float32")
-    params = init_params(cfg, jax.random.PRNGKey(3))
-    tokens = np.arange(10).reshape(2, 5) % cfg.vocab_size
-    tables = jnp.array([[0, 1], [2, 3]], jnp.int32)
-    cache = KVCache.create(cfg, num_blocks=4, block_size=BLOCK, dtype=jnp.float32)
-    batch = _make_batch(tokens, tables)
-
-    logits_local, _ = forward(params, cfg, batch, cache, BLOCK)
-
-    mesh = make_mesh(MeshConfig(tp=2))
-    params_s = shard_tree(params, param_pspecs(cfg), mesh)
-    cache_s = shard_tree(cache, KVCache(cache_pspec(), cache_pspec()), mesh)
-    fwd = jax.jit(forward, static_argnames=("config", "block_size"))
-    logits_tp, _ = fwd(params_s, cfg, batch, cache_s, BLOCK)
-
-    np.testing.assert_allclose(
-        np.asarray(logits_local), np.asarray(logits_tp), atol=1e-4, rtol=1e-4
-    )
 
 
 def test_rope_llama3_scaling_changes_low_freqs():
@@ -176,20 +29,98 @@ def test_rope_llama3_scaling_changes_low_freqs():
     assert float(scaled[-1]) < float(plain[-1])
 
 
+def _sample(logits, temp, topk, topp, fpen=None, ppen=None, counts=None,
+            seeds=None, steps=None, need_lp=False):
+    B, V = logits.shape
+    return sample_tokens(
+        logits,
+        jnp.zeros(B, jnp.uint32) if seeds is None else seeds,
+        jnp.zeros(B, jnp.int32) if steps is None else steps,
+        temp,
+        topk,
+        topp,
+        jnp.zeros(B) if fpen is None else fpen,
+        jnp.zeros(B) if ppen is None else ppen,
+        jnp.zeros((B, V), jnp.int16) if counts is None else counts,
+        jnp.asarray(need_lp),
+    )
+
+
 def test_sampling_greedy_and_topk():
     logits = jnp.array([[0.0, 5.0, 1.0], [3.0, 0.0, 2.9]], jnp.float32)
-    rng = jax.random.PRNGKey(0)
     zeros = jnp.zeros(2)
     # temperature 0 → argmax
-    out = sample_tokens(logits, rng, zeros, jnp.zeros(2, jnp.int32), jnp.ones(2))
-    assert out.tolist() == [1, 0]
+    out = _sample(logits, zeros, jnp.zeros(2, jnp.int32), jnp.ones(2))
+    assert out.tokens.tolist() == [1, 0]
     # top_k=1 with temperature → still argmax
-    out = sample_tokens(
-        logits, rng, jnp.ones(2), jnp.ones(2, jnp.int32), jnp.ones(2)
-    )
-    assert out.tolist() == [1, 0]
+    out = _sample(logits, jnp.ones(2), jnp.ones(2, jnp.int32), jnp.ones(2))
+    assert out.tokens.tolist() == [1, 0]
     # top_p tiny → argmax
-    out = sample_tokens(
-        logits, rng, jnp.ones(2), jnp.zeros(2, jnp.int32), jnp.full(2, 0.01)
+    out = _sample(
+        logits, jnp.ones(2), jnp.zeros(2, jnp.int32), jnp.full(2, 0.01)
     )
-    assert out.tolist() == [1, 0]
+    assert out.tokens.tolist() == [1, 0]
+
+
+def test_sampling_mixed_batch_rows_independent():
+    """A batch mixing greedy and filtered rows must give each row its own
+    policy (the runtime lax.cond branches must not leak across rows)."""
+    logits = jnp.array(
+        [[0.0, 5.0, 1.0], [3.0, 0.0, 2.9], [0.1, 0.2, 9.0]], jnp.float32
+    )
+    temp = jnp.array([0.0, 1.0, 0.0])  # rows 0/2 greedy, row 1 sampled
+    out = _sample(logits, temp, jnp.array([0, 1, 0], jnp.int32), jnp.ones(3))
+    assert out.tokens[0] == 1 and out.tokens[2] == 2  # greedy rows
+    assert out.tokens[1] == 0  # top_k=1 → argmax even when sampling
+
+
+def test_sampling_penalties_shift_choice():
+    """Frequency/presence penalties subtract from repeated tokens' logits
+    (vLLM semantics: output-token counts only)."""
+    logits = jnp.array([[5.0, 4.9, 0.0]], jnp.float32)
+    counts = jnp.zeros((1, 3), jnp.int16).at[0, 0].set(2)
+    zero, one = jnp.zeros(1), jnp.ones(1)
+    # No penalty → token 0; freq 2*0.2 = 0.4 > 0.1 gap → token 1.
+    base = _sample(logits, zero, jnp.zeros(1, jnp.int32), one, counts=counts)
+    assert base.tokens.tolist() == [0]
+    pen = _sample(
+        logits, zero, jnp.zeros(1, jnp.int32), one,
+        fpen=jnp.full(1, 0.2), counts=counts,
+    )
+    assert pen.tokens.tolist() == [1]
+    # Presence penalty alone (0.2 > 0.1 gap) also flips it.
+    pres = _sample(
+        logits, zero, jnp.zeros(1, jnp.int32), one,
+        ppen=jnp.full(1, 0.2), counts=counts,
+    )
+    assert pres.tokens.tolist() == [1]
+
+
+def test_sampling_seed_reproducible_and_stream_advances():
+    logits = jnp.tile(jnp.array([[1.0, 1.0, 1.0, 1.0]], jnp.float32), (2, 1))
+    temp, topk, topp = jnp.ones(2), jnp.zeros(2, jnp.int32), jnp.ones(2)
+    seeds = jnp.array([7, 7], jnp.uint32)
+    a = _sample(logits, temp, topk, topp, seeds=seeds,
+                steps=jnp.array([0, 0], jnp.int32))
+    # Same seed + same step → same draw; different steps → independent draws.
+    assert a.tokens[0] == a.tokens[1]
+    draws = [
+        int(_sample(logits, temp, topk, topp, seeds=seeds,
+                    steps=jnp.array([s, s], jnp.int32)).tokens[0])
+        for s in range(8)
+    ]
+    assert len(set(draws)) > 1  # the stream advances with step
+
+
+def test_sampling_logprobs():
+    logits = jnp.array([[0.0, 2.0, 1.0]], jnp.float32)
+    out = _sample(
+        logits, jnp.zeros(1), jnp.zeros(1, jnp.int32), jnp.ones(1),
+        need_lp=True,
+    )
+    lse = float(jnp.log(jnp.sum(jnp.exp(logits[0]))))
+    np.testing.assert_allclose(float(out.logprob[0]), 2.0 - lse, rtol=1e-5)
+    assert int(out.top_ids[0, 0]) == 1 and int(out.top_ids[0, 1]) == 2
+    np.testing.assert_allclose(
+        float(out.top_logprobs[0, 0]), 2.0 - lse, rtol=1e-5
+    )

@@ -1,5 +1,8 @@
-"""forward_ragged equivalence vs the batched forward: same prompts, same
-logits — prefill, decode, and mixed prefill+decode in one ragged step."""
+"""forward_ragged correctness against an independent dense oracle: a plain
+full-context causal-attention transformer (no paging, no KV cache) sharing
+only the primitive ops (rms_norm/rope/moe).  Covers prefill, chunked prefill
++ decode, mixed prefill+decode rows, MoE, and TP-sharded equivalence on the
+virtual CPU mesh."""
 
 import jax
 import jax.numpy as jnp
@@ -7,14 +10,14 @@ import numpy as np
 
 from dynamo_tpu.models import get_config
 from dynamo_tpu.models.llama import (
-    KVCache,
-    ModelBatch,
     PagedKVCache,
     RaggedBatch,
-    forward,
     forward_ragged,
     init_params,
+    rms_norm,
 )
+from dynamo_tpu.models.moe import moe_mlp
+from dynamo_tpu.ops.rope import apply_rope, rope_frequencies
 
 BS = 4  # page size
 
@@ -25,36 +28,42 @@ def _cfgparams(name="debug-tiny"):
     return cfg, params
 
 
-def _old_prefill(cfg, params, prompts, max_blocks=8):
-    B = len(prompts)
-    Sq = max(len(p) for p in prompts)
-    cache = KVCache.create(cfg, num_blocks=B * max_blocks, block_size=BS, dtype=jnp.float32)
-    tokens = np.zeros((B, Sq), np.int32)
-    positions = np.zeros((B, Sq), np.int32)
-    slots = np.full((B, Sq), -1, np.int32)
-    tables = np.zeros((B, max_blocks), np.int32)
-    ctx = np.zeros((B,), np.int32)
-    lidx = np.zeros((B,), np.int32)
-    for i, p in enumerate(prompts):
-        tokens[i, : len(p)] = p
-        positions[i, : len(p)] = np.arange(len(p))
-        tables[i] = np.arange(max_blocks) + i * max_blocks
-        slots[i, : len(p)] = tables[i, np.arange(len(p)) // BS] * BS + np.arange(len(p)) % BS
-        ctx[i] = len(p)
-        lidx[i] = len(p) - 1
-    batch = ModelBatch(
-        token_ids=jnp.asarray(tokens),
-        positions=jnp.asarray(positions),
-        slot_mapping=jnp.asarray(slots),
-        block_tables=jnp.asarray(tables),
-        context_lens=jnp.asarray(ctx),
-        logits_idx=jnp.asarray(lidx),
-    )
-    logits, cache = forward(params, cfg, batch, cache, BS)
-    return np.asarray(logits)
+def _reference_logits(cfg, params, prompt):
+    """Dense oracle: full causal attention over the whole prompt at once.
+    Returns the LAST token's logits [vocab]."""
+    S = len(prompt)
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    G = H // KV
+    inv = rope_frequencies(hd, cfg.rope_theta, cfg.rope_scaling)
+    pos = jnp.arange(S, dtype=jnp.int32)
+    h = params["embed"][jnp.asarray(prompt)]
+    for l in range(cfg.num_layers):
+        lp = jax.tree.map(lambda a: a[l], params["layers"])
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        q = apply_rope((x @ lp["wq"]).reshape(S, H, hd), pos, inv)
+        k = apply_rope((x @ lp["wk"]).reshape(S, KV, hd), pos, inv)
+        v = (x @ lp["wv"]).reshape(S, KV, hd)
+        qf = q.astype(jnp.float32).reshape(S, KV, G, hd) * hd**-0.5
+        scores = jnp.einsum("qkgd,lkd->kgql", qf, k.astype(jnp.float32))
+        causal = pos[None, :] <= pos[:, None]  # [q, l]
+        scores = jnp.where(causal[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("kgql,lkd->qkgd", probs, v.astype(jnp.float32))
+        h = h + attn.reshape(S, H * hd).astype(h.dtype) @ lp["wo"]
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        if cfg.is_moe:
+            h = h + moe_mlp(x[None], lp, cfg)[0]
+        else:
+            gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+            h = h + (gate * (x @ lp["w_up"])) @ lp["w_down"]
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return np.asarray((h[-1] @ head).astype(jnp.float32))
 
 
-def _ragged(cfg, params, items, S, T, pages_per_seq=8, cache=None):
+def _ragged(cfg, params, items, S, T, pages_per_seq=8, cache=None, mesh=None):
     """items: list of (tokens, start_pos, table_row).  Returns logits + cache."""
     n_pages = S * pages_per_seq
     if cache is None:
@@ -86,14 +95,14 @@ def _ragged(cfg, params, items, S, T, pages_per_seq=8, cache=None):
         cu_q_lens=jnp.asarray(cu),
         num_seqs=jnp.asarray([len(items)], np.int32),
     )
-    logits, cache = forward_ragged(params, cfg, rb, cache, attn_impl="xla")
+    logits, cache = forward_ragged(params, cfg, rb, cache, attn_impl="xla", mesh=mesh)
     return np.asarray(logits), cache
 
 
-def test_ragged_prefill_matches_batched():
+def test_ragged_prefill_matches_dense_oracle():
     cfg, params = _cfgparams()
     prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [11, 12, 13, 14, 15, 16, 17]]
-    want = _old_prefill(cfg, params, prompts)
+    want = np.stack([_reference_logits(cfg, params, p) for p in prompts])
     pp = 8
     items = [
         (p, 0, np.arange(pp, dtype=np.int32) + i * pp) for i, p in enumerate(prompts)
@@ -103,12 +112,12 @@ def test_ragged_prefill_matches_batched():
 
 
 def test_ragged_chunked_prefill_then_decode_matches_full():
-    """Chunked prefill (two ragged steps) + a decode step must equal a single
-    full prefill of prompt+token — the cache contents agree."""
+    """Chunked prefill (two ragged steps) + a decode step must equal the
+    dense oracle run over prompt+token in one pass."""
     cfg, params = _cfgparams()
     prompt = [5, 3, 8, 1, 9, 2, 7]
     nxt = 4
-    want = _old_prefill(cfg, params, [prompt + [nxt]])[0]
+    want = _reference_logits(cfg, params, prompt + [nxt])
 
     pp = 8
     table = np.arange(pp, dtype=np.int32)
@@ -157,9 +166,40 @@ def test_ragged_mixed_prefill_and_decode_rows():
     np.testing.assert_allclose(got[1], want_b[0], rtol=1e-4, atol=1e-4)
 
 
-def test_ragged_moe_forward_runs():
+def test_ragged_moe_matches_dense_oracle():
     cfg, params = _cfgparams("debug-tiny-moe")
-    items = [([1, 2, 3, 4], 0, np.arange(8, dtype=np.int32))]
+    prompt = [1, 2, 3, 4]
+    want = _reference_logits(cfg, params, prompt)
+    items = [(prompt, 0, np.arange(8, dtype=np.int32))]
     logits, _ = _ragged(cfg, params, items, S=2, T=8)
-    assert logits.shape[1] == cfg.vocab_size
+    np.testing.assert_allclose(logits[0], want, rtol=1e-4, atol=1e-4)
     assert not np.any(np.isnan(logits[0]))
+
+
+def test_ragged_tp_sharded_matches_single_device():
+    """forward_ragged under a tp=2 mesh (shard_map attention + sharded
+    params/pages) must match the unsharded run."""
+    from dynamo_tpu.parallel import (
+        MeshConfig,
+        make_mesh,
+        pages_pspec,
+        param_pspecs,
+        shard_tree,
+    )
+
+    cfg, params = _cfgparams()
+    pp = 8
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7]]
+    items = [
+        (p, 0, np.arange(pp, dtype=np.int32) + i * pp) for i, p in enumerate(prompts)
+    ]
+    want, _ = _ragged(cfg, params, items, S=2, T=8, pages_per_seq=pp)
+
+    mesh = make_mesh(MeshConfig(tp=2))
+    params_s = shard_tree(params, param_pspecs(cfg), mesh)
+    cache = PagedKVCache.create(cfg, 2 * pp, BS, dtype=jnp.float32)
+    cache_s = shard_tree(cache, PagedKVCache(pages_pspec()), mesh)
+    got, _ = _ragged(
+        cfg, params_s, items, S=2, T=8, pages_per_seq=pp, cache=cache_s, mesh=mesh
+    )
+    np.testing.assert_allclose(got[:2], want[:2], rtol=1e-4, atol=1e-4)
