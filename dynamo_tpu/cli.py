@@ -45,13 +45,21 @@ def _build_engine(out: str, args):
 
 
 def _tokenizer_spec(args) -> dict:
-    if getattr(args, "tokenizer", None):
-        return {"kind": "hf", "file": args.tokenizer}
+    tok = getattr(args, "tokenizer", None)
+    if tok:
+        if tok.endswith(".gguf"):
+            return {"kind": "gguf", "file": tok}
+        return {"kind": "hf", "file": tok}
+    ckpt = getattr(args, "checkpoint", None)
+    if ckpt and ckpt.endswith(".gguf"):
+        return {"kind": "gguf", "file": ckpt}  # embedded tokenizer
     return {"kind": "byte"}
 
 
 async def _run_hub(args) -> None:
-    server = await HubServer(host=args.host, port=args.port).start()
+    server = await HubServer(
+        host=args.host, port=args.port, persist_path=args.persist
+    ).start()
     print(f"hub listening on {server.address}", flush=True)
     await _wait_forever()
 
@@ -289,13 +297,20 @@ async def _wait_forever() -> None:
 
 
 def main(argv: Optional[list] = None) -> None:
-    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    # DYN_LOG / DYN_LOG_FORMAT / DYN_LOG_FILE (reference logging.rs)
+    from .runtime.logging_config import setup_logging
+
+    setup_logging()
     parser = argparse.ArgumentParser(prog="dynamo-tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     p_hub = sub.add_parser("hub", help="run the control-plane hub")
     p_hub.add_argument("--host", default="0.0.0.0")
     p_hub.add_argument("--port", type=int, default=6650)
+    p_hub.add_argument(
+        "--persist", default=None,
+        help="snapshot file: durable KV + queues survive hub restart",
+    )
 
     p_http = sub.add_parser("http", help="standalone OpenAI frontend w/ discovery")
     p_http.add_argument("--hub", required=True)
@@ -384,6 +399,12 @@ def main(argv: Optional[list] = None) -> None:
     p_metrics.add_argument("--host", default="0.0.0.0")
     p_metrics.add_argument("--port", type=int, default=9091)
 
+    p_deploy = sub.add_parser(
+        "deploy", help="render k8s manifests from a DynamoTpuDeployment CR"
+    )
+    p_deploy.add_argument("verb", choices=["render", "preview"])
+    p_deploy.add_argument("-f", "--file", required=True, dest="cr_file")
+
     p_mock = sub.add_parser("mock-worker", help="synthetic metrics/KV events")
     p_mock.add_argument("--hub", required=True)
     p_mock.add_argument("--namespace", default="dynamo")
@@ -412,6 +433,18 @@ def main(argv: Optional[list] = None) -> None:
                     cpu_devices=args.cpu_devices,
                 )
             )
+
+    if args.cmd == "deploy":
+        import yaml
+
+        from .deploy import render_to_yaml, shell_preview
+
+        with open(args.cr_file) as f:
+            cr = yaml.safe_load(f)
+        print(
+            render_to_yaml(cr) if args.verb == "render" else shell_preview(cr)
+        )
+        return
 
     try:
         if args.cmd == "hub":

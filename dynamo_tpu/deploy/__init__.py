@@ -1,0 +1,6 @@
+"""Deploy layer (L7): CRD-shaped deployment spec → k8s manifests.
+
+Reference counterpart: deploy/dynamo/operator (DynamoDeployment CRD +
+controller), deploy/helm.  See deploy/k8s/crd.yaml and renderer.py."""
+
+from .renderer import render, render_to_yaml, shell_preview  # noqa: F401

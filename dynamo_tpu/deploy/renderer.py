@@ -1,0 +1,226 @@
+"""DynamoTpuDeployment → k8s manifests: the operator's reconcile logic as a
+pure function.
+
+Reference counterpart: the k8s operator's child-resource generation
+(deploy/dynamo/operator/*: a DynamoDeployment CR fans out into per-service
+Deployments/Services with env wiring) and the helm chart's templates.  Here
+the same mapping is a testable function — usable by an in-cluster controller
+or from the CLI (``dynamo-tpu deploy render``) for GitOps-style flows.
+
+Service roles map onto the CLI (cli.py):
+  hub       → ``cli hub``                          (control plane)
+  frontend  → ``cli http --hub … --router kv``     (OpenAI edge)
+  worker    → ``cli run in=dyn://… out=tpu``        (aggregated engine)
+  prefill   → worker with ``--disagg prefill``
+  decode    → worker with ``--disagg decode``
+  router    → standalone KV router (via frontend flag today)
+  metrics   → ``cli metrics``
+
+Multi-host workers (nnodes > 1) render one StatefulSet with nnodes pods;
+rank/coordinator wiring comes from the pod ordinal + headless service —
+matching the engine's --nnodes/--node-rank/--coordinator flags.
+"""
+
+from __future__ import annotations
+
+import shlex
+from typing import Any, Dict, List
+
+HUB_PORT = 6650
+HTTP_PORT = 8000
+METRICS_PORT = 9091
+STEP_PORT = 6651
+COORD_PORT = 6652
+
+
+def _meta(name: str, app: str, extra: Dict[str, str] = {}) -> Dict[str, Any]:
+    return {
+        "name": name,
+        "labels": {"app.kubernetes.io/name": app,
+                   "app.kubernetes.io/part-of": "dynamo-tpu", **extra},
+    }
+
+
+def _env_list(*groups) -> List[Dict[str, str]]:
+    out: List[Dict[str, str]] = []
+    for g in groups:
+        out.extend(g or [])
+    return out
+
+
+def _engine_flags(engine: Dict[str, Any]) -> List[str]:
+    flags = []
+    for key, val in (engine or {}).items():
+        flags.append(f"--{key.replace('_', '-')}")
+        flags.append(str(val))
+    return flags
+
+
+def render(cr: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """One DynamoTpuDeployment custom resource → child manifests."""
+    name = cr["metadata"]["name"]
+    namespace = cr["metadata"].get("namespace", "default")
+    spec = cr["spec"]
+    image = spec["image"]
+    model = spec.get("model", "model")
+    global_envs = spec.get("envs", [])
+    out: List[Dict[str, Any]] = []
+    hub_addr = f"{name}-hub.{namespace}.svc:{HUB_PORT}"
+
+    services = spec.get("services") or {"hub": {"role": "hub"},
+                                        "frontend": {"role": "frontend"},
+                                        "worker": {"role": "worker"}}
+    for svc_name, svc in services.items():
+        role = svc.get("role", svc_name)
+        full = f"{name}-{svc_name}"
+        replicas = int(svc.get("replicas", 1))
+        nnodes = int(svc.get("nnodes", 1))
+        tpu = svc.get("tpu") or {}
+        envs = _env_list(global_envs, svc.get("envs"))
+
+        if role == "hub":
+            cmd = ["python", "-m", "dynamo_tpu.cli", "hub",
+                   "--port", str(HUB_PORT)]
+            out.append(_deployment(full, namespace, image, cmd, replicas,
+                                   envs, port=HUB_PORT))
+            out.append(_service(full, namespace, HUB_PORT))
+            continue
+        if role == "frontend":
+            cmd = ["python", "-m", "dynamo_tpu.cli", "http", "--hub", hub_addr,
+                   "--port", str(HTTP_PORT), "--router",
+                   str(svc.get("engine", {}).get("router", "kv"))]
+            out.append(_deployment(full, namespace, image, cmd, replicas,
+                                   envs, port=HTTP_PORT))
+            out.append(_service(full, namespace, HTTP_PORT))
+            continue
+        if role == "metrics":
+            cmd = ["python", "-m", "dynamo_tpu.cli", "metrics", "--hub",
+                   hub_addr, "--port", str(METRICS_PORT)]
+            out.append(_deployment(full, namespace, image, cmd, replicas,
+                                   envs, port=METRICS_PORT))
+            out.append(_service(full, namespace, METRICS_PORT))
+            continue
+
+        # engine roles: worker / prefill / decode
+        endpoint = f"dyn://dynamo.TpuWorker.{svc_name}"
+        cmd = ["python", "-m", "dynamo_tpu.cli", "run", f"in={endpoint}",
+               "out=tpu", "--hub", hub_addr, "--model", model]
+        if spec.get("checkpoint"):
+            cmd += ["--checkpoint", spec["checkpoint"]]
+        if role in ("prefill", "decode"):
+            cmd += ["--disagg", role]
+        cmd += _engine_flags(svc.get("engine"))
+        if nnodes > 1:
+            # Pod ordinal = node rank; rank 0's pod DNS is the coordinator.
+            coord = f"{full}-0.{full}.{namespace}.svc:{COORD_PORT}"
+            cmd += ["--nnodes", str(nnodes), "--coordinator", coord,
+                    "--step-port", str(STEP_PORT),
+                    "--node-rank", "$(POD_ORDINAL)"]
+            envs = envs + [{
+                "name": "POD_ORDINAL",
+                "valueFrom": {"fieldRef": {
+                    "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"
+                }},
+            }]
+        out.append(_statefulset(full, namespace, image, cmd,
+                                replicas=nnodes if nnodes > 1 else replicas,
+                                envs=envs, tpu=tpu))
+        out.append(_service(full, namespace, STEP_PORT, headless=True))
+    return out
+
+
+def _container(name: str, image: str, cmd: List[str], envs, tpu=None,
+               port=None) -> Dict[str, Any]:
+    c: Dict[str, Any] = {
+        "name": name,
+        "image": image,
+        "command": cmd,
+        "env": envs,
+    }
+    if port is not None:
+        c["ports"] = [{"containerPort": port}]
+    if tpu:
+        chips = int(tpu.get("chips", 4))
+        c["resources"] = {"limits": {"google.com/tpu": chips},
+                          "requests": {"google.com/tpu": chips}}
+    return c
+
+
+def _deployment(name, namespace, image, cmd, replicas, envs, port=None):
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {**_meta(name, name), "namespace": namespace},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app.kubernetes.io/name": name}},
+            "template": {
+                "metadata": _meta(name, name),
+                "spec": {"containers": [
+                    _container(name, image, cmd, envs, port=port)
+                ]},
+            },
+        },
+    }
+
+
+def _statefulset(name, namespace, image, cmd, replicas, envs, tpu):
+    pod_spec: Dict[str, Any] = {
+        "containers": [_container(name, image, cmd, envs, tpu=tpu)],
+    }
+    if tpu.get("accelerator"):
+        pod_spec["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator": tpu["accelerator"],
+        }
+        if tpu.get("topology"):
+            pod_spec["nodeSelector"][
+                "cloud.google.com/gke-tpu-topology"
+            ] = tpu["topology"]
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {**_meta(name, name), "namespace": namespace},
+        "spec": {
+            "serviceName": name,
+            "replicas": replicas,
+            "podManagementPolicy": "Parallel",  # all ranks start together
+            "selector": {"matchLabels": {"app.kubernetes.io/name": name}},
+            "template": {
+                "metadata": _meta(name, name),
+                "spec": pod_spec,
+            },
+        },
+    }
+
+
+def _service(name, namespace, port, headless=False):
+    svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {**_meta(name, name), "namespace": namespace},
+        "spec": {
+            "selector": {"app.kubernetes.io/name": name},
+            "ports": [{"port": port, "targetPort": port}],
+        },
+    }
+    if headless:
+        svc["spec"]["clusterIP"] = "None"
+    return svc
+
+
+def render_to_yaml(cr: Dict[str, Any]) -> str:
+    import yaml
+
+    docs = render(cr)
+    return "---\n".join(yaml.safe_dump(d, sort_keys=False) for d in docs)
+
+
+def shell_preview(cr: Dict[str, Any]) -> str:
+    """The commands each service runs (docs / dry-run aid)."""
+    lines = []
+    for doc in render(cr):
+        if doc["kind"] in ("Deployment", "StatefulSet"):
+            c = doc["spec"]["template"]["spec"]["containers"][0]
+            lines.append(f"# {doc['metadata']['name']}")
+            lines.append(" ".join(shlex.quote(x) for x in c["command"]))
+    return "\n".join(lines)

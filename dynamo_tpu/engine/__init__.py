@@ -13,7 +13,15 @@ def build_tpu_engine(args):
     from .engine import TpuEngine
 
     arch = getattr(args, "arch", None)
+    checkpoint = getattr(args, "checkpoint", None)
     model_config_path = getattr(args, "model_config", None)
+    if checkpoint and checkpoint.endswith(".gguf") and not arch:
+        # GGUF carries its own architecture metadata (reference: the
+        # ModelDeploymentCard's gguf path, lib/llm/src/gguf/*).
+        from ..models.config import register_config
+        from ..models.gguf import GGUFFile
+
+        arch = register_config(GGUFFile(checkpoint).to_model_config()).name
     if model_config_path:
         import json
 

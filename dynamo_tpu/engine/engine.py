@@ -553,30 +553,36 @@ class TpuEngine(AsyncEngine):
     # scheduler sees remote-prefilled prompts as ordinary prefix-cache hits.
 
     async def export_prompt_blocks(
-        self, token_ids: List[int]
+        self, token_ids: List[int], start_block: int = 0, max_blocks: int = 0
     ) -> Optional[Dict[str, Any]]:
-        """Gather the cached KV for ``token_ids``'s complete blocks to host.
+        """Gather cached KV for ``token_ids``'s complete blocks to host.
 
-        Returns None unless every complete block of the prompt is resident
-        (blocks are looked up by chained hash — reuse-pool contents count).
+        Exports the longest RESIDENT run starting at ``start_block`` (not
+        all-or-nothing — a prompt that lost tail blocks to eviction still
+        transfers its resident prefix; round-2 returned None in that case
+        and recomputed everything).  ``max_blocks`` bounds the run (chunked
+        transfer).  Returns None when nothing is resident at start_block.
         """
         from ..tokens import hash_token_blocks
 
         blocks = hash_token_blocks(token_ids, self.cfg.block_size)
-        if not blocks:
-            return None
         ids: List[int] = []
-        for tb in blocks:
+        for tb in blocks[start_block:]:
             bid = self.kv._by_hash.get(tb.sequence_hash)
             if bid is None:
-                return None
+                break
             ids.append(bid)
+            if max_blocks and len(ids) >= max_blocks:
+                break
+        if not ids:
+            return None
         async with self._device_lock:
             pages = np.asarray(self.cache.pages[:, np.asarray(ids, np.int32)])
         k = pages[:, :, :, 0::2]  # [L, n, page_size, KV, hd]
         v = pages[:, :, :, 1::2]
         return {
             "n_blocks": len(ids),
+            "start_block": start_block,
             "block_size": self.cfg.block_size,
             "dtype": str(k.dtype),
             "shape": list(k.shape),
@@ -587,14 +593,21 @@ class TpuEngine(AsyncEngine):
     async def inject_blocks(self, token_ids: List[int], payload: Dict[str, Any]) -> int:
         """Write transferred KV into this engine's cache as sealed blocks.
 
-        Returns the number of tokens now covered by the local prefix cache.
-        The blocks are immediately released to the reuse pool (contents
-        intact), so the very next generate() for these tokens admits with a
-        full prefix hit — no special remote-prefill state in the scheduler.
+        ``payload["start_block"]`` supports chunked transfers: chunk k's
+        blocks seal under their chained hashes as they arrive, so decode can
+        overlap with the remaining chunks' transfer (match_prefix walks from
+        block 0, so chunks are useful as soon as their predecessors landed —
+        the sender streams them in order).
+
+        Returns the number of tokens covered by this injection.  The blocks
+        are immediately released to the reuse pool (contents intact), so the
+        very next generate() for these tokens admits with a prefix hit — no
+        special remote-prefill state in the scheduler.
         """
         from ..tokens import hash_token_blocks
 
-        blocks = hash_token_blocks(token_ids, self.cfg.block_size)
+        start = int(payload.get("start_block", 0))
+        blocks = hash_token_blocks(token_ids, self.cfg.block_size)[start:]
         n = min(int(payload["n_blocks"]), len(blocks))
         if n == 0:
             return 0
@@ -640,6 +653,38 @@ class TpuEngine(AsyncEngine):
                 self._inject_fn, self.cache, *self._prep((page_ids, comb_p))
             )
         for bid, tb in zip(ids, blocks):
+            self.kv.seal_block(bid, tb)
+        self.kv.free_sequence(ids)
+        return n * self.cfg.block_size
+
+    async def inject_blocks_from_device(
+        self, token_ids: List[int], pages_dev, n: int, start_block: int = 0
+    ) -> int:
+        """Seal ``n`` transferred blocks whose pages are ALREADY on device
+        (the ICI/device_put fast path — no host staging).  ``pages_dev`` is
+        [L, pad, ps, 2KV, hd] with the first n slots valid."""
+        from ..tokens import hash_token_blocks
+
+        if jax.process_count() > 1:
+            # Device handles can't cross the leader/follower broadcast; the
+            # host-staged inject_blocks path handles multi-host transfers.
+            return 0
+        blocks = hash_token_blocks(token_ids, self.cfg.block_size)[start_block:]
+        n = min(n, len(blocks))
+        if n == 0:
+            return 0
+        alloc = self.kv.allocate_sequence(blocks[:n], n)
+        if alloc is None:
+            return 0
+        ids, _ = alloc
+        pad = pages_dev.shape[1]
+        page_ids = np.full((pad,), self.cfg.num_blocks, np.int32)  # OOB pad
+        page_ids[:n] = ids
+        async with self._device_lock:
+            self.cache = await asyncio.to_thread(
+                self._inject_fn, self.cache, page_ids, pages_dev
+            )
+        for bid, tb in zip(ids, blocks[:n]):
             self.kv.seal_block(bid, tb)
         self.kv.free_sequence(ids)
         return n * self.cfg.block_size
@@ -1284,3 +1329,40 @@ class TpuEngine(AsyncEngine):
                 "p99_ms": round(times[min(m - 1, int(m * 0.99))] * 1e3, 2),
             }
         return out
+
+
+async def transfer_blocks_device(src: TpuEngine, dst: TpuEngine, token_ids) -> int:
+    """Co-located prefill→decode KV transfer that never stages in host RAM:
+    device gather from the source cache → ``jax.device_put`` onto the
+    destination's sharding → in-place scatter.  On one chip this is an HBM
+    copy; across chips of a shared slice the put rides ICI — the reference's
+    NIXL/GPUDirect block path (SURVEY §2.6) for same-slice deployments.
+    Returns tokens covered (the longest resident prefix run)."""
+    from ..tokens import hash_token_blocks
+
+    if src.cfg.block_size != dst.cfg.block_size:
+        return 0
+    if src.cache.pages.shape[0] != dst.cache.pages.shape[0]:
+        return 0  # different layer counts: not the same model
+    blocks = hash_token_blocks(token_ids, src.cfg.block_size)
+    src_ids: List[int] = []
+    for tb in blocks:
+        bid = src.kv._by_hash.get(tb.sequence_hash)
+        if bid is None:
+            break
+        src_ids.append(bid)
+    if not src_ids:
+        return 0
+    n = len(src_ids)
+    pad = 1 << max(0, (n - 1).bit_length())
+    gather_ids = np.zeros((pad,), np.int32)
+    gather_ids[:n] = src_ids
+    async with src._device_lock:
+        pages = await asyncio.to_thread(src._gather_fn, src.cache, gather_ids)
+    if dst.mesh is not None:
+        pages = jax.device_put(
+            pages, jax.tree_util.tree_leaves(dst.cache)[0].sharding
+        )
+    elif pages.devices() != dst.cache.pages.devices():
+        pages = jax.device_put(pages, next(iter(dst.cache.pages.devices())))
+    return await dst.inject_blocks_from_device(token_ids, pages, n)

@@ -56,18 +56,45 @@ class DisaggDecodeWorker(AsyncEngine):
         self.import_path = import_path
         self.transfer_timeout = transfer_timeout
         self._pending: Dict[str, asyncio.Future] = {}
+        self._covered: Dict[str, int] = {}  # per-transfer chunk accumulation
         self.remote_prefills = 0
         self.local_prefills = 0
+        from collections import deque as _deque
+
+        # rolling remote-prefill wait wall (TTFT input), bounded
+        self.transfer_ms = _deque(maxlen=1024)
 
     # The engine handler served at the decode worker's kv_import endpoint.
     async def kv_import_handler(self, request: Context) -> AsyncIterator[Dict]:
         data = request.data
         tokens = data["token_ids"]
         covered = await self.engine.inject_blocks(tokens, data["payload"])
-        fut = self._pending.pop(data["transfer_id"], None)
-        if fut is not None and not fut.done():
-            fut.set_result(covered)
+        self._covered[data["transfer_id"]] = (
+            self._covered.get(data["transfer_id"], 0) + covered
+        )
+        # Chunked transfer: the future resolves on the LAST chunk; earlier
+        # chunks are already sealed, so decode admission can begin while the
+        # tail is still in flight.
+        if data.get("last", True):
+            total = self._covered.pop(data["transfer_id"], covered)
+            fut = self._pending.pop(data["transfer_id"], None)
+            if fut is not None and not fut.done():
+                fut.set_result(total)
         yield {"ok": True, "tokens_covered": covered}
+
+    async def transfer_direct(self, transfer_id: str, tokens, src_engine) -> int:
+        """Same-process fast path: device→device block copy, no host staging
+        (engine.transfer_blocks_device).  A zero-block transfer leaves the
+        future pending — the sender retries and the decode side's timeout
+        fallback covers permanent failure."""
+        from ...engine.engine import transfer_blocks_device
+
+        covered = await transfer_blocks_device(src_engine, self.engine, tokens)
+        if covered > 0:
+            fut = self._pending.pop(transfer_id, None)
+            if fut is not None and not fut.done():
+                fut.set_result(covered)
+        return covered
 
     async def generate(self, request: Context) -> ResponseStream:
         pre = PreprocessedRequest.from_dict(request.data)
@@ -98,31 +125,52 @@ class DisaggDecodeWorker(AsyncEngine):
                 "reply": {"address": self.import_address, "path": self.import_path},
             }
         )
+        import time as _time
+
+        t0 = _time.perf_counter()
         try:
             covered = await asyncio.wait_for(fut, self.transfer_timeout)
             self.remote_prefills += 1
+            self.transfer_ms.append((_time.perf_counter() - t0) * 1e3)
             logger.info("remote prefill covered %d tokens", covered)
         except asyncio.TimeoutError:
             # Fall back to local prefill; a late transfer still lands as a
             # harmless prefix-cache fill.
             self._pending.pop(transfer_id, None)
+            self._covered.pop(transfer_id, None)  # orphaned chunk counts
             self.local_prefills += 1
             logger.warning("remote prefill timed out; prefilling locally")
 
 
 class PrefillWorkerLoop:
-    """Dedicated prefill worker: drain the queue, compute KV, push blocks."""
+    """Dedicated prefill worker: drain the queue, compute KV, push blocks.
+
+    Transfers stream in ``chunk_blocks``-block chunks (ordered per
+    connection), so the decode side seals and can use early blocks while
+    later ones are still in flight.  ``direct`` maps reply addresses of
+    CO-LOCATED decode workers (same process / shared slice) to their
+    DisaggDecodeWorker: those transfers take the device→device path and
+    never stage in host RAM."""
 
     MAX_ATTEMPTS = 3
 
-    def __init__(self, engine, queue: PrefillQueue):
+    def __init__(
+        self,
+        engine,
+        queue: PrefillQueue,
+        chunk_blocks: int = 32,
+        direct: Optional[Dict[str, "DisaggDecodeWorker"]] = None,
+    ):
         self.engine = engine
         self.queue = queue
+        self.chunk_blocks = max(1, chunk_blocks)
+        self.direct = direct or {}
         self._task: Optional[asyncio.Task] = None
         self._clients: Dict[str, Client] = {}
         self._attempts: Dict[str, int] = {}
         self.handled = 0
         self.dropped = 0
+        self.direct_transfers = 0
 
     async def start(self) -> "PrefillWorkerLoop":
         self._task = asyncio.get_running_loop().create_task(self._run())
@@ -184,22 +232,62 @@ class PrefillWorkerLoop:
         stream = await self.engine.generate(Context(pre.to_dict()))
         async for _ in stream:
             pass
-        payload = await self.engine.export_prompt_blocks(tokens)
-        if payload is None:
-            raise RuntimeError("prompt blocks missing after prefill (evicted?)")
         reply = item["reply"]
-        client = self._client_for(reply["address"], reply["path"])
-        resp = await client.generate(
-            Context(
-                {
-                    "transfer_id": item["transfer_id"],
-                    "token_ids": list(tokens),
-                    "payload": payload,
-                }
+
+        worker = self.direct.get(reply["address"])
+        if worker is not None:
+            covered = await worker.transfer_direct(
+                item["transfer_id"], tokens, self.engine
             )
-        )
-        async for _ack in resp:
-            pass
+            if covered == 0:
+                raise RuntimeError("direct transfer moved no blocks")
+            self.direct_transfers += 1
+            return
+
+        client = self._client_for(reply["address"], reply["path"])
+        total_blocks = len(tokens) // self.engine.cfg.block_size
+        start = 0
+        while True:
+            payload = await self.engine.export_prompt_blocks(
+                tokens, start_block=start, max_blocks=self.chunk_blocks
+            )
+            if payload is None:
+                if start == 0:
+                    raise RuntimeError(
+                        "prompt blocks missing after prefill (evicted?)"
+                    )
+                # Partial run (tail evicted mid-transfer): finalize with an
+                # empty chunk so the decode side resolves with what landed
+                # and prefills the remainder locally.
+                resp = await client.generate(
+                    Context(
+                        {
+                            "transfer_id": item["transfer_id"],
+                            "token_ids": list(tokens),
+                            "payload": {"n_blocks": 0},
+                            "last": True,
+                        }
+                    )
+                )
+                async for _ack in resp:
+                    pass
+                break
+            start += payload["n_blocks"]
+            last = start >= total_blocks or payload["n_blocks"] < self.chunk_blocks
+            resp = await client.generate(
+                Context(
+                    {
+                        "transfer_id": item["transfer_id"],
+                        "token_ids": list(tokens),
+                        "payload": payload,
+                        "last": last,
+                    }
+                )
+            )
+            async for _ack in resp:
+                pass
+            if last:
+                break
 
     def _client_for(self, address: str, path: str) -> Client:
         key = f"{address}/{path}"

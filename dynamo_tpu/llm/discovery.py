@@ -38,6 +38,10 @@ def make_tokenizer(spec: Dict[str, Any]) -> BaseTokenizer:
         if "file" in spec:
             return HFTokenizer(spec["file"])
         return HFTokenizer.from_pretrained_dir(spec["dir"])
+    if kind == "gguf":
+        from ..models.gguf import GGUFFile
+
+        return GGUFFile(spec["file"]).to_tokenizer()
     raise ValueError(f"unknown tokenizer kind {kind!r}")
 
 

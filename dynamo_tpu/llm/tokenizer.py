@@ -99,19 +99,28 @@ class HFTokenizer(BaseTokenizer):
 
     def __init__(
         self,
-        tokenizer_file: str,
+        tokenizer_file: Optional[str] = None,
         config_file: Optional[str] = None,
+        *,
+        tokenizer: Optional[Any] = None,  # in-memory tokenizers.Tokenizer
+        bos_token_id: Optional[int] = None,
+        eos_token_id: Optional[int] = None,
     ):
         from tokenizers import Tokenizer
 
-        self._tok = Tokenizer.from_file(tokenizer_file)
+        if tokenizer is not None:
+            self._tok = tokenizer  # e.g. built from GGUF metadata
+        elif tokenizer_file is not None:
+            self._tok = Tokenizer.from_file(tokenizer_file)
+        else:
+            raise ValueError("need tokenizer_file or tokenizer")
         self._chat_template: Optional[str] = None
         self.bos_token: Optional[str] = None
         self.eos_token: Optional[str] = None
         self._bos_id: Optional[int] = None
         self._eos_id: Optional[int] = None
 
-        if config_file is None:
+        if config_file is None and tokenizer_file is not None:
             candidate = os.path.join(os.path.dirname(tokenizer_file), "tokenizer_config.json")
             config_file = candidate if os.path.exists(candidate) else None
         if config_file is not None:
@@ -124,6 +133,10 @@ class HFTokenizer(BaseTokenizer):
             self._bos_id = self._tok.token_to_id(self.bos_token)
         if self.eos_token:
             self._eos_id = self._tok.token_to_id(self.eos_token)
+        if bos_token_id is not None:
+            self._bos_id = int(bos_token_id)
+        if eos_token_id is not None:
+            self._eos_id = int(eos_token_id)
 
     @classmethod
     def from_pretrained_dir(cls, model_dir: str) -> "HFTokenizer":
@@ -194,8 +207,15 @@ class ByteTokenizer(BaseTokenizer):
                 if buf:
                     out.append(buf.decode("utf-8", errors="replace"))
                     buf = bytearray()
-                if not skip_special_tokens:
-                    out.append(self._special_by_id.get(i, f"<unk:{i}>"))
+                if i in self._special_by_id:
+                    if not skip_special_tokens:
+                        out.append(self._special_by_id[i])
+                else:
+                    # Ids past the byte+special range (a model vocab larger
+                    # than this tokenizer's) decode lossily, never silently:
+                    # downstream consumers (streaming clients, stop-string
+                    # scan) must see one glyph per token.
+                    out.append("�")
         if buf:
             out.append(buf.decode("utf-8", errors="replace"))
         return "".join(out)
@@ -235,7 +255,23 @@ class DecodeStream:
         tail = self._ids[self._prefix_offset :]
         text = self._tok.decode(tail, skip_special_tokens=self._skip)
         if text.endswith("�"):
-            return ""
+            if len(self._ids) - self._read_offset < 4:
+                # Possibly an incomplete multi-byte sequence: hold the
+                # delta.  A UTF-8 character resolves within 4 bytes, so a
+                # longer unresolved window is a DELIBERATE replacement
+                # glyph (e.g. an id outside a lossy tokenizer's range) —
+                # holding forever would jail the whole stream until finish.
+                return ""
+            # Force-emit the held window and COMMIT past it (both offsets
+            # to the end): re-decoding these ids later could resolve
+            # differently than what we just emitted and garble the diff.
+            prev = self._tok.decode(
+                self._ids[self._prefix_offset : self._read_offset],
+                skip_special_tokens=self._skip,
+            )
+            self._prefix_offset = len(self._ids)
+            self._read_offset = len(self._ids)
+            return text[len(prev) :]
         prev = self._tok.decode(
             self._ids[self._prefix_offset : self._read_offset],
             skip_special_tokens=self._skip,

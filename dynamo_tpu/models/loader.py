@@ -57,8 +57,14 @@ def _iter_safetensors(path: str):
 
 
 def load_params(config: ModelConfig, path: str, dtype: Any = None) -> Dict[str, Any]:
-    """Load a HF llama-family checkpoint directory into the params tree."""
+    """Load a HF llama-family checkpoint directory into the params tree.
+    A ``.gguf`` path loads through the GGUF container instead."""
     import jax.numpy as jnp
+
+    if path.endswith(".gguf"):
+        from .gguf import load_params_gguf
+
+        return load_params_gguf(config, path, dtype)
 
     dt = jnp.dtype(dtype or config.dtype)
     L, E = config.num_layers, config.num_experts

@@ -8,6 +8,8 @@ from .engine import (
     collect,
     engine_from_generator,
 )
+from .config import RuntimeConfig, env_overrides  # noqa: F401
+from .logging_config import JsonlFormatter, parse_filter, setup_logging  # noqa: F401
 from .pipeline import MapOperator, Operator, ServiceBackend, build_pipeline
 from .client import Client, NoInstancesError, RouterMode
 from .component import (

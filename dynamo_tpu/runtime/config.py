@@ -1,0 +1,105 @@
+"""Layered runtime configuration: defaults < config file < environment.
+
+Reference semantics: lib/runtime/src/config.rs:58-115 — a figment of
+``RuntimeConfig::default()``, then an optional TOML/JSON file named by
+``DYN_RUNTIME_CONFIG``, then ``DYN_*`` environment variables, later layers
+winning per key.  Same precedence here with YAML/JSON files.
+
+Env mapping: ``DYN_<FIELD>`` (case-insensitive) sets a top-level field;
+double underscores nest (``DYN_HTTP__PORT=8080`` → ``http.port``).  Values
+parse as JSON when possible ("8080" → int, "true" → bool), else string.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Mapping, Optional
+
+ENV_PREFIX = "DYN_"
+CONFIG_PATH_ENV = "DYN_RUNTIME_CONFIG"
+
+
+def _parse_env_value(raw: str) -> Any:
+    try:
+        return json.loads(raw)
+    except (ValueError, TypeError):
+        return raw
+
+
+def _deep_merge(base: Dict[str, Any], over: Mapping[str, Any]) -> Dict[str, Any]:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, Mapping) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _load_file(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        text = f.read()
+    if path.endswith((".yaml", ".yml")):
+        import yaml
+
+        return yaml.safe_load(text) or {}
+    return json.loads(text or "{}")
+
+
+def env_overrides(
+    environ: Optional[Mapping[str, str]] = None, prefix: str = ENV_PREFIX
+) -> Dict[str, Any]:
+    """``DYN_A__B=v`` → {"a": {"b": v}} (reserved names excluded)."""
+    environ = os.environ if environ is None else environ
+    reserved = {CONFIG_PATH_ENV, "DYN_LOG", "DYN_LOG_FORMAT", "DYN_LOG_FILE"}
+    out: Dict[str, Any] = {}
+    for key, raw in environ.items():
+        if not key.startswith(prefix) or key in reserved:
+            continue
+        path = key[len(prefix):].lower().split("__")
+        node = out
+        for part in path[:-1]:
+            node = node.setdefault(part, {})
+        node[path[-1]] = _parse_env_value(raw)
+    return out
+
+
+@dataclass
+class RuntimeConfig:
+    """The runtime's own knobs (reference RuntimeConfig: worker threads →
+    here event-loop/debug toggles, grace periods, endpoint health)."""
+
+    namespace: str = "dynamo"
+    hub: Optional[str] = None  # host:port of the discovery hub
+    # graceful shutdown (reference: graceful_shutdown_timeout)
+    shutdown_timeout_s: float = 30.0
+    kill_timeout_s: float = 5.0
+    # service plane
+    host: str = "0.0.0.0"
+    http_port: int = 8000
+    metrics_port: int = 9091
+    # engine defaults (overridable per worker)
+    engine: Dict[str, Any] = field(default_factory=dict)
+    extra: Dict[str, Any] = field(default_factory=dict)  # unrecognized keys
+
+    @classmethod
+    def from_layers(
+        cls,
+        file_path: Optional[str] = None,
+        environ: Optional[Mapping[str, str]] = None,
+    ) -> "RuntimeConfig":
+        """defaults < file (arg or $DYN_RUNTIME_CONFIG) < DYN_* env."""
+        environ = os.environ if environ is None else environ
+        merged: Dict[str, Any] = {}
+        path = file_path or environ.get(CONFIG_PATH_ENV)
+        if path:
+            merged = _deep_merge(merged, _load_file(path))
+        merged = _deep_merge(merged, env_overrides(environ))
+        known = {f for f in cls.__dataclass_fields__ if f != "extra"}
+        kwargs = {k: v for k, v in merged.items() if k in known}
+        extra = {k: v for k, v in merged.items() if k not in known}
+        cfg = cls(**kwargs)
+        cfg.extra = extra
+        return cfg

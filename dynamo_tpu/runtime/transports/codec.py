@@ -11,6 +11,13 @@ Responses stream as RESP_* frames on the same connection; CANCEL/KILL flow
 client→server mid-stream (the reference's ZMQ "Harmony" control messages,
 transports/zmq.rs:44-52).
 
+Every frame carries a u32 STREAM id, so one connection multiplexes many
+concurrent requests (the reference multiplexes via NATS subjects + response
+stream registration; a connection per request measured as pure churn at
+high concurrency).  Stream 0 is connection control (heartbeats).
+
+    [1 byte type][4 bytes stream id][4 bytes payload length][payload]
+
 Payload encoding is msgpack (falls back to JSON if a payload is not
 msgpack-serializable).
 """
@@ -27,7 +34,7 @@ from typing import Any
 import msgpack
 
 MAX_FRAME = 256 * 1024 * 1024  # 256 MiB guard against corrupt length prefixes
-_HDR = struct.Struct(">BI")
+_HDR = struct.Struct(">BII")
 
 
 class FrameType(enum.IntEnum):
@@ -46,6 +53,7 @@ class FrameType(enum.IntEnum):
 class Frame:
     type: FrameType
     payload: bytes
+    stream: int = 0  # multiplexing stream id (0 = connection control)
 
     def unpack(self) -> Any:
         return decode(self.payload)
@@ -65,17 +73,22 @@ def decode(buf: bytes) -> Any:
 
 
 async def write_frame(
-    writer: asyncio.StreamWriter, ftype: FrameType, obj: Any = None, *, raw: bytes | None = None
+    writer: asyncio.StreamWriter,
+    ftype: FrameType,
+    obj: Any = None,
+    *,
+    stream: int = 0,
+    raw: bytes | None = None,
 ) -> None:
     payload = raw if raw is not None else encode(obj)
-    writer.write(_HDR.pack(int(ftype), len(payload)) + payload)
+    writer.write(_HDR.pack(int(ftype), stream, len(payload)) + payload)
     await writer.drain()
 
 
 async def read_frame(reader: asyncio.StreamReader) -> Frame:
     hdr = await reader.readexactly(_HDR.size)
-    ftype, length = _HDR.unpack(hdr)
+    ftype, stream, length = _HDR.unpack(hdr)
     if length > MAX_FRAME:
         raise ValueError(f"frame length {length} exceeds MAX_FRAME")
     payload = await reader.readexactly(length) if length else b""
-    return Frame(FrameType(ftype), payload)
+    return Frame(FrameType(ftype), payload, stream)

@@ -30,11 +30,15 @@ from __future__ import annotations
 import asyncio
 import itertools
 import json
+import logging
+import os
 import time
 import uuid
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Set, Tuple
+
+logger = logging.getLogger(__name__)
 
 
 # --------------------------------------------------------------------------
@@ -276,6 +280,39 @@ class HubState:
     async def q_len(self, queue: str) -> int:
         return len(self._queues.get(queue, ()))
 
+    # -- persistence --------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Durable state: KV entries NOT bound to leases (lease-bound keys
+        are live-worker registrations that must re-register on rejoin) plus
+        queued + in-flight work items (at-least-once across restart)."""
+        return {
+            "kv": {
+                k: v for k, v in self._kv.items() if k not in self._kv_lease
+            },
+            "queues": {
+                name: [qi.item for qi in dq]
+                for name, dq in self._queues.items()
+                if dq
+            },
+            "inflight": [
+                [queue, item] for queue, item in self._inflight.values()
+            ],
+        }
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        for k, v in (snap.get("kv") or {}).items():
+            self._kv[k] = v
+        for name, items in (snap.get("queues") or {}).items():
+            dq = self._queues.setdefault(name, deque())
+            for item in items:
+                dq.append(_QueueItem(item, uuid.uuid4().hex))
+        for queue, item in snap.get("inflight") or ():
+            # undelivered at snapshot time from the consumer's perspective
+            self._queues.setdefault(queue, deque()).append(
+                _QueueItem(item, uuid.uuid4().hex)
+            )
+
 
 # --------------------------------------------------------------------------
 # In-process binding
@@ -448,23 +485,69 @@ class HubServer:
     items, and stops keepalives for its leases (which then expire → liveness).
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        persist_path: Optional[str] = None,
+        persist_interval_s: float = 2.0,
+    ):
         self.host = host
         self.port = port
         self.state = HubState()
         self._server: Optional[asyncio.base_events.Server] = None
+        # Restart-survival (reference: etcd raft log / NATS JetStream file
+        # store): durable KV + queued work snapshot to disk; lease-bound
+        # registrations intentionally NOT persisted (workers re-register).
+        self.persist_path = persist_path
+        self.persist_interval_s = persist_interval_s
+        self._persist_task: Optional[asyncio.Task] = None
 
     async def start(self) -> "HubServer":
+        if self.persist_path and os.path.exists(self.persist_path):
+            with open(self.persist_path) as f:
+                self.state.restore(json.load(f))
         self.state.start_expiry_loop()
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        if self.persist_path:
+            self._persist_task = asyncio.get_running_loop().create_task(
+                self._persist_loop()
+            )
         return self
+
+    def _persist_now(self) -> None:
+        if not self.persist_path:
+            return
+        tmp = self.persist_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.state.snapshot(), f)
+        os.replace(tmp, self.persist_path)  # atomic swap
+
+    async def _persist_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.persist_interval_s)
+            try:
+                self._persist_now()
+            except Exception:
+                logger.exception("hub snapshot failed")
 
     @property
     def address(self) -> str:
         return f"{self.host}:{self.port}"
 
     async def close(self) -> None:
+        if self._persist_task is not None:
+            self._persist_task.cancel()
+            try:
+                await self._persist_task
+            except asyncio.CancelledError:
+                pass
+            self._persist_task = None
+        try:
+            self._persist_now()
+        except Exception:
+            logger.exception("final hub snapshot failed")
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()

@@ -2,25 +2,32 @@
 
 Reference semantics: the request plane (NATS request → endpoint subject,
 pipeline/network/egress/push.rs:88-158) + response plane (direct TCP callback
-with prologue handshake and streamed frames, tcp/{server,client}.rs) — here
-collapsed onto ONE direct TCP connection per request: the client dials the
-worker, sends header+data (TwoPartMessage), reads a prologue then streamed
-items.  CANCEL/KILL frames flow client→worker mid-stream, giving remote
-cancellation the same semantics as in-process ``stop_generating``/``kill``
-(the reference gets this implicitly by dropping the response stream;
-explicit frames are stronger).
+with prologue handshake and streamed frames, tcp/{server,client}.rs).  Here
+both planes collapse onto MULTIPLEXED direct TCP connections: each client
+process keeps ONE connection per worker address, and every request is a
+stream id on it — header+data frames up, prologue+items down, CANCEL/KILL
+up mid-stream.  (The reference gets multiplexing from NATS subjects +
+registered response streams; round 2's connection-per-request design was
+pure setup churn at high concurrency.)
 
-A send failure on the worker side stops generation for that request
-(push_handler.rs:100-116 behaviour).
+Cancellation: CANCEL/KILL frames give remote ``stop_generating``/``kill``
+the same semantics as in-process; a client disconnect cancels every stream
+it owned (push_handler.rs:100-116 behaviour).
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import Any, AsyncIterator, Callable, Dict, Optional
+import itertools
+import logging
+from typing import Any, AsyncIterator, Dict, Optional, Tuple
 
 from ..engine import AsyncEngine, AsyncEngineContext, Context, ResponseStream
-from .codec import FrameType, read_frame, write_frame
+from .codec import Frame, FrameType, read_frame, write_frame
+
+logger = logging.getLogger(__name__)
+
+_DONE = object()
 
 
 class RemoteEngineError(RuntimeError):
@@ -28,14 +35,14 @@ class RemoteEngineError(RuntimeError):
 
 
 class ServiceServer:
-    """Hosts AsyncEngines at string paths over TCP; one request per connection."""
+    """Hosts AsyncEngines at string paths over TCP (multiplexed streams)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.host = host
         self.port = port
         self._endpoints: Dict[str, AsyncEngine] = {}
         self._server: Optional[asyncio.base_events.Server] = None
-        self._active: set = set()
+        self._conn_tasks: set = set()
 
     def register(self, path: str, engine: AsyncEngine) -> None:
         self._endpoints[path] = engine
@@ -56,80 +63,198 @@ class ServiceServer:
     async def close(self) -> None:
         if self._server is not None:
             self._server.close()
+            # Long-lived multiplexed connections never end on their own —
+            # cancel the per-connection handlers BEFORE wait_closed() (which
+            # waits for them since 3.12).
+            for task in list(self._conn_tasks):
+                task.cancel()
             await self._server.wait_closed()
             self._server = None
-        for task in list(self._active):
-            task.cancel()
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
-        task = asyncio.current_task()
-        self._active.add(task)
-        ctx: Optional[AsyncEngineContext] = None
-        control_task: Optional[asyncio.Task] = None
-        try:
-            header_frame = await read_frame(reader)
-            if header_frame.type != FrameType.REQ_HEADER:
-                return
-            header = header_frame.unpack()
-            data_frame = await read_frame(reader)
-            if data_frame.type != FrameType.REQ_DATA:
-                return
+        conn_task = asyncio.current_task()
+        self._conn_tasks.add(conn_task)
+        wlock = asyncio.Lock()
+        headers: Dict[int, Dict[str, Any]] = {}  # sid → REQ_HEADER awaiting data
+        streams: Dict[int, Tuple[AsyncEngineContext, asyncio.Task]] = {}
 
-            engine = self._endpoints.get(header.get("endpoint", ""))
-            if engine is None:
-                await write_frame(
-                    writer,
-                    FrameType.RESP_PROLOGUE,
-                    {"ok": False, "error": f"no such endpoint: {header.get('endpoint')}"},
-                )
-                return
+        async def send(ftype: FrameType, obj: Any = None, sid: int = 0) -> None:
+            async with wlock:
+                await write_frame(writer, ftype, obj, stream=sid)
 
+        async def serve_stream(sid: int, header: Dict[str, Any], data: Any):
             ctx = AsyncEngineContext(header.get("id"))
-            request = Context(data_frame.unpack(), ctx)
-
-            async def control_loop():
-                # reads CANCEL/KILL from the client for the life of the stream
-                try:
-                    while True:
-                        frame = await read_frame(reader)
-                        if frame.type == FrameType.CANCEL:
-                            ctx.stop_generating()
-                        elif frame.type == FrameType.KILL:
-                            ctx.kill()
-                except (asyncio.IncompleteReadError, ConnectionResetError):
-                    # client went away entirely
-                    ctx.stop_generating()
-
-            control_task = asyncio.create_task(control_loop())
-
+            streams[sid] = (ctx, asyncio.current_task())
             try:
-                stream = await engine.generate(request)
-            except Exception as e:  # noqa: BLE001 — remote boundary
-                await write_frame(
-                    writer, FrameType.RESP_PROLOGUE, {"ok": False, "error": str(e)}
-                )
-                return
-
-            await write_frame(writer, FrameType.RESP_PROLOGUE, {"ok": True})
-            try:
-                async for item in stream:
-                    await write_frame(writer, FrameType.RESP_ITEM, item)
-                await write_frame(writer, FrameType.RESP_COMPLETE)
-            except (ConnectionResetError, BrokenPipeError):
-                ctx.stop_generating()
-            except Exception as e:  # noqa: BLE001 — stream error to client
+                engine = self._endpoints.get(header.get("endpoint", ""))
+                if engine is None:
+                    await send(
+                        FrameType.RESP_PROLOGUE,
+                        {"ok": False,
+                         "error": f"no such endpoint: {header.get('endpoint')}"},
+                        sid,
+                    )
+                    return
                 try:
-                    await write_frame(writer, FrameType.RESP_ERROR, {"error": str(e)})
+                    stream = await engine.generate(Context(data, ctx))
+                except Exception as e:  # noqa: BLE001 — remote boundary
+                    await send(
+                        FrameType.RESP_PROLOGUE, {"ok": False, "error": str(e)}, sid
+                    )
+                    return
+                await send(FrameType.RESP_PROLOGUE, {"ok": True}, sid)
+                try:
+                    async for item in stream:
+                        await send(FrameType.RESP_ITEM, item, sid)
+                    await send(FrameType.RESP_COMPLETE, None, sid)
                 except (ConnectionResetError, BrokenPipeError):
-                    pass
-        except (asyncio.IncompleteReadError, ConnectionResetError):
-            if ctx is not None:
+                    ctx.stop_generating()
+                except Exception as e:  # noqa: BLE001 — stream error to client
+                    try:
+                        await send(FrameType.RESP_ERROR, {"error": str(e)}, sid)
+                    except (ConnectionResetError, BrokenPipeError):
+                        pass
+            except asyncio.CancelledError:
                 ctx.stop_generating()
+                raise
+            finally:
+                streams.pop(sid, None)
+
+        try:
+            while True:
+                frame = await read_frame(reader)
+                sid = frame.stream
+                if frame.type == FrameType.REQ_HEADER:
+                    headers[sid] = frame.unpack()
+                elif frame.type == FrameType.REQ_DATA:
+                    header = headers.pop(sid, None)
+                    if header is None:
+                        continue  # protocol slip; drop
+                    asyncio.create_task(serve_stream(sid, header, frame.unpack()))
+                elif frame.type == FrameType.CANCEL:
+                    if sid in streams:
+                        streams[sid][0].stop_generating()
+                elif frame.type == FrameType.KILL:
+                    if sid in streams:
+                        streams[sid][0].kill()
+                # HEARTBEAT and unknown types: ignore
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass  # client went away: cancel everything it owned below
         finally:
-            if control_task is not None:
-                control_task.cancel()
+            for ctx, task in list(streams.values()):
+                ctx.stop_generating()
+                task.cancel()
             writer.close()
-            self._active.discard(task)
+            self._conn_tasks.discard(conn_task)
+
+
+class MuxConnection:
+    """One shared client connection per worker address; streams by id.
+
+    ``get()`` returns the live connection for an address (dialing if
+    needed); a broken connection errors all of its in-flight streams and the
+    next ``get()`` dials fresh.
+    """
+
+    _by_address: Dict[str, "MuxConnection"] = {}
+    _dial_locks: Dict[Tuple[int, str], asyncio.Lock] = {}
+    # Per-stream receive buffer bound: items are small (one token chunk),
+    # so this caps a stalled consumer's memory without blocking the shared
+    # read loop (head-of-line).  Overflow terminates only that stream.
+    STREAM_QUEUE_MAX = 8192
+
+    def __init__(self, address: str):
+        self.address = address
+        self._loop = asyncio.get_running_loop()
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._wlock = asyncio.Lock()
+        self._queues: Dict[int, asyncio.Queue] = {}
+        self._sid = itertools.count(1)
+        self._reader_task: Optional[asyncio.Task] = None
+        self.closed = False
+
+    @classmethod
+    async def get(cls, address: str) -> "MuxConnection":
+        # Serialize dialing per (loop, address) so two concurrent first
+        # requests can't race into two connections (one would leak).
+        lock_key = (id(asyncio.get_running_loop()), address)
+        lock = cls._dial_locks.setdefault(lock_key, asyncio.Lock())
+        async with lock:
+            conn = cls._by_address.get(address)
+            # A cached connection is only usable from the loop that created
+            # it (its transport and reader task are loop-bound); a different
+            # running loop means the old one is gone — dial fresh.
+            if (
+                conn is not None
+                and conn._loop is not asyncio.get_running_loop()
+            ):
+                conn._close_transport()  # best effort on a dead loop
+                conn = None
+            if conn is None or conn.closed:
+                conn = cls(address)
+                await conn._connect()
+                cls._by_address[address] = conn
+            return conn
+
+    async def _connect(self) -> None:
+        host, port = self.address.rsplit(":", 1)
+        self._reader, self._writer = await asyncio.open_connection(host, int(port))
+        self._reader_task = asyncio.create_task(self._read_loop())
+
+    def _close_transport(self) -> None:
+        self.closed = True
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = await read_frame(self._reader)
+                queue = self._queues.get(frame.stream)
+                if queue is None:
+                    continue
+                if queue.qsize() >= self.STREAM_QUEUE_MAX:
+                    # Stalled consumer: kill this stream, not the connection.
+                    queue.put_nowait(_DONE)
+                    self._queues.pop(frame.stream, None)
+                    continue
+                queue.put_nowait(frame)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self._close_transport()
+            for q in self._queues.values():
+                q.put_nowait(_DONE)
+
+    async def open_stream(self, header: Dict[str, Any], data: Any) -> Tuple[int, asyncio.Queue]:
+        sid = next(self._sid)
+        queue: asyncio.Queue = asyncio.Queue()
+        self._queues[sid] = queue
+        try:
+            async with self._wlock:
+                await write_frame(self._writer, FrameType.REQ_HEADER, header, stream=sid)
+                await write_frame(self._writer, FrameType.REQ_DATA, data, stream=sid)
+        except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            self._close_transport()
+            self._queues.pop(sid, None)
+            raise RemoteEngineError(f"connection to {self.address} failed: {e}")
+        return sid, queue
+
+    async def send(self, ftype: FrameType, sid: int) -> None:
+        if self.closed:
+            return
+        try:
+            async with self._wlock:
+                await write_frame(self._writer, ftype, None, stream=sid)
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            self._close_transport()
+
+    def release(self, sid: int) -> None:
+        self._queues.pop(sid, None)
 
 
 class RemoteEngine(AsyncEngine):
@@ -140,19 +265,19 @@ class RemoteEngine(AsyncEngine):
         self.endpoint = endpoint
 
     async def generate(self, request: Context) -> ResponseStream:
-        host, port = self.address.rsplit(":", 1)
-        reader, writer = await asyncio.open_connection(host, int(port))
+        conn = await MuxConnection.get(self.address)
+        sid, queue = await conn.open_stream(
+            {"id": request.id, "endpoint": self.endpoint}, request.data
+        )
         try:
-            await write_frame(
-                writer, FrameType.REQ_HEADER, {"id": request.id, "endpoint": self.endpoint}
-            )
-            await write_frame(writer, FrameType.REQ_DATA, request.data)
-            prologue_frame = await read_frame(reader)
-            prologue = prologue_frame.unpack()
+            first = await queue.get()
+            if first is _DONE:
+                raise RemoteEngineError("remote connection closed")
+            prologue = first.unpack()
             if not prologue.get("ok"):
                 raise RemoteEngineError(prologue.get("error", "remote engine error"))
         except BaseException:
-            writer.close()
+            conn.release(sid)
             raise
 
         ctx = request.ctx
@@ -160,33 +285,35 @@ class RemoteEngine(AsyncEngine):
         async def forward_cancel():
             try:
                 await ctx.stopped()
-                await write_frame(
-                    writer, FrameType.KILL if ctx.is_killed else FrameType.CANCEL
+                await conn.send(
+                    FrameType.KILL if ctx.is_killed else FrameType.CANCEL, sid
                 )
-            except (asyncio.CancelledError, ConnectionResetError, BrokenPipeError):
+            except asyncio.CancelledError:
                 pass
 
         cancel_task = asyncio.create_task(forward_cancel())
-        return ResponseStream(_RemoteStreamIter(reader, writer, cancel_task), ctx)
+        return ResponseStream(
+            _RemoteStreamIter(conn, sid, queue, cancel_task), ctx
+        )
 
 
 class _RemoteStreamIter:
-    """Response-frame iterator whose aclose() always releases the connection.
+    """Response-frame iterator whose aclose() always releases the stream.
 
-    A plain inner async generator would skip its ``finally`` when closed
-    before the first ``__anext__`` (never-started generators don't run their
-    body), leaking the socket and the cancel-forwarding task; this class owns
-    cleanup explicitly.
+    aclose() before completion also tells the worker to stop (CANCEL) —
+    with a shared connection there is no socket close to signal abandonment.
     """
 
     def __init__(
         self,
-        reader: asyncio.StreamReader,
-        writer: asyncio.StreamWriter,
+        conn: MuxConnection,
+        sid: int,
+        queue: asyncio.Queue,
         cancel_task: asyncio.Task,
     ):
-        self._reader = reader
-        self._writer = writer
+        self._conn = conn
+        self._sid = sid
+        self._queue = queue
         self._cancel_task = cancel_task
         self._done = False
 
@@ -198,27 +325,30 @@ class _RemoteStreamIter:
             raise StopAsyncIteration
         try:
             while True:
-                frame = await read_frame(self._reader)
+                frame = await self._queue.get()
+                if frame is _DONE:
+                    await self.aclose(notify=False)
+                    raise RemoteEngineError("remote connection closed mid-stream")
                 if frame.type == FrameType.RESP_ITEM:
                     return frame.unpack()
                 if frame.type == FrameType.RESP_COMPLETE:
-                    await self.aclose()
+                    await self.aclose(notify=False)
                     raise StopAsyncIteration
                 if frame.type == FrameType.RESP_ERROR:
                     err = frame.unpack().get("error", "remote error")
-                    await self.aclose()
+                    await self.aclose(notify=False)
                     raise RemoteEngineError(err)
                 # ignore heartbeats/unknown frame types
-        except asyncio.IncompleteReadError:
-            await self.aclose()
-            raise RemoteEngineError("remote connection closed mid-stream")
         except BaseException:
             await self.aclose()
             raise
 
-    async def aclose(self) -> None:
+    async def aclose(self, notify: bool = True) -> None:
         if self._done:
             return
         self._done = True
         self._cancel_task.cancel()
-        self._writer.close()
+        if notify:
+            # Abandoned before completion: stop the remote generation.
+            await self._conn.send(FrameType.CANCEL, self._sid)
+        self._conn.release(self._sid)

@@ -124,7 +124,9 @@ async def test_remote_prefill_end_to_end():
         await gen_ep.serve_endpoint(worker)
 
         ploop = await PrefillWorkerLoop(
-            prefill_engine, PrefillQueue(prefill_rt.hub, "tiny")
+            prefill_engine,
+            PrefillQueue(prefill_rt.hub, "tiny"),
+            chunk_blocks=1,  # force multi-chunk streaming over the plane
         ).start()
 
         client_ep = (
@@ -158,3 +160,81 @@ async def test_remote_prefill_end_to_end():
         await decode_rt.close()
         await prefill_rt.close()
         await hub.close()
+
+
+@pytest.mark.asyncio
+async def test_partial_export_longest_resident_run():
+    """Export is no longer all-or-nothing: with the tail evicted, the
+    resident prefix run still transfers (round-2 returned None)."""
+    a = TpuEngine(EngineConfig(**CFG))
+    prompt = list(range(1, 17))  # 4 full blocks
+    try:
+        await collect(await a.generate(Context(_req(prompt, max_tokens=1))))
+        from dynamo_tpu.tokens import hash_token_blocks
+
+        blocks = hash_token_blocks(prompt, 4)
+        # Manually evict block 2's hash to simulate a mid-prompt gap.
+        bid = a.kv._by_hash.pop(blocks[2].sequence_hash)
+        a.kv._blocks[bid].sequence_hash = None
+        payload = await a.export_prompt_blocks(prompt)
+        assert payload is not None and payload["n_blocks"] == 2  # blocks 0-1
+        # Chunked export honors start/max.
+        p0 = await a.export_prompt_blocks(prompt, start_block=0, max_blocks=1)
+        assert p0["n_blocks"] == 1 and p0["start_block"] == 0
+        p1 = await a.export_prompt_blocks(prompt, start_block=1, max_blocks=1)
+        assert p1["n_blocks"] == 1 and p1["start_block"] == 1
+        assert await a.export_prompt_blocks(prompt, start_block=2) is None
+    finally:
+        await a.close()
+
+
+@pytest.mark.asyncio
+async def test_chunked_inject_with_offsets():
+    """Chunks injected in order (each with start_block) accumulate into one
+    matchable prefix."""
+    a = TpuEngine(EngineConfig(**CFG))
+    b = TpuEngine(EngineConfig(**CFG))
+    prompt = list(range(21, 37))  # 4 full blocks
+    try:
+        out_a = await collect(await a.generate(Context(_req(prompt, max_tokens=4))))
+        for start in range(0, 4, 2):
+            payload = await a.export_prompt_blocks(
+                prompt, start_block=start, max_blocks=2
+            )
+            assert payload["n_blocks"] == 2
+            covered = await b.inject_blocks(prompt, payload)
+            assert covered == 8
+        assert b.estimate_prefix_hit(prompt) == 16
+        out_b = await collect(await b.generate(Context(_req(prompt, max_tokens=4))))
+        assert [t for i in out_a for t in i["token_ids"]] == [
+            t for i in out_b for t in i["token_ids"]
+        ]
+    finally:
+        await a.close()
+        await b.close()
+
+
+@pytest.mark.asyncio
+async def test_device_direct_transfer():
+    """Co-located engines transfer KV device→device (no host payload) and
+    decode output matches the host-staged path."""
+    from dynamo_tpu.engine.engine import transfer_blocks_device
+
+    a = TpuEngine(EngineConfig(**CFG))
+    b = TpuEngine(EngineConfig(**CFG))
+    prompt = list(range(41, 57))  # 4 full blocks
+    try:
+        out_a = await collect(await a.generate(Context(_req(prompt, max_tokens=4))))
+        covered = await transfer_blocks_device(a, b, prompt)
+        assert covered == 16
+        assert b.estimate_prefix_hit(prompt) == 16
+        assert b.kv.hit_rate == 0.0  # transfer itself is not a lookup
+        out_b = await collect(await b.generate(Context(_req(prompt, max_tokens=4))))
+        assert [t for i in out_a for t in i["token_ids"]] == [
+            t for i in out_b for t in i["token_ids"]
+        ]
+        m = b.metrics()
+        assert m.gpu_prefix_cache_hit_rate > 0.4
+    finally:
+        await a.close()
+        await b.close()

@@ -201,3 +201,36 @@ async def test_detached_runtime_inproc():
         await client.close()
     finally:
         await runtime.close()
+
+
+@pytest.mark.asyncio
+async def test_multiplexed_streams_share_one_connection():
+    """Concurrent requests to one worker ride a single TCP connection
+    (stream ids), not a connection per request (round-2 churn)."""
+    from dynamo_tpu.runtime.engine import AsyncEngine, ResponseStream
+    from dynamo_tpu.runtime.transports.service import (
+        MuxConnection,
+        RemoteEngine,
+        ServiceServer,
+    )
+
+    class Echo(AsyncEngine):
+        async def generate(self, request):
+            async def gen():
+                await asyncio.sleep(0.01)  # keep streams concurrently open
+                yield {"v": request.data["i"]}
+
+            return ResponseStream(gen(), request.ctx)
+
+    server = await ServiceServer().start()
+    server.register("e", Echo())
+    try:
+        eng = RemoteEngine(server.address, "e")
+        outs = await asyncio.gather(
+            *[collect(await eng.generate(Context({"i": i}))) for i in range(8)]
+        )
+        assert [o[0]["v"] for o in outs] == list(range(8))
+        conn = await MuxConnection.get(server.address)
+        assert next(conn._sid) > 8  # all 8 streams used the same connection
+    finally:
+        await server.close()

@@ -1,0 +1,165 @@
+"""GGUF container support: parse/writer roundtrip, `llama.*` metadata →
+ModelConfig, embedded tokenizer extraction, weight loading into the params
+tree, and quantized-type rejection.  Reference semantics:
+lib/llm/src/gguf/{mod,content,metadata}.rs."""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.models import get_config
+from dynamo_tpu.models.gguf import GGUFFile, load_params_gguf, write_gguf
+
+
+def _tiny_meta(vocab):
+    return {
+        "general.architecture": "llama",
+        "general.name": "tiny",
+        "llama.block_count": 2,
+        "llama.embedding_length": 16,
+        "llama.attention.head_count": 4,
+        "llama.attention.head_count_kv": 2,
+        "llama.feed_forward_length": 32,
+        "llama.context_length": 128,
+        "llama.rope.freq_base": 10000.0,
+        "llama.attention.layer_norm_rms_epsilon": 1e-5,
+        "tokenizer.ggml.model": "gpt2",
+        "tokenizer.ggml.tokens": vocab,
+        "tokenizer.ggml.merges": ["h e", "he l", "hel l", "hell o"],
+        "tokenizer.ggml.bos_token_id": 0,
+        "tokenizer.ggml.eos_token_id": 1,
+    }
+
+
+def _tiny_tensors(rng, L=2, D=16, H=4, KV=2, hd=4, F=32, V=8):
+    t = {}
+    t["token_embd.weight"] = rng.standard_normal((V, D)).astype(np.float32)
+    t["output_norm.weight"] = np.ones((D,), np.float32)
+    t["output.weight"] = rng.standard_normal((V, D)).astype(np.float32)
+    for i in range(L):
+        t[f"blk.{i}.attn_norm.weight"] = np.ones((D,), np.float32)
+        t[f"blk.{i}.attn_q.weight"] = rng.standard_normal((H * hd, D)).astype(np.float32)
+        t[f"blk.{i}.attn_k.weight"] = rng.standard_normal((KV * hd, D)).astype(np.float32)
+        t[f"blk.{i}.attn_v.weight"] = rng.standard_normal((KV * hd, D)).astype(np.float32)
+        t[f"blk.{i}.attn_output.weight"] = rng.standard_normal((D, H * hd)).astype(np.float32)
+        t[f"blk.{i}.ffn_norm.weight"] = np.ones((D,), np.float32)
+        t[f"blk.{i}.ffn_gate.weight"] = rng.standard_normal((F, D)).astype(np.float32)
+        t[f"blk.{i}.ffn_up.weight"] = rng.standard_normal((F, D)).astype(np.float32)
+        t[f"blk.{i}.ffn_down.weight"] = rng.standard_normal((D, F)).astype(np.float32)
+    return t
+
+
+def test_gguf_roundtrip_metadata_and_tensors(tmp_path):
+    rng = np.random.default_rng(0)
+    vocab = ["h", "e", "l", "o", "he", "hel", "hell", "hello"]
+    tensors = _tiny_tensors(rng)
+    path = str(tmp_path / "tiny.gguf")
+    write_gguf(path, _tiny_meta(vocab), tensors)
+
+    g = GGUFFile(path)
+    assert g.architecture() == "llama"
+    assert g.metadata["llama.block_count"] == 2
+    assert g.metadata["tokenizer.ggml.tokens"] == vocab
+    assert set(g.tensors) == set(tensors)
+    for name, want in tensors.items():
+        np.testing.assert_array_equal(g.tensor(name), want)
+
+
+def test_gguf_to_model_config(tmp_path):
+    path = str(tmp_path / "tiny.gguf")
+    write_gguf(path, _tiny_meta(["a"] * 8), _tiny_tensors(np.random.default_rng(1)))
+    cfg = GGUFFile(path).to_model_config()
+    assert cfg.num_layers == 2
+    assert cfg.hidden_size == 16
+    assert cfg.num_heads == 4 and cfg.num_kv_heads == 2
+    assert cfg.vocab_size == 8
+    assert cfg.eos_token_ids == (1,)
+
+
+def test_gguf_tokenizer_extraction(tmp_path):
+    path = str(tmp_path / "tiny.gguf")
+    vocab = ["h", "e", "l", "o", "he", "hel", "hell", "hello"]
+    write_gguf(path, _tiny_meta(vocab), _tiny_tensors(np.random.default_rng(2)))
+    tok = GGUFFile(path).to_tokenizer()
+    ids = tok.encode("hello", add_special_tokens=False)
+    assert ids == [vocab.index("hello")]
+    assert tok.decode(ids) == "hello"
+    assert tok.eos_token_id == 1
+
+
+def test_gguf_load_params(tmp_path):
+    rng = np.random.default_rng(3)
+    tensors = _tiny_tensors(rng)
+    path = str(tmp_path / "tiny.gguf")
+    write_gguf(path, _tiny_meta(["a"] * 8), tensors)
+    cfg = GGUFFile(path).to_model_config().with_overrides(dtype="float32")
+    params = load_params_gguf(cfg, path)
+    assert params["layers"]["wq"].shape == (2, 16, 16)  # [L, D, H*hd]
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["wq"][0]),
+        tensors["blk.0.attn_q.weight"].T,
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(params["embed"]), tensors["token_embd.weight"], rtol=1e-6
+    )
+    assert params["lm_head"].shape == (16, 8)
+
+
+def test_gguf_quantized_rejected(tmp_path):
+    import struct
+
+    path = str(tmp_path / "q.gguf")
+    write_gguf(path, _tiny_meta(["a"] * 8), {"x": np.zeros((4, 4), np.float32)})
+    # Patch the tensor's ggml_type field to Q4_0 (=2) in place.
+    g = GGUFFile(path)
+    raw = open(path, "rb").read()
+    # the type field sits right after name + ndims + 2 dims in the directory;
+    # simplest robust patch: rewrite via parser offsets is overkill — write a
+    # file whose parser object we then abuse directly instead.
+    g.tensors["x"].ggml_type = 2
+    with pytest.raises(ValueError, match="quantized"):
+        g.tensor("x")
+
+
+def test_gguf_end_to_end_serving(tmp_path):
+    """`run out=tpu --checkpoint x.gguf`: config + weights + tokenizer all
+    come from the container, and the engine generates."""
+    import asyncio
+    from types import SimpleNamespace
+
+    from dynamo_tpu.engine import build_tpu_engine
+    from dynamo_tpu.llm.protocols import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context, collect
+
+    rng = np.random.default_rng(7)
+    path = str(tmp_path / "serve.gguf")
+    write_gguf(path, _tiny_meta(["a"] * 8), _tiny_tensors(rng))
+    args = SimpleNamespace(
+        arch=None,
+        checkpoint=path,
+        model_config=None,
+        block_size=4,
+        num_blocks=32,
+        max_batch=2,
+        max_model_len=64,
+        prefill_chunk=32,
+    )
+    engine = build_tpu_engine(args)
+    assert engine.model_config.num_layers == 2
+
+    async def main():
+        req = PreprocessedRequest(
+            token_ids=[1, 2, 3],
+            stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+        ).to_dict()
+        out = await collect(await engine.generate(Context(req)))
+        toks = [t for i in out for t in i["token_ids"]]
+        assert len(toks) == 4 and all(0 <= t < 8 for t in toks)
+        await engine.close()
+
+    asyncio.run(main())

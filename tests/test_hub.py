@@ -183,3 +183,34 @@ async def test_inproc_hub_same_interface():
         assert item == 1 and await hub.q_ack(token)
     finally:
         await hub.close()
+
+
+@pytest.mark.asyncio
+async def test_hub_restart_recovers_durable_state(tmp_path):
+    """Kill the hub, start a new one on the same snapshot: durable KV
+    (model registry, config) and queued work survive; lease-bound worker
+    registrations do NOT (workers must re-register — liveness)."""
+    from dynamo_tpu.runtime.transports.hub import HubClient, HubServer
+
+    snap = str(tmp_path / "hub.json")
+    hub = await HubServer(persist_path=snap).start()
+    addr_port = hub.port
+    client = await HubClient(hub.address).connect()
+    await client.kv_put("models/m1", {"endpoint": "dyn://a.b.c"})
+    await client.q_push("prefill", {"job": 1})
+    lease = await client.lease_grant(ttl=30.0)
+    await client.kv_put("instances/w1", {"id": 1}, lease_id=lease)
+    await client.close()
+    await hub.close()  # final snapshot on close
+
+    hub2 = await HubServer(port=addr_port, persist_path=snap).start()
+    try:
+        c2 = await HubClient(hub2.address).connect()
+        assert await c2.kv_get("models/m1") == {"endpoint": "dyn://a.b.c"}
+        assert await c2.kv_get("instances/w1") is None  # lease-bound dropped
+        item, token = await asyncio.wait_for(c2.q_pop("prefill"), 5)
+        assert item == {"job": 1}
+        await c2.q_ack(token)
+        await c2.close()
+    finally:
+        await hub2.close()

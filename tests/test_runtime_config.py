@@ -1,0 +1,72 @@
+"""Layered runtime config (env > file > defaults — config.rs:58-115) and the
+DYN_LOG / JSONL logging subsystem (logging.rs:16-100)."""
+
+import json
+import logging
+
+from dynamo_tpu.runtime.config import RuntimeConfig, env_overrides
+from dynamo_tpu.runtime.logging_config import (
+    JsonlFormatter,
+    parse_filter,
+    setup_logging,
+)
+
+
+def test_config_layering_env_beats_file_beats_defaults(tmp_path):
+    cfg_file = tmp_path / "runtime.yaml"
+    cfg_file.write_text(
+        "namespace: from-file\nhttp_port: 1111\nshutdown_timeout_s: 7.5\n"
+    )
+    env = {
+        "DYN_RUNTIME_CONFIG": str(cfg_file),
+        "DYN_HTTP_PORT": "2222",  # env wins over file
+        "DYN_HUB": '"h:1"',
+    }
+    cfg = RuntimeConfig.from_layers(environ=env)
+    assert cfg.namespace == "from-file"  # file beats default
+    assert cfg.http_port == 2222  # env beats file
+    assert cfg.shutdown_timeout_s == 7.5
+    assert cfg.hub == "h:1"
+    assert cfg.metrics_port == 9091  # untouched default
+
+
+def test_config_env_nesting_and_types():
+    over = env_overrides(
+        {"DYN_ENGINE__MAX_BATCH": "16", "DYN_ENGINE__ATTN": '"tpu"',
+         "DYN_FLAG": "true", "OTHER": "x", "DYN_LOG": "debug"}
+    )
+    assert over == {
+        "engine": {"max_batch": 16, "attn": "tpu"},
+        "flag": True,
+    }  # DYN_LOG reserved for the logging subsystem, OTHER ignored
+
+
+def test_log_filter_parsing():
+    default, mods = parse_filter("warn,dynamo_tpu.engine=debug,hub=error")
+    assert default == logging.WARNING
+    assert mods == {
+        "dynamo_tpu.engine": logging.DEBUG,
+        "hub": logging.ERROR,
+    }
+
+
+def test_jsonl_formatter_shape():
+    rec = logging.LogRecord(
+        "dynamo_tpu.engine", logging.INFO, __file__, 1, "hello %s", ("x",), None
+    )
+    out = json.loads(JsonlFormatter().format(rec))
+    assert out["level"] == "INFO"
+    assert out["target"] == "dynamo_tpu.engine"
+    assert out["message"] == "hello x"
+    assert out["time"].endswith("Z")
+
+
+def test_setup_logging_applies_filters_and_is_idempotent():
+    setup_logging(spec="warn,mymod=debug", fmt="jsonl")
+    setup_logging(spec="warn,mymod=debug", fmt="jsonl")  # no handler pileup
+    root = logging.getLogger()
+    ours = [h for h in root.handlers if getattr(h, "_dyn_installed", False)]
+    assert len(ours) == 1
+    assert isinstance(ours[0].formatter, JsonlFormatter)
+    assert root.level == logging.WARNING
+    assert logging.getLogger("mymod").level == logging.DEBUG
