@@ -337,6 +337,14 @@ def main(argv: Optional[list] = None) -> None:
     p_run.add_argument("--tp", type=int, default=1, help="tensor parallel size")
     p_run.add_argument("--dp", type=int, default=1, help="data parallel size")
     p_run.add_argument("--ep", type=int, default=1, help="expert parallel size")
+    p_run.add_argument(
+        "--sp", type=int, default=1,
+        help="sequence parallel size (ring-attention long-prompt prefill)",
+    )
+    p_run.add_argument(
+        "--sp-prefill-min", type=int, default=1024, dest="sp_prefill_min",
+        help="prompts at least this long use the sp whole-prompt prefill",
+    )
     p_run.add_argument("--block-size", type=int, default=16, dest="block_size")
     p_run.add_argument("--num-blocks", type=int, default=256, dest="num_blocks")
     p_run.add_argument("--max-batch", type=int, default=8, dest="max_batch")

@@ -43,6 +43,8 @@ def build_tpu_engine(args):
         tp=getattr(args, "tp", 1),
         dp=getattr(args, "dp", 1),
         ep=getattr(args, "ep", 1),
+        sp=getattr(args, "sp", 1),
+        sp_prefill_min=getattr(args, "sp_prefill_min", 1024),
         checkpoint_path=getattr(args, "checkpoint", None),
         attn_impl=getattr(args, "attn_impl", "auto"),
     )

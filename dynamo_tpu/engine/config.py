@@ -35,6 +35,12 @@ class EngineConfig:
     dp: int = 1
     tp: int = 1
     ep: int = 1
+    # sequence parallel (ring attention): long prompts >= sp_prefill_min
+    # tokens prefill in ONE whole-prompt pass sharded over the "sp" axis
+    # instead of serial prefill_chunk steps (models/llama.py
+    # forward_sp_prefill).  Best fit: dedicated (disagg) prefill workers.
+    sp: int = 1
+    sp_prefill_min: int = 1024
     dtype: str = "bfloat16"
     cache_dtype: Optional[str] = None  # defaults to dtype
     seed: int = 0

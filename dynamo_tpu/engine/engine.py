@@ -121,7 +121,7 @@ class TpuEngine(AsyncEngine):
         self.step_trace: List[Tuple[str, float, int, int]] = []
 
         # --- device state -------------------------------------------------
-        mesh_cfg = MeshConfig(dp=cfg.dp, tp=cfg.tp, ep=cfg.ep)
+        mesh_cfg = MeshConfig(dp=cfg.dp, tp=cfg.tp, ep=cfg.ep, sp=cfg.sp)
         self.mesh = make_mesh(mesh_cfg) if mesh_cfg.num_devices > 1 else None
         # In a multi-process (multi-host) run, host-side step inputs must be
         # assembled into replicated GLOBAL arrays before they can feed a jit
@@ -292,6 +292,18 @@ class TpuEngine(AsyncEngine):
                 _inject, donate_argnums=(0,), out_shardings=cache_sh
             )
         self._gather_fn = jax.jit(_gather)  # host offload (no donation)
+
+        if cfg.sp > 1:
+            from ..models.llama import forward_sp_prefill
+
+            def _sp(params, toks, valid):
+                return forward_sp_prefill(
+                    params, model_config, toks, valid, mesh
+                )
+
+            self._sp_fn = jax.jit(_sp)
+        else:
+            self._sp_fn = None
         # Cached all-zeros penalty-counts buffer (see _sampling_arrays).
         self._zero_counts = jnp.zeros(
             (S, self.model_config.vocab_size), jnp.int16
@@ -468,6 +480,24 @@ class TpuEngine(AsyncEngine):
             last.block_until_ready()
         else:
             out.tokens.block_until_ready()
+        if self._sp_fn is not None:
+            # Every reachable sp-prefill token bucket (pow2, sp multiple,
+            # sp_prefill_min..max_model_len) — a cold whole-model compile
+            # must never land inside a request.
+            lo = max(cfg.sp, 1 << (max(1, cfg.sp_prefill_min) - 1).bit_length())
+            hi = max(lo, 1 << (cfg.max_model_len - 1).bit_length())
+            t = lo
+            while True:
+                Tg = t + (-t) % cfg.sp
+                _, kv_rows = self._sp_fn(
+                    self.params,
+                    np.zeros((Tg,), np.int32),
+                    np.asarray(Tg, np.int32),
+                )
+                kv_rows.block_until_ready()
+                if t >= hi:
+                    break
+                t *= 2
         return self.compile_counts()
 
     # ------------------------------------------------------------ public API
@@ -486,6 +516,14 @@ class TpuEngine(AsyncEngine):
             # admission, so the scheduler sees them as prefix-cache hits
             # (the reference's restore-ahead-of-prefill TTFT win).
             await self._restore_from_host(list(pre.token_ids))
+        if (
+            self._sp_fn is not None
+            and len(pre.token_ids) >= self.cfg.sp_prefill_min
+            and jax.process_count() == 1
+        ):
+            # Long prompt: one sequence-parallel whole-prompt pass seals the
+            # complete blocks ahead of admission (ring attention over "sp").
+            await self._sp_prefill(list(pre.token_ids))
         seq = SequenceState.from_request(request.id, pre, self.cfg)
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[request.id] = queue
@@ -1181,6 +1219,56 @@ class TpuEngine(AsyncEngine):
         for i, (_, tb) in enumerate(live):
             self.host_kv.put(tb.sequence_hash, np.ascontiguousarray(pages[:, i]))
         return len(live)
+
+    async def _sp_prefill(self, token_ids: List[int]) -> int:
+        """Whole-prompt sequence-parallel prefill: compute the prompt's KV in
+        one ring-attention pass over the "sp" mesh axis and seal its complete
+        blocks into the paged cache (released to the reuse pool), so
+        admission sees a full prefix hit.  The trailing partial block plus
+        the last token recompute through the normal unified step (which also
+        produces the first sampled token's logits).  Returns sealed tokens.
+        """
+        from ..tokens import hash_token_blocks
+
+        cfg = self.cfg
+        bs = cfg.block_size
+        n_complete = len(token_ids) // bs
+        blocks = hash_token_blocks(token_ids, bs)
+        resident = len(self.kv.match_prefix(blocks))
+        if resident >= n_complete or n_complete == 0:
+            return 0
+        # Token bucket: power of two, multiple of sp (bounds recompiles).
+        Tg = max(cfg.sp, 1 << (len(token_ids) - 1).bit_length())
+        Tg += (-Tg) % cfg.sp
+        toks = np.zeros((Tg,), np.int32)
+        toks[: len(token_ids)] = token_ids
+        valid = np.asarray(len(token_ids), np.int32)
+        # No _device_lock here: the forward is a pure function of
+        # params+tokens (touches no donated cache), so decode dispatches
+        # interleave in the device queue instead of stalling behind the
+        # whole-prompt pass.  (Dedicated disagg prefill workers remain the
+        # intended fit for sp — config.py.)
+        _, kv_rows = await asyncio.to_thread(
+            self._sp_fn, self.params, toks, valid
+        )
+        # [L, Tg, 2KV, hd] → complete-block pages [L, n, bs, 2KV, hd]
+        L = kv_rows.shape[0]
+        pages = kv_rows[:, : n_complete * bs].reshape(
+            L, n_complete, bs, kv_rows.shape[2], kv_rows.shape[3]
+        )[:, resident:]
+        n_new = n_complete - resident
+        pad = 1 << max(0, (n_new - 1).bit_length())
+        if pad != n_new:
+            pages = jnp.pad(pages, ((0, 0), (0, pad - n_new), (0, 0), (0, 0), (0, 0)))
+        covered = await self.inject_blocks_from_device(
+            token_ids, pages, n_new, start_block=resident
+        )
+        if covered:
+            logger.info(
+                "sp prefill sealed %d tokens of %d (sp=%d, bucket %d)",
+                covered, len(token_ids), cfg.sp, Tg,
+            )
+        return covered
 
     async def _restore_from_host(self, token_ids: List[int]) -> int:
         """Scatter host-tier blocks beyond the HBM-resident prefix back into

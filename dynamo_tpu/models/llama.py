@@ -240,3 +240,78 @@ def forward_ragged(
         head = params["embed"].T
     logits = (h_last @ head).astype(jnp.float32)  # [S, vocab]
     return logits, PagedKVCache(pages)
+
+
+def forward_sp_prefill(
+    params: Params,
+    config: ModelConfig,
+    token_ids: jnp.ndarray,  # [Tg] int32, Tg divisible by the mesh's sp size
+    valid_len,  # int or [] int32 — true prompt length (<= Tg; rest padding)
+    mesh,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Whole-prompt sequence-parallel prefill for long contexts.
+
+    Tokens shard over the mesh's "sp" axis; every matmul is local to its
+    token shard (weights replicated over sp) and attention runs as RING
+    attention (ops/ring_attention.py) — per-chip attention memory is
+    O((Tg/sp)^2) and K/V blocks move neighbor-to-neighbor over ICI.  The
+    reference has no counterpart (SURVEY §5: no sequence parallelism
+    anywhere); this is the TPU-native long-context path the north-star
+    configs call for.
+
+    Returns (logits [vocab] f32 of the LAST valid token — the first decode
+    token's distribution — and kv [L, Tg, 2*KV, hd] combined-interleaved
+    pages-layout rows for sealing the prompt into the paged cache).
+    """
+    from ..ops.ring_attention import ring_attention
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    (Tg,) = token_ids.shape
+    H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
+    inv_freq = rope_frequencies(hd, config.rope_theta, config.rope_scaling)
+    scale = hd**-0.5
+    valid = jnp.asarray(valid_len, jnp.int32).reshape(())
+
+    # Tokens shard over "sp", heads over "tp": with both axes active each
+    # chip rings over its own heads' K/V only (no per-layer all-gather of
+    # tp-sharded projections, no redundant attention across tp replicas).
+    heads = P("sp", "tp", None)
+    ring = shard_map(
+        lambda q, k, v, n: ring_attention(q, k, v, n[0], sm_scale=scale),
+        mesh=mesh,
+        in_specs=(heads, heads, heads, P()),
+        out_specs=heads,
+        check_vma=False,
+    )
+
+    positions = jnp.arange(Tg, dtype=jnp.int32)
+    h = params["embed"][token_ids]  # [Tg, D] — sharded over sp by input spec
+
+    def layer(carry, lp):
+        h = carry
+        x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
+        q = apply_rope((x @ lp["wq"]).reshape(Tg, H, hd), positions, inv_freq)
+        k = apply_rope((x @ lp["wk"]).reshape(Tg, KV, hd), positions, inv_freq)
+        v = (x @ lp["wv"]).reshape(Tg, KV, hd)
+        attn = ring(q, k, v, jnp.asarray([valid], jnp.int32))
+        h = h + attn.reshape(Tg, H * hd) @ lp["wo"]
+        x = rms_norm(h, lp["mlp_norm"], config.rms_norm_eps)
+        if config.is_moe:
+            h = h + moe_mlp(x[None], lp, config)[0]
+        else:
+            gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+            h = h + (gate * (x @ lp["w_up"])) @ lp["w_down"]
+        # pages layout rows: K at even combined-head indices, V at odd
+        comb = jnp.stack([k, v], axis=2).reshape(Tg, 2 * KV, hd)
+        return h, comb
+
+    h, kv = jax.lax.scan(layer, h, params["layers"])
+
+    h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
+    h_last = h[jnp.clip(valid - 1, 0, Tg - 1)]
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = (h_last @ head).astype(jnp.float32)
+    return logits, kv  # kv: [L, Tg, 2KV, hd]

@@ -1,0 +1,120 @@
+"""Ring attention: causal self-attention sequence-parallel over a mesh axis.
+
+The reference has NO long-context parallelism (SURVEY §5 — it offloads long
+prefills to dedicated workers and chunks them); this is the TPU-native
+capability the north-star configs need: shard a long prompt's tokens over
+the ``sp`` mesh axis, keep Q resident per shard, and rotate K/V blocks
+around the ring with ``lax.ppermute`` while accumulating an online softmax —
+compute and memory per chip stay O(T/sp · T), K/V movement rides ICI
+neighbor-to-neighbor (the Ring Attention construction of Liu et al. 2023,
+built here from scratch on XLA collectives).
+
+Layout contract: shard i of the ``sp`` axis owns the CONTIGUOUS token chunk
+[i*C, (i+1)*C) of a length sp*C prompt (padding tokens at the tail of the
+last shards are masked by ``valid_len``).  Causality falls out of chunk
+indices: a shard attends fully to earlier chunks, causally within its own,
+not at all to later ones — those ring rounds still run (uniform program per
+shard) but are masked.
+
+Use ``ring_attention`` inside shard_map (see tests/test_ring_attention.py)
+or through ``parallel.mesh`` sp-aware forward paths.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _chunk_attend(q, k, v, q_pos, k_pos, valid_len, sm_scale):
+    """Partial (unnormalized) attention of q against one K/V chunk.
+
+    q: [C, KV, G, D] f32; k/v: [C, KV, D] f32.
+    Returns (o_part [C, KV, G, D], m [C, KV, G], l [C, KV, G]) — the online
+    softmax partials (running max, sum of exp) for this chunk.
+    """
+    scores = jnp.einsum("qkgd,lkd->kgql", q, k) * sm_scale  # [KV, G, C, C]
+    mask = (k_pos[None, :] <= q_pos[:, None]) & (k_pos[None, :] < valid_len)
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)  # [KV, G, C]
+    p = jnp.exp(scores - m[..., None])
+    p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)  # [KV, G, C]
+    o = jnp.einsum("kgql,lkd->qkgd", p, v)  # [C, KV, G, D]
+    # transpose m/l to [C, KV, G] to match o's leading token dim
+    return o, m.transpose(2, 0, 1), l.transpose(2, 0, 1)
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [C, H, D] this shard's queries (f32/bf16)
+    k: jnp.ndarray,  # [C, KV, D] this shard's keys
+    v: jnp.ndarray,  # [C, KV, D] this shard's values
+    valid_len: jnp.ndarray,  # [] int32 — global prompt length (pre-padding)
+    *,
+    axis_name: str = "sp",
+    sm_scale: float,
+) -> jnp.ndarray:
+    """Causal ring attention; call under shard_map with ``axis_name`` bound.
+
+    Returns [C, H, D] attention outputs for this shard's tokens.
+    """
+    sp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    C, H, D = q.shape
+    KV = k.shape[1]
+    G = H // KV
+
+    qf = q.astype(jnp.float32).reshape(C, KV, G, D)
+    q_pos = idx * C + jnp.arange(C, dtype=jnp.int32)
+
+    o = jnp.zeros((C, KV, G, D), jnp.float32)
+    m = jnp.full((C, KV, G), NEG_INF, jnp.float32)
+    l = jnp.zeros((C, KV, G), jnp.float32)
+    kv = (k.astype(jnp.float32), v.astype(jnp.float32))
+
+    # sp is static (mesh shape), so the ring unrolls at trace time; each
+    # round overlaps the neighbor ppermute with the chunk's compute.
+    for r in range(sp):
+        src = (idx - r) % sp  # whose chunk we hold this round
+        k_pos = src * C + jnp.arange(C, dtype=jnp.int32)
+        o_c, m_c, l_c = _chunk_attend(
+            qf, kv[0], kv[1], q_pos, k_pos, valid_len, sm_scale
+        )
+        # online softmax merge
+        m_new = jnp.maximum(m, m_c)
+        # guard fully-masked chunks (m_c == NEG_INF): exp underflows to 0
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_c - m_new)
+        o = o * alpha[..., None] + o_c * beta[..., None]
+        l = l * alpha + l_c * beta
+        m = m_new
+        if r != sp - 1:
+            perm = [(j, (j + 1) % sp) for j in range(sp)]
+            kv = lax.ppermute(kv, axis_name, perm)
+
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(C, H, D).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, valid_len, mesh, *, sm_scale):
+    """Convenience wrapper: shard_map ``ring_attention`` over mesh axis "sp".
+
+    q/k/v are GLOBAL [T, H|KV, D] arrays (T divisible by the sp size);
+    tokens shard over "sp", heads stay local (combine with "tp" by sharding
+    the head axis in the caller's specs)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    fn = shard_map(
+        lambda q_, k_, v_, n_: ring_attention(
+            q_, k_, v_, n_[0], sm_scale=sm_scale
+        ),
+        mesh=mesh,
+        in_specs=(P("sp"), P("sp"), P("sp"), P()),
+        out_specs=P("sp"),
+        check_vma=False,
+    )
+    return fn(q, k, v, jnp.asarray([valid_len], jnp.int32))

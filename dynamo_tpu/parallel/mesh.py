@@ -36,10 +36,11 @@ class MeshConfig:
     dp: int = 1
     tp: int = 1
     ep: int = 1  # expert parallel; 1 = fold experts onto tp
+    sp: int = 1  # sequence parallel (ring attention, long-context prefill)
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.tp * self.ep
+        return self.dp * self.tp * self.ep * self.sp
 
 
 def make_mesh(
@@ -59,8 +60,10 @@ def make_mesh(
                 devices = cpus
     if len(devices) < n:
         raise ValueError(f"need {n} devices for {cfg}, have {len(devices)}")
-    grid = np.array(devices[:n]).reshape(cfg.dp, cfg.ep, cfg.tp)
-    return Mesh(grid, ("dp", "ep", "tp"))
+    # sp adjacent to tp: K/V ring hops between sp neighbors stay one ICI
+    # hop for standard torus topologies.
+    grid = np.array(devices[:n]).reshape(cfg.dp, cfg.ep, cfg.sp, cfg.tp)
+    return Mesh(grid, ("dp", "ep", "sp", "tp"))
 
 
 def param_pspecs(config: ModelConfig) -> Any:
