@@ -210,7 +210,7 @@ async def _self_host(args):
         num_blocks=max_batch * blocks_per_seq + 64,
         max_batch=max_batch,
         max_model_len=ctx,
-        prefill_chunk=512,
+        prefill_chunk=int(os.environ.get("LOADGEN_PREFILL_CHUNK", "512")),
         decode_steps=int(os.environ.get("LOADGEN_DECODE_STEPS", "16")),
         pipeline_depth=4,
         dtype="float32" if backend == "cpu" else "bfloat16",

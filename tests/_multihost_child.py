@@ -49,20 +49,28 @@ CFG = EngineConfig(
 )
 
 PROMPTS = [[1, 2, 3, 4, 5], [9, 8, 7]]
+# One greedy row and one seeded+penalized sampled row: the fused sampler's
+# per-request seed/penalty state must stay in SPMD lockstep across hosts.
+SAMPLING = [
+    SamplingOptions(temperature=0.0),
+    SamplingOptions(temperature=0.8, seed=42, frequency_penalty=0.5),
+]
 
 
 async def generate_all(engine):
-    async def one(p):
+    async def one(p, samp):
         req = PreprocessedRequest(
             token_ids=p,
             stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
-            sampling_options=SamplingOptions(temperature=0.0),
+            sampling_options=samp,
         ).to_dict()
         stream = await engine.generate(Context(req))
         out = await collect(stream)
         return [t for item in out for t in item["token_ids"]]
 
-    return await asyncio.gather(*[one(p) for p in PROMPTS])
+    return await asyncio.gather(
+        *[one(p, s) for p, s in zip(PROMPTS, SAMPLING)]
+    )
 
 
 async def main() -> None:
