@@ -477,9 +477,13 @@ class TpuEngine(AsyncEngine):
                 self.params, self.cache, last, steps_f, counts_f,
                 *args, self._prep(samp)
             )
-            last.block_until_ready()
+            # A real fetch, not block_until_ready: some remote-execution
+            # backends treat block_until_ready as a local no-op, and warmup
+            # must not return with compiles/executions still queued (the
+            # first real request would absorb them).
+            np.asarray(last)
         else:
-            out.tokens.block_until_ready()
+            np.asarray(out.tokens)
         if self._sp_fn is not None:
             # Every reachable sp-prefill token bucket (pow2, sp multiple,
             # sp_prefill_min..max_model_len) — a cold whole-model compile
@@ -489,12 +493,12 @@ class TpuEngine(AsyncEngine):
             t = lo
             while True:
                 Tg = t + (-t) % cfg.sp
-                _, kv_rows = self._sp_fn(
+                logits_sp, _ = self._sp_fn(
                     self.params,
                     np.zeros((Tg,), np.int32),
                     np.asarray(Tg, np.int32),
                 )
-                kv_rows.block_until_ready()
+                np.asarray(logits_sp)  # real fetch (see above)
                 if t >= hi:
                     break
                 t *= 2
