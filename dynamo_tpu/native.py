@@ -27,7 +27,11 @@ from typing import List, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libdyn_native.so")
+# DYN_NATIVE_LIB overrides the library (e.g. the `make sanitize` ASan build).
+_SO_PATH = os.environ.get(
+    "DYN_NATIVE_LIB",
+    os.path.join(_NATIVE_DIR, "build", "libdyn_native.so"),
+)
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -51,9 +55,18 @@ def _build() -> bool:
 
 def _build_and_load() -> None:
     global _lib, _load_failed
-    if not os.path.exists(_SO_PATH) and not _build():
-        _load_failed = True
-        return
+    if not os.path.exists(_SO_PATH):
+        if "DYN_NATIVE_LIB" in os.environ:
+            # An explicit override must never silently fall back to the
+            # pure-Python path (e.g. a sanitizer run that tests nothing) —
+            # and auto-build only knows the default target.
+            raise FileNotFoundError(
+                f"DYN_NATIVE_LIB={_SO_PATH} does not exist; build it first "
+                "(e.g. `make -C native sanitize`)"
+            )
+        if not _build():
+            _load_failed = True
+            return
     _load()
 
 

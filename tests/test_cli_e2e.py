@@ -1,0 +1,132 @@
+"""Full CLI integration: hub, native-engine worker, and discovery HTTP
+frontend as three real processes (the deployment the k8s renderer emits),
+serving a streamed completion end-to-end with KV-aware routing available.
+Covers arg parsing, logging setup, engine build, model registration, the
+model watcher, the multiplexed request plane, and the OpenAI edge."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env() -> dict:
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO,
+        "PYTHONUNBUFFERED": "1",
+        "DYN_LOG": "info",
+    }
+    for keep in ("PATH", "HOME", "TMPDIR", "LANG"):
+        if keep in os.environ:
+            env[keep] = os.environ[keep]
+    return env
+
+
+def _spawn(*args: str) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.cli", *args],
+        env=_env(),
+        cwd=REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def _wait_http(url: str, deadline_s: float = 90.0):
+    end = time.time() + deadline_s
+    last = None
+    while time.time() < end:
+        try:
+            with urllib.request.urlopen(url, timeout=2) as r:
+                return json.loads(r.read())
+        except Exception as e:  # noqa: BLE001 — retry until deadline
+            last = e
+            time.sleep(0.5)
+    raise AssertionError(f"{url} never came up: {last}")
+
+
+def _wait_tcp(port: int, deadline_s: float = 60.0) -> None:
+    end = time.time() + deadline_s
+    while time.time() < end:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return
+        except OSError:
+            time.sleep(0.3)
+    raise AssertionError(f"port {port} never accepted connections")
+
+
+def test_cli_three_process_serving():
+    hub_port, http_port = _free_port(), _free_port()
+    procs = []
+    try:
+        procs.append(_spawn("hub", "--host", "127.0.0.1", "--port", str(hub_port)))
+        _wait_tcp(hub_port)
+        hub = f"127.0.0.1:{hub_port}"
+        procs.append(
+            _spawn(
+                "run", "in=dyn://dynamo.TpuWorker.generate", "out=tpu",
+                "--hub", hub, "--model", "tiny", "--arch", "debug-tiny",
+                "--block-size", "4", "--num-blocks", "64", "--max-batch", "2",
+                "--max-model-len", "128", "--prefill-chunk", "32",
+            )
+        )
+        procs.append(
+            _spawn(
+                "http", "--hub", hub, "--host", "127.0.0.1",
+                "--port", str(http_port), "--router", "kv",
+            )
+        )
+        base = f"http://127.0.0.1:{http_port}"
+        end = time.time() + 120
+        while time.time() < end:
+            models = _wait_http(f"{base}/v1/models")
+            if any(m["id"] == "tiny" for m in models.get("data", [])):
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("model never registered")
+
+        req = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps(
+                {
+                    "model": "tiny",
+                    "prompt": "hello",
+                    "max_tokens": 5,
+                    "stream": False,
+                    "nvext": {"ignore_eos": True},
+                }
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            body = json.loads(r.read())
+        assert body["choices"][0]["finish_reason"] == "length"
+        assert body["usage"]["completion_tokens"] == 5
+
+        metrics = urllib.request.urlopen(f"{base}/metrics", timeout=10).read()
+        assert b"requests_total" in metrics or b"http" in metrics
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=10)
+                if out:
+                    sys.stderr.write(out[-1500:])
+            except Exception:
+                pass
