@@ -105,17 +105,20 @@ def ragged_attention(
                 # (vLLM's TPU backend raises it the same way).
                 vmem_limit_bytes=64 << 20,
             )
-        except Exception as e:  # trace-time shape rejection (toy geometries)
-            # The kernel enforces its own contract during tracing; anything
-            # it rejects (e.g. debug-model head shapes its block tiling
-            # can't broadcast) falls back to the XLA path rather than
-            # crashing the engine.  Real serving geometries stay on the
-            # kernel — this never triggers at runtime, only at trace.
+        except Exception as e:  # trace-time rejection
+            # The kernel enforces its own contract during tracing.  Only
+            # TOY geometries (sub-lane-width heads: tests/debug models) may
+            # silently fall back to the XLA path — there its O(T·window)
+            # materialization is small.  A rejection at a real serving
+            # geometry (head_dim >= 128) is a kernel/JAX fault that must be
+            # LOUD, not a silent 10x memory/latency downgrade.
+            if hd >= 128:
+                raise
             import logging
 
             logging.getLogger(__name__).warning(
-                "pallas ragged kernel rejected shapes q=%s pages=%s (%s); "
-                "using the XLA fallback",
+                "pallas ragged kernel rejected toy shapes q=%s pages=%s "
+                "(%s); using the XLA fallback",
                 q.shape, pages.shape, e,
             )
             impl = "xla"
