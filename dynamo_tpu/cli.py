@@ -351,6 +351,14 @@ def main(argv: Optional[list] = None) -> None:
     p_run.add_argument("--max-model-len", type=int, default=1024, dest="max_model_len")
     p_run.add_argument("--prefill-chunk", type=int, default=512, dest="prefill_chunk")
     p_run.add_argument(
+        "--kv-cache-dtype", default=None, dest="cache_dtype",
+        help="KV page dtype (e.g. float8_e4m3fn halves KV memory)",
+    )
+    p_run.add_argument(
+        "--kv-scale", type=float, default=1.0, dest="kv_scale",
+        help="static scale for quantized KV pages",
+    )
+    p_run.add_argument(
         "--attn-impl",
         default="auto",
         choices=["auto", "xla", "pallas", "jax"],

@@ -45,6 +45,8 @@ def build_tpu_engine(args):
         ep=getattr(args, "ep", 1),
         sp=getattr(args, "sp", 1),
         sp_prefill_min=getattr(args, "sp_prefill_min", 1024),
+        cache_dtype=getattr(args, "cache_dtype", None),
+        kv_scale=getattr(args, "kv_scale", 1.0),
         checkpoint_path=getattr(args, "checkpoint", None),
         attn_impl=getattr(args, "attn_impl", "auto"),
     )

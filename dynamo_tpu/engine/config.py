@@ -42,7 +42,14 @@ class EngineConfig:
     sp: int = 1
     sp_prefill_min: int = 1024
     dtype: str = "bfloat16"
-    cache_dtype: Optional[str] = None  # defaults to dtype
+    # KV cache dtype; defaults to dtype.  Quantized page dtypes halve KV
+    # memory (2x context capacity) with one static kv_scale — the TPU
+    # kernel's native k_scale/v_scale path.  "float8_e4m3fn" works with the
+    # default scale; "int8" REQUIRES a calibrated kv_scale (stored values
+    # are value/kv_scale rounded to integers — at the 1.0 default, normal
+    # sub-unit activations all round to 0).
+    cache_dtype: Optional[str] = None
+    kv_scale: float = 1.0
     seed: int = 0
     # derived buckets
     batch_buckets: List[int] = field(default_factory=list)

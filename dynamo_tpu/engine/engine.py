@@ -164,10 +164,18 @@ class TpuEngine(AsyncEngine):
         self.attn_impl = attn_impl
         S = cfg.max_batch
         mesh = self.mesh
+        # Quantized (1-byte) KV pages use one static scale (config.py).
+        self.kv_scale = (
+            float(cfg.kv_scale)
+            if jnp.dtype(cfg.cache_dtype).itemsize == 1
+            else None
+        )
+        kv_scale = self.kv_scale
 
         def _step(params, cache, rb, samp):
             logits, cache = forward_ragged(
-                params, model_config, rb, cache, attn_impl=attn_impl, mesh=mesh
+                params, model_config, rb, cache, attn_impl=attn_impl,
+                mesh=mesh, kv_scale=kv_scale,
             )
             out = sample_tokens(
                 logits,
@@ -222,7 +230,7 @@ class TpuEngine(AsyncEngine):
                 )
                 logits, cache = forward_ragged(
                     params, model_config, rb, cache, attn_impl=attn_impl,
-                    mesh=mesh,
+                    mesh=mesh, kv_scale=kv_scale,
                 )
                 out = sample_tokens(
                     logits,
@@ -627,6 +635,10 @@ class TpuEngine(AsyncEngine):
             "start_block": start_block,
             "block_size": self.cfg.block_size,
             "dtype": str(k.dtype),
+            # Stored representation metadata: the importer must match (a
+            # different quantization scale/dtype would seal wrongly-scaled
+            # KV under valid hashes).
+            "kv_scale": self.kv_scale,
             "shape": list(k.shape),
             "k": np.ascontiguousarray(k).tobytes(),
             "v": np.ascontiguousarray(v).tobytes(),
@@ -667,10 +679,24 @@ class TpuEngine(AsyncEngine):
             )
             self.kv.free_sequence(alloc[0])
             return 0
+        if (
+            payload.get("dtype", str(jnp.dtype(self.cfg.cache_dtype)))
+            != str(jnp.dtype(self.cfg.cache_dtype))
+            or payload.get("kv_scale", self.kv_scale) != self.kv_scale
+        ):
+            # Stored-representation mismatch (quantization dtype/scale):
+            # importing raw rows would mis-scale the prefix silently.
+            logger.warning(
+                "rejecting KV import: stored repr %s/scale %s != local %s/%s",
+                payload.get("dtype"), payload.get("kv_scale"),
+                jnp.dtype(self.cfg.cache_dtype), self.kv_scale,
+            )
+            self.kv.free_sequence(alloc[0])
+            return 0
         ids, cached = alloc
         shape = tuple(payload["shape"])
         name = payload["dtype"]
-        dt = jnp.bfloat16 if name == "bfloat16" else np.dtype(name)
+        dt = jnp.dtype(name)  # ml_dtypes registers bf16/fp8 names
         k = np.frombuffer(payload["k"], dtype=dt).reshape(shape)[:, :n]
         v = np.frombuffer(payload["v"], dtype=dt).reshape(shape)[:, :n]
         # Interleave back to combined pages [L, n, ps, 2KV, hd] (K even).
@@ -1257,6 +1283,9 @@ class TpuEngine(AsyncEngine):
         )
         # [L, Tg, 2KV, hd] → complete-block pages [L, n, bs, 2KV, hd]
         L = kv_rows.shape[0]
+        if self.kv_scale is not None and self.kv_scale != 1.0:
+            # Quantized cache stores value/scale (write_kv_ragged contract).
+            kv_rows = kv_rows.astype(jnp.float32) / self.kv_scale
         pages = kv_rows[:, : n_complete * bs].reshape(
             L, n_complete, bs, kv_rows.shape[2], kv_rows.shape[3]
         )[:, resident:]
@@ -1436,6 +1465,8 @@ async def transfer_blocks_device(src: TpuEngine, dst: TpuEngine, token_ids) -> i
         return 0
     if src.cache.pages.shape[0] != dst.cache.pages.shape[0]:
         return 0  # different layer counts: not the same model
+    if src.cache.pages.dtype != dst.cache.pages.dtype or src.kv_scale != dst.kv_scale:
+        return 0  # stored representation differs: host path will also refuse
     blocks = hash_token_blocks(token_ids, src.cfg.block_size)
     src_ids: List[int] = []
     for tb in blocks:

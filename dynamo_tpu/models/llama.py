@@ -136,6 +136,7 @@ def forward_ragged(
     *,
     attn_impl: str = "xla",  # "tpu" (pallas kernel) | "xla" (gather fallback)
     mesh=None,
+    kv_scale=None,  # static scale for quantized (fp8/int8) page dtypes
 ) -> Tuple[jnp.ndarray, PagedKVCache]:
     """Unified mixed prefill+decode forward over a flat ragged token run.
 
@@ -158,7 +159,7 @@ def forward_ragged(
     L, P_layer, ps = cache.pages.shape[0], cache.pages.shape[1], cache.pages.shape[2]
 
     def attn_and_write(q, k, v, pages, slots, kv_lens, tables, cu, num):
-        pages = write_kv_ragged(pages, k, v, slots)
+        pages = write_kv_ragged(pages, k, v, slots, kv_scale=kv_scale)
         out = ragged_attention(
             q,
             pages,
@@ -168,6 +169,7 @@ def forward_ragged(
             num,
             sm_scale=scale,
             impl=attn_impl,
+            kv_scale=kv_scale,
         )
         return out, pages
 

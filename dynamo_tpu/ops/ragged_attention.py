@@ -47,6 +47,7 @@ def write_kv_ragged(
     k_new: jnp.ndarray,  # [T, kv_heads, head_dim]
     v_new: jnp.ndarray,  # [T, kv_heads, head_dim]
     slot_mapping: jnp.ndarray,  # [T] int32 flat slot ids; -1 = padding (dropped)
+    kv_scale: float | None = None,  # quantized cache: store value/scale
 ) -> jnp.ndarray:
     """Scatter new K/V rows into their cache slots (one combined scatter)."""
     P, ps, KV2, D = pages.shape
@@ -54,6 +55,14 @@ def write_kv_ragged(
     # Interleave to the combined layout: [T, KV, 2, D] -> [T, 2KV, D]
     # puts k_h at combined index 2h and v_h at 2h+1.
     comb = jnp.stack([k_new, v_new], axis=2).reshape(T, KV2, D)
+    if kv_scale is not None and kv_scale != 1.0:
+        comb = comb.astype(jnp.float32) / kv_scale
+    if jnp.issubdtype(pages.dtype, jnp.integer):
+        # Integer caches: round-to-nearest (astype truncates toward zero,
+        # which both biases the quantization and zeroes |x| < 1) and clip
+        # to the representable range.
+        info = jnp.iinfo(pages.dtype)
+        comb = jnp.clip(jnp.round(comb.astype(jnp.float32)), info.min, info.max)
     slots = jnp.where(jnp.asarray(slot_mapping) < 0, P * ps, slot_mapping)
     flat = pages.reshape(P * ps, KV2, D)
     flat = flat.at[slots].set(comb.astype(flat.dtype), mode="drop")
@@ -70,6 +79,7 @@ def ragged_attention(
     *,
     sm_scale: float,
     impl: str = "xla",  # "tpu" | "xla"
+    kv_scale: float | None = None,  # quantized cache: value = stored * scale
 ) -> jnp.ndarray:
     """Causal attention of each token against its sequence's paged context.
 
@@ -77,6 +87,10 @@ def ragged_attention(
     kv_lens[i]-token context (their K/V must already be written — callers run
     write_kv_ragged first).  Tokens at or past cu_q_lens[num_seqs] are
     padding and produce zeros.
+
+    ``kv_scale`` supports quantized (fp8/int8) page dtypes with one static
+    per-tensor scale — the TPU kernel's native k_scale/v_scale contract;
+    the write side stores value/scale (write_kv_ragged).
     """
     if impl == "tpu":
         from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
@@ -104,6 +118,8 @@ def ragged_attention(
                 # not the hardware ceiling; long-context shapes need headroom
                 # (vLLM's TPU backend raises it the same way).
                 vmem_limit_bytes=64 << 20,
+                k_scale=kv_scale,
+                v_scale=kv_scale,
             )
         except Exception as e:  # trace-time rejection
             # The kernel enforces its own contract during tracing.  Only
@@ -154,6 +170,9 @@ def ragged_attention(
     kv = pages.reshape(-1, 2 * KV, D)[slots]  # [T, W, 2KV, D]
     k = kv[:, :, 0::2].astype(jnp.float32)  # [T, W, KV, D]
     v = kv[:, :, 1::2].astype(jnp.float32)
+    if kv_scale is not None and kv_scale != 1.0:
+        k = k * kv_scale
+        v = v * kv_scale
 
     qf = q.reshape(T, KV, G, D).astype(jnp.float32) * sm_scale
     logits = jnp.einsum("tkgd,twkd->tkgw", qf, k)  # [T, KV, G, W]

@@ -79,3 +79,76 @@ def test_write_kv_ragged_interleave():
     # Padding slot -1 dropped; untouched slots stay zero.
     np.testing.assert_array_equal(flat[1], 0.0)
     np.testing.assert_array_equal(flat[4], 0.0)
+
+
+def test_quantized_fp8_kv_cache_close_to_full_precision():
+    """fp8 page dtype with a static kv_scale: attention output stays close
+    to the f32-cache result (the TPU kernel's k_scale/v_scale contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops.ragged_attention import ragged_attention, write_kv_ragged
+
+    T, KV, H, D, P, ps = 12, 2, 4, 16, 8, 4
+    key = jax.random.PRNGKey(3)
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (T, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (T, KV, D), jnp.float32)
+    v = jax.random.normal(ks[2], (T, KV, D), jnp.float32)
+    slots = jnp.arange(T, dtype=jnp.int32)
+    tables = jnp.arange(P, dtype=jnp.int32)[None, :].repeat(2, 0)
+    kv_lens = jnp.asarray([T, 0], jnp.int32)
+    cu = jnp.asarray([0, T, T], jnp.int32)
+    num = jnp.asarray([1], jnp.int32)
+
+    def run(dtype, kv_scale):
+        pages = jnp.zeros((P, ps, 2 * KV, D), dtype)
+        pages = write_kv_ragged(pages, k, v, slots, kv_scale=kv_scale)
+        return ragged_attention(
+            q, pages, kv_lens, tables, cu, num,
+            sm_scale=D**-0.5, impl="xla", kv_scale=kv_scale,
+        )
+
+    full = run(jnp.float32, None)
+    fp8 = run(jnp.float8_e4m3fn, 1.0)
+    np.testing.assert_allclose(
+        np.asarray(fp8)[:T], np.asarray(full)[:T], atol=0.25
+    )
+    # A non-unit scale must roundtrip too (values stored as value/scale).
+    fp8s = run(jnp.float8_e4m3fn, 0.25)
+    np.testing.assert_allclose(
+        np.asarray(fp8s)[:T], np.asarray(full)[:T], atol=0.25
+    )
+
+
+def test_engine_fp8_kv_cache_serves():
+    import asyncio
+
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.llm.protocols import PreprocessedRequest, StopConditions
+    from dynamo_tpu.runtime.engine import Context, collect
+
+    async def main():
+        engine = TpuEngine(
+            EngineConfig(
+                model="debug-tiny", block_size=4, num_blocks=64, max_batch=2,
+                max_model_len=64, prefill_chunk=32, dtype="float32",
+                cache_dtype="float8_e4m3fn", kv_scale=1.0,
+            )
+        )
+        assert engine.kv_scale == 1.0
+        req = PreprocessedRequest(
+            token_ids=[1, 2, 3, 4, 5, 6, 7, 8, 9],
+            stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
+        ).to_dict()
+        out = await collect(await engine.generate(Context(req)))
+        toks = [t for i in out for t in i["token_ids"]]
+        assert len(toks) == 6
+        # Prefix reuse still works across the quantized cache.
+        out2 = await collect(await engine.generate(Context(req)))
+        assert engine.kv.matched_blocks > 0
+        assert [t for i in out2 for t in i["token_ids"]] == toks
+        await engine.close()
+
+    asyncio.run(main())
