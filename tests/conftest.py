@@ -50,3 +50,21 @@ def cpu_devices():
     import jax
 
     return jax.devices("cpu")
+
+
+def hermetic_child_env(repo: str) -> dict:
+    """Whitelisted env for CPU-only child processes (the same rationale as
+    __graft_entry__.dryrun_multichip: any inherited var — PYTHONPATH site
+    hooks especially — can force a real TPU platform into the child)."""
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo,
+        "PYTHONUNBUFFERED": "1",
+    }
+    for keep in (
+        "PATH", "HOME", "TMPDIR", "LANG", "LC_ALL",
+        "LD_LIBRARY_PATH", "VIRTUAL_ENV",
+    ):
+        if keep in os.environ:
+            env[keep] = os.environ[keep]
+    return env

@@ -1,0 +1,72 @@
+"""The two driver-facing contracts must never regress silently:
+
+- ``bench.py`` prints exactly ONE JSON line with metric/value/unit/
+  vs_baseline (the driver records it as BENCH_r{N}.json);
+- ``__graft_entry__.entry()`` returns a jittable (fn, args) and
+  ``dryrun_multichip(n)`` compiles+executes the full sharded step on an
+  n-device mesh in a hermetic CPU subprocess.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env() -> dict:
+    from tests.conftest import hermetic_child_env
+
+    return hermetic_child_env(REPO)
+
+
+def test_bench_prints_one_json_line():
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=REPO,
+        env=_env(),
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"want exactly one stdout line, got {lines}"
+    out = json.loads(lines[0])
+    assert set(out) == {"metric", "value", "unit", "vs_baseline"}
+    assert out["value"] > 0
+
+
+def test_graft_entry_compiles():
+    code = (
+        "import __graft_entry__ as g, jax; "
+        "fn, a = g.entry(); r = jax.jit(fn)(*a); "
+        "assert r[0].shape == (4,), r[0].shape; print('entry-ok')"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO,
+        env=_env(),
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "entry-ok" in proc.stdout
+
+
+def test_dryrun_multichip_hermetic():
+    # Hostile caller environment on purpose: the child must scrub it.
+    env = _env()
+    env.update(JAX_PLATFORMS="tpu", TPU_LIBRARY_PATH="/nonexistent")
+    proc = subprocess.run(
+        [sys.executable, "-c", "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=2000,  # > dryrun's internal 2 x 900s retry budget
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "dryrun_multichip ok" in proc.stdout

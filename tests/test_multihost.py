@@ -24,14 +24,9 @@ def _free_port() -> int:
 
 
 def _child_env() -> dict:
-    # Whitelist, same rationale as __graft_entry__.dryrun_multichip: any
-    # inherited var (PYTHONPATH site hooks especially) can force a real TPU
-    # platform into what must be a CPU-only child.
-    env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
-    for keep in ("PATH", "HOME", "TMPDIR", "LANG", "LC_ALL"):
-        if keep in os.environ:
-            env[keep] = os.environ[keep]
-    return env
+    from tests.conftest import hermetic_child_env
+
+    return hermetic_child_env(REPO)
 
 
 def _spawn(role: str, coord: int, step: int) -> subprocess.Popen:
