@@ -22,16 +22,9 @@ def _free_port() -> int:
 
 
 def _env() -> dict:
-    env = {
-        "JAX_PLATFORMS": "cpu",
-        "PYTHONPATH": REPO,
-        "PYTHONUNBUFFERED": "1",
-        "DYN_LOG": "info",
-    }
-    for keep in ("PATH", "HOME", "TMPDIR", "LANG"):
-        if keep in os.environ:
-            env[keep] = os.environ[keep]
-    return env
+    from conftest import hermetic_child_env
+
+    return hermetic_child_env(REPO) | {"DYN_LOG": "info"}
 
 
 def _spawn(*args: str) -> subprocess.Popen:
@@ -120,6 +113,75 @@ def test_cli_three_process_serving():
 
         metrics = urllib.request.urlopen(f"{base}/metrics", timeout=10).read()
         assert b"requests_total" in metrics or b"http" in metrics
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=10)
+                if out:
+                    sys.stderr.write(out[-1500:])
+            except Exception:
+                pass
+
+
+def test_cli_disaggregated_serving():
+    """Hub + dedicated prefill worker + disagg decode worker + frontend as
+    four CLI processes; a long prompt (above --max-local-prefill) goes
+    through the remote-prefill path and completes."""
+    hub_port, http_port = _free_port(), _free_port()
+    engine_flags = [
+        "--model", "tiny", "--arch", "debug-tiny",
+        "--block-size", "4", "--num-blocks", "128", "--max-batch", "2",
+        "--max-model-len", "128", "--prefill-chunk", "64",
+    ]
+    procs = []
+    try:
+        procs.append(_spawn("hub", "--host", "127.0.0.1", "--port", str(hub_port)))
+        _wait_tcp(hub_port)
+        hub = f"127.0.0.1:{hub_port}"
+        procs.append(
+            _spawn("run", "in=dyn://dynamo.TpuWorker.prefill", "out=tpu",
+                   "--hub", hub, "--disagg", "prefill", *engine_flags)
+        )
+        procs.append(
+            _spawn("run", "in=dyn://dynamo.TpuWorker.generate", "out=tpu",
+                   "--hub", hub, "--disagg", "decode",
+                   "--max-local-prefill", "16", *engine_flags)
+        )
+        procs.append(
+            _spawn("http", "--hub", hub, "--host", "127.0.0.1",
+                   "--port", str(http_port))
+        )
+        base = f"http://127.0.0.1:{http_port}"
+        end = time.time() + 120
+        while time.time() < end:
+            models = _wait_http(f"{base}/v1/models")
+            if any(m["id"] == "tiny" for m in models.get("data", [])):
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("model never registered")
+
+        # 60-token prompt > max-local-prefill 16 → remote prefill path.
+        req = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps(
+                {
+                    "model": "tiny",
+                    "prompt": [((i * 7) % 250) + 1 for i in range(60)],
+                    "max_tokens": 5,
+                    "stream": False,
+                    "nvext": {"ignore_eos": True},
+                }
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=180) as r:
+            body = json.loads(r.read())
+        assert body["choices"][0]["finish_reason"] == "length"
+        assert body["usage"]["completion_tokens"] == 5
+        assert body["usage"]["prompt_tokens"] == 60
     finally:
         for p in procs:
             p.kill()

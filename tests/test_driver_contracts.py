@@ -16,7 +16,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _env() -> dict:
-    from tests.conftest import hermetic_child_env
+    from conftest import hermetic_child_env  # tests/ is on sys.path under pytest
 
     return hermetic_child_env(REPO)
 

@@ -24,7 +24,7 @@ def _free_port() -> int:
 
 
 def _child_env() -> dict:
-    from tests.conftest import hermetic_child_env
+    from conftest import hermetic_child_env  # tests/ is on sys.path under pytest
 
     return hermetic_child_env(REPO)
 
