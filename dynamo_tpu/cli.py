@@ -103,9 +103,25 @@ async def _run(args) -> None:
             )
             await follower_serve(engine, f"{leader_host}:{args.step_port}")
             return
-        publisher = await StepPublisher(
-            "0.0.0.0", args.step_port, nnodes - 1
-        ).start()
+        # Bind to the coordinator's interface, not 0.0.0.0: the step plane
+        # carries pickled frames, so exposure must stay inside the
+        # deployment's trust domain (plus DYN_STEP_TOKEN auth — multihost.py).
+        # The advertised coordinator name may not be locally bindable (VIP /
+        # NAT / port-forward); fall back to 0.0.0.0 then — auth still holds.
+        step_host = args.coordinator.rsplit(":", 1)[0] if args.coordinator else "0.0.0.0"
+        try:
+            publisher = await StepPublisher(
+                step_host, args.step_port, nnodes - 1
+            ).start()
+        except OSError:
+            print(
+                f"step plane: cannot bind {step_host}, falling back to "
+                "0.0.0.0 (firewall the port / set DYN_STEP_TOKEN)",
+                flush=True,
+            )
+            publisher = await StepPublisher(
+                "0.0.0.0", args.step_port, nnodes - 1
+            ).start()
         engine.attach_publisher(publisher)
 
     if inp == "http":

@@ -78,6 +78,10 @@ class EngineConfig:
     host_cache_bytes: int = 0
     # Seconds between offload pump cycles (device gather + async D2H).
     host_offload_interval: float = 0.05
+    # Persistent XLA compilation cache dir: None resolves DYN_XLA_CACHE_DIR
+    # (default ~/.cache/dynamo_tpu/xla); "" disables.  Makes warmup ~free on
+    # worker restart (engine/xla_cache.py; r3 cold warmup was 139.6s).
+    compilation_cache_dir: Optional[str] = None
 
     def __post_init__(self) -> None:
         if not self.batch_buckets:

@@ -71,6 +71,9 @@ class TpuEngine(AsyncEngine):
         params: Any = None,
     ):
         self.cfg = cfg
+        from .xla_cache import setup_compilation_cache
+
+        setup_compilation_cache(cfg.compilation_cache_dir)
         self.model_config: ModelConfig = get_config(cfg.model).with_overrides(
             dtype=cfg.dtype
         )
@@ -276,8 +279,20 @@ class TpuEngine(AsyncEngine):
             # Donated in-place page scatter for KV imports; padding ids are
             # out of range and dropped, so callers can bucket the page count
             # to bound recompiles.
+            dt = cache.pages.dtype
+            if jnp.issubdtype(dt, jnp.integer):
+                # Integer (quantized) pages: round-to-nearest + clip, exactly
+                # like write_kv_ragged — a plain astype truncates toward zero
+                # and wraps on overflow, so sp-prefilled blocks would differ
+                # numerically from normal-prefill blocks (ADVICE r3 medium).
+                info = jnp.iinfo(dt)
+                new_pages = jnp.clip(
+                    jnp.round(new_pages.astype(jnp.float32)),
+                    info.min,
+                    info.max,
+                )
             pages = cache.pages.at[:, page_ids].set(
-                new_pages.astype(cache.pages.dtype), mode="drop"
+                new_pages.astype(dt), mode="drop"
             )
             return PagedKVCache(pages)
 
@@ -615,6 +630,12 @@ class TpuEngine(AsyncEngine):
         """
         from ..tokens import hash_token_blocks
 
+        if jax.process_count() > 1:
+            # Sharded global pages can't be gathered from one host (same
+            # restriction as host_cache_bytes); refuse cleanly at request
+            # time so the caller falls back to local prefill instead of
+            # hanging on a non-addressable array (ADVICE r3).
+            return None
         blocks = hash_token_blocks(token_ids, self.cfg.block_size)
         ids: List[int] = []
         for tb in blocks[start_block:]:
@@ -1018,9 +1039,14 @@ class TpuEngine(AsyncEngine):
         dispatched_any = False
 
         def want_rebuild() -> bool:
+            # Waiting requests only force a rebuild when one could actually
+            # be ADMITTED (free slot + blocks).  At oversubscription the
+            # queue is never empty; gating on num_waiting alone would keep
+            # the fused pipeline permanently disabled (round-3 saturation
+            # collapse: conc 32 throughput below conc 16).
             return (
                 self._closed
-                or self.scheduler.num_waiting > 0
+                or self.scheduler.admission_ready()
                 or any(s.finished for s in members)
                 or any(
                     (c := self._contexts.get(s.request_id)) is not None
@@ -1030,8 +1056,15 @@ class TpuEngine(AsyncEngine):
             )
 
         while True:
-            # Top up the dispatch window.
-            while not rebuild and len(inflight) < cfg.pipeline_depth:
+            # Top up the dispatch window.  With requests queued, cap the
+            # in-flight depth at 2 (enough to overlap fetch with compute) so
+            # the drain a newcomer's admission must wait for stays bounded.
+            depth = (
+                min(cfg.pipeline_depth, 2)
+                if self.scheduler.num_waiting
+                else cfg.pipeline_depth
+            )
+            while not rebuild and len(inflight) < depth:
                 # Don't dispatch chunks no row can still use: once every
                 # member's in-flight frontier covers its remaining token
                 # budget, further chunks are pure waste (their tokens would
@@ -1461,6 +1494,8 @@ async def transfer_blocks_device(src: TpuEngine, dst: TpuEngine, token_ids) -> i
     Returns tokens covered (the longest resident prefix run)."""
     from ..tokens import hash_token_blocks
 
+    if jax.process_count() > 1:
+        return 0  # same single-process restriction as export_prompt_blocks
     if src.cfg.block_size != dst.cfg.block_size:
         return 0
     if src.cache.pages.shape[0] != dst.cache.pages.shape[0]:

@@ -109,6 +109,19 @@ class KvBlockManager:
             matched.append(bid)
         return matched
 
+    def would_fit(
+        self, token_blocks: Sequence[TokenBlock], num_blocks_needed: int
+    ) -> bool:
+        """Dry-run of allocate_sequence's capacity check (no side effects,
+        no counter updates).  The fused-decode admission gate polls this —
+        keeping the math here means it can never drift from real admission."""
+        matched = self.match_prefix(token_blocks)
+        fresh_needed = num_blocks_needed - len(matched)
+        # Matched blocks sitting in the reuse pool get revived and stop
+        # counting as free, so subtract them from available capacity.
+        revived = sum(1 for b in matched if self._blocks[b].ref_count == 0)
+        return fresh_needed <= self.free_blocks - revived
+
     def allocate_sequence(
         self, token_blocks: Sequence[TokenBlock], num_blocks_needed: int
     ) -> Optional[Tuple[List[int], int]]:
@@ -121,12 +134,9 @@ class KvBlockManager:
         matched = self.match_prefix(token_blocks)
         self.lookup_blocks += len(token_blocks)
         self.matched_blocks += len(matched)
-        fresh_needed = num_blocks_needed - len(matched)
-        # Matched blocks sitting in the reuse pool get revived and stop
-        # counting as free, so subtract them from available capacity.
-        revived = sum(1 for b in matched if self._blocks[b].ref_count == 0)
-        if fresh_needed > self.free_blocks - revived:
+        if not self.would_fit(token_blocks, num_blocks_needed):
             return None
+        fresh_needed = num_blocks_needed - len(matched)
         ids: List[int] = []
         for bid in matched:
             blk = self._blocks[bid]

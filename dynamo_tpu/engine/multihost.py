@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import pickle
 import struct
 from typing import Any, Optional, Tuple
@@ -28,6 +29,21 @@ from typing import Any, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct(">I")
+
+
+def _hello_frame() -> bytes:
+    """Fixed-size authentication hello: magic + SHA-256 of DYN_STEP_TOKEN.
+
+    Frames after the hello are pickled, so an attacker reaching the port
+    would get code execution on the leader — the port must be firewalled to
+    the deployment's trust domain, and setting DYN_STEP_TOKEN on every node
+    additionally rejects unauthenticated connections at accept time
+    (ADVICE r3).  The hello itself is a raw-bytes compare: nothing from an
+    unauthenticated peer is ever unpickled."""
+    import hashlib
+
+    token = os.environ.get("DYN_STEP_TOKEN", "")
+    return b"DYNSTEP1" + hashlib.sha256(token.encode()).digest()
 
 
 async def _send(writer: asyncio.StreamWriter, obj: Any) -> None:
@@ -55,7 +71,25 @@ class StepPublisher:
         self._connected = asyncio.Event()
 
     async def start(self, timeout: float = 120.0) -> "StepPublisher":
+        expect = _hello_frame()
+
         async def on_conn(reader, writer):
+            # The hello is a FIXED-SIZE raw-bytes compare, checked before
+            # anything from this peer is unpickled; a wrong/missing token is
+            # dropped before it ever counts toward the follower quorum.
+            import hmac
+
+            try:
+                hello = await asyncio.wait_for(
+                    reader.readexactly(len(expect)), 30.0
+                )
+            except Exception:
+                writer.close()
+                return
+            if not hmac.compare_digest(hello, expect):
+                logger.warning("step plane: rejecting unauthenticated peer")
+                writer.close()
+                return
             self._writers.append((reader, writer))
             logger.info(
                 "step follower %d/%d connected",
@@ -114,6 +148,8 @@ async def follower_serve(
             if asyncio.get_event_loop().time() > deadline:
                 raise
             await asyncio.sleep(retry_s)
+    writer.write(_hello_frame())
+    await writer.drain()
     logger.info("connected to step leader %s", leader)
     try:
         while True:

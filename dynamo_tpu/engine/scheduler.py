@@ -200,9 +200,17 @@ class Scheduler:
                 items.append((seq, seq.num_computed, chunk))
                 budget -= chunk
 
-        # Admit newcomers while slots + blocks + budget allow.
+        # Admit newcomers while slots + blocks + budget allow.  Track
+        # whether the waiting head is BLOCKED (slots/blocks full): waiting
+        # requests that cannot land must not hold the fused decode pipeline
+        # off — that inverts throughput exactly at saturation (conc 32 below
+        # conc 16 in round 3), when the queue is never empty.
+        admission_blocked = (
+            bool(self.waiting) and len(self.running) >= self.cfg.max_batch
+        )
         while budget > 0 and self.waiting and len(items) < self.cfg.max_batch:
             if len(self.running) >= self.cfg.max_batch:
+                admission_blocked = True
                 break
             seq = self.waiting[0]
             if not self._try_admit(seq):
@@ -212,6 +220,7 @@ class Scheduler:
                     self.waiting.popleft()
                     self.rejected.append(seq)
                     continue
+                admission_blocked = True
                 break
             self.waiting.popleft()
             self.running.append(seq)
@@ -224,11 +233,29 @@ class Scheduler:
         if not items:
             return None
         pure = (
-            not self.waiting
+            (not self.waiting or admission_blocked)
             and all(n == 1 for _, _, n in items)
             and not any(s.in_prefill for s in self.running)
         )
         return StepPlan(items, pure_decode=pure)
+
+    def admission_ready(self) -> bool:
+        """Non-destructive check: would the waiting head admit right now?
+        The fused decode pipeline polls this between chunks — it keeps
+        fusing while admission is impossible (slots/blocks full) and drains
+        for a rebuild the moment a newcomer could actually land."""
+        if not self.waiting:
+            return False
+        if len(self.running) >= self.cfg.max_batch:
+            return False
+        seq = self.waiting[0]
+        prompt_blocks = (len(seq.prompt) + self.cfg.block_size) // self.cfg.block_size
+        if prompt_blocks <= self.kv.free_blocks:
+            return True  # fits even with zero prefix hits: skip the hashing
+        from ..tokens import hash_token_blocks
+
+        blocks = hash_token_blocks(seq.prompt, self.cfg.block_size)
+        return self.kv.would_fit(blocks, prompt_blocks)
 
     def _try_admit(self, seq: SequenceState) -> bool:
         """Allocate prompt blocks (sharing any cached prefix)."""

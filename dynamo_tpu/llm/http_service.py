@@ -164,6 +164,11 @@ class HttpService:
         ctx = Context(body)
         try:
             stream = await engine.generate(ctx)
+        except ValueError as e:
+            # Request-shape errors (bad sampling params, oversize prompt)
+            # are the client's fault: 400, not 500.
+            guard.finish(Status.REJECTED)
+            return _error_response(400, str(e))
         except Exception as e:  # noqa: BLE001 — edge boundary
             guard.finish(Status.ERROR)
             logger.exception("engine rejected request")

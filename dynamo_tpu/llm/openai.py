@@ -92,6 +92,11 @@ class ChatCompletionRequest(CommonFields):
 
     def sampling_options(self) -> SamplingOptions:
         opts = super().sampling_options()
+        if self.top_logprobs is not None and not 0 <= self.top_logprobs <= 20:
+            # OpenAI's documented range; the sampler computes exactly this
+            # many alternatives (ops/sampling.py TOPK_LOGPROBS), so anything
+            # larger must be rejected, not silently clamped.
+            raise ValueError("top_logprobs must be between 0 and 20")
         if self.logprobs:
             opts.logprobs = self.top_logprobs or 0
         return opts
@@ -106,6 +111,8 @@ class CompletionRequest(CommonFields):
     def sampling_options(self) -> SamplingOptions:
         opts = super().sampling_options()
         if self.logprobs is not None:
+            if not 0 <= self.logprobs <= 20:
+                raise ValueError("logprobs must be between 0 and 20")
             opts.logprobs = self.logprobs
         return opts
 

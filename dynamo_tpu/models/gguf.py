@@ -267,8 +267,13 @@ def load_params_gguf(config, path: str, dtype: Any = None) -> Dict[str, Any]:
     if "embed" not in params:
         raise ValueError("gguf missing token_embd.weight")
     if "lm_head" not in params and not config.tie_word_embeddings:
-        # llama.cpp omits output.weight for tied embeddings.
-        pass
+        # llama.cpp only omits output.weight for TIED embeddings; an untied
+        # checkpoint without it would silently fall back to embed.T in
+        # forward and produce wrong logits (ADVICE r3).
+        raise ValueError(
+            "gguf missing output.weight but config is not tied "
+            "(tie_word_embeddings=False)"
+        )
     return params
 
 

@@ -30,7 +30,10 @@ import jax.numpy as jnp
 from jax import lax
 
 NEG_INF = -1e30
-TOPK_LOGPROBS = 8  # top-k logprobs returned when logprobs are requested
+# Top-k logprobs returned when logprobs are requested.  20 is the OpenAI
+# API's documented top_logprobs maximum (the edge rejects anything larger),
+# so no valid request is ever silently clamped (ADVICE r3).
+TOPK_LOGPROBS = 20
 
 
 class SampleOut(NamedTuple):
