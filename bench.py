@@ -49,10 +49,10 @@ def _engine_config():
     layers = int(os.environ.get("BENCH_LAYERS", "0"))
     isl = int(os.environ.get("BENCH_ISL", "128"))
     osl = int(os.environ.get("BENCH_OSL", "64"))
-    # Decode is weights-bound, so tok/s scales nearly linearly with batch
-    # until KV gathers bite: 64 rows measured fastest (round-4 scaling
-    # table in benchmarks/RESULTS.md).
-    max_batch = int(os.environ.get("BENCH_MAX_BATCH", "64"))
+    # Decode is weights-bound, so tok/s scales nearly linearly with batch:
+    # measured 988/1710/3119/4717/6705 tok/s at 16/32/64/128/256 rows (512
+    # OOMs at 18 layers) — round-4 scaling table in benchmarks/RESULTS.md.
+    max_batch = int(os.environ.get("BENCH_MAX_BATCH", "256"))
     max_model_len = max(256, 1 << (isl + osl + 16 - 1).bit_length())
     cfg = EngineConfig(
         model=model,
@@ -220,7 +220,7 @@ def main() -> None:
 
     tps = asyncio.run(bench())
     # vs_baseline tracks the trend against the round-3 headline (1002.88
-    # tok/s, BENCH_r03.json).  r3 ran max_batch=16 and this default runs 64;
+    # tok/s, BENCH_r03.json).  r3 ran max_batch=16 and this default runs 256;
     # that config change IS part of the round-4 improvement being claimed
     # (VERDICT r3 #3: "headline from the best batch") — same external
     # workload (isl/osl per request), faster engine configuration.  Any

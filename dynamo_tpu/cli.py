@@ -108,15 +108,20 @@ async def _run(args) -> None:
         # deployment's trust domain (plus DYN_STEP_TOKEN auth — multihost.py).
         # The advertised coordinator name may not be locally bindable (VIP /
         # NAT / port-forward); fall back to 0.0.0.0 then — auth still holds.
+        # OSError: the name isn't locally bindable (VIP/NAT).  TimeoutError:
+        # it bound, but to an interface followers can't reach (e.g. a
+        # 127.0.1.1 /etc/hosts alias) — followers keep retrying for 120s
+        # (follower_serve), so the 0.0.0.0 retry still catches them.
         step_host = args.coordinator.rsplit(":", 1)[0] if args.coordinator else "0.0.0.0"
+        first = StepPublisher(step_host, args.step_port, nnodes - 1)
         try:
-            publisher = await StepPublisher(
-                step_host, args.step_port, nnodes - 1
-            ).start()
-        except OSError:
+            publisher = await first.start(timeout=60.0)
+        except (OSError, asyncio.TimeoutError):
+            await first.close()  # release the port before rebinding
             print(
-                f"step plane: cannot bind {step_host}, falling back to "
-                "0.0.0.0 (firewall the port / set DYN_STEP_TOKEN)",
+                f"step plane: cannot serve followers on {step_host}, "
+                "falling back to 0.0.0.0 (firewall the port / set "
+                "DYN_STEP_TOKEN)",
                 flush=True,
             )
             publisher = await StepPublisher(

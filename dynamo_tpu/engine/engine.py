@@ -437,10 +437,11 @@ class TpuEngine(AsyncEngine):
         return out
 
     def reachable_token_buckets(self) -> List[int]:
-        """Every token bucket the scheduler can hand _run_unified: decode
-        rows and prefill chunks share one prefill_chunk budget, so totals
-        range 1..max(prefill_chunk, max_batch)."""
-        hi = self.cfg.bucket_tokens(max(self.cfg.prefill_chunk, self.cfg.max_batch))
+        """Every token bucket the scheduler can hand _run_unified: up to
+        max_batch decode rows ride alongside up to prefill_chunk prompt
+        tokens in one step (decode rows don't consume the prefill budget),
+        so totals range 1..prefill_chunk + max_batch."""
+        hi = self.cfg.bucket_tokens(self.cfg.prefill_chunk + self.cfg.max_batch)
         buckets, b = [], self.cfg.bucket_tokens(1)
         while b < hi:
             buckets.append(b)

@@ -110,12 +110,18 @@ class KvBlockManager:
         return matched
 
     def would_fit(
-        self, token_blocks: Sequence[TokenBlock], num_blocks_needed: int
+        self,
+        token_blocks: Sequence[TokenBlock],
+        num_blocks_needed: int,
+        matched: Optional[List[int]] = None,
     ) -> bool:
         """Dry-run of allocate_sequence's capacity check (no side effects,
         no counter updates).  The fused-decode admission gate polls this —
-        keeping the math here means it can never drift from real admission."""
-        matched = self.match_prefix(token_blocks)
+        keeping the math here means it can never drift from real admission.
+        ``matched`` lets a caller that already ran match_prefix skip the
+        second walk."""
+        if matched is None:
+            matched = self.match_prefix(token_blocks)
         fresh_needed = num_blocks_needed - len(matched)
         # Matched blocks sitting in the reuse pool get revived and stop
         # counting as free, so subtract them from available capacity.
@@ -134,7 +140,7 @@ class KvBlockManager:
         matched = self.match_prefix(token_blocks)
         self.lookup_blocks += len(token_blocks)
         self.matched_blocks += len(matched)
-        if not self.would_fit(token_blocks, num_blocks_needed):
+        if not self.would_fit(token_blocks, num_blocks_needed, matched):
             return None
         fresh_needed = num_blocks_needed - len(matched)
         ids: List[int] = []

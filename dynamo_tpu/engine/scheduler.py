@@ -189,7 +189,11 @@ class Scheduler:
                 continue
             items.append((seq, seq.num_computed, 1))
             scheduled.add(id(seq))
-            budget -= 1
+            # Decode rows do NOT consume the prefill budget: the unified
+            # step is sized for prefill_chunk + max_batch tokens
+            # (config.max_step_tokens), so a full decode batch must never
+            # starve prompt chunks — with max_batch > prefill_chunk it
+            # would permanently block admission at saturation.
 
         # Prefill continuations (chunked prefill of already-running prompts).
         for seq in self.running:

@@ -38,10 +38,20 @@ def setup_compilation_cache(path: Optional[str] = None) -> Optional[str]:
         )
     if not path:
         return None
-    if _configured == path:
-        return path
     import jax
 
+    backend = jax.default_backend()
+    if backend == "cpu" and not os.environ.get("DYN_XLA_CACHE_DIR"):
+        # XLA:CPU AOT cache entries embed the compile machine's CPU feature
+        # set and can fail (or SIGILL) when loaded under a different feature
+        # detection — observed between the serving process and hermetic
+        # child processes on the SAME host.  CPU compiles are cheap; the
+        # restart-warmup win this cache exists for is the accelerator path.
+        # Explicitly setting DYN_XLA_CACHE_DIR opts CPU back in.
+        return None
+    path = os.path.join(path, backend)  # one cache per backend
+    if _configured == path:
+        return path
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)

@@ -166,8 +166,11 @@ class HttpService:
             stream = await engine.generate(ctx)
         except ValueError as e:
             # Request-shape errors (bad sampling params, oversize prompt)
-            # are the client's fault: 400, not 500.
+            # are the client's fault: 400, not 500.  Logged with traceback:
+            # an internal ValueError misclassified here must still be
+            # visible server-side.
             guard.finish(Status.REJECTED)
+            logger.warning("request rejected: %s", e, exc_info=True)
             return _error_response(400, str(e))
         except Exception as e:  # noqa: BLE001 — edge boundary
             guard.finish(Status.ERROR)

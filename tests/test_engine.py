@@ -382,3 +382,64 @@ def test_engine_fused_decode_engages_at_oversubscription():
         await engine2.close()
 
     asyncio.run(main())
+
+
+def test_scheduler_decode_rows_do_not_consume_prefill_budget():
+    """Review r4: with max_batch > prefill_chunk, a full decode batch must
+    neither disable pure_decode nor starve admission — decode rows ride the
+    unified step's own capacity (max_step_tokens = prefill_chunk +
+    max_batch), they don't spend the prompt-chunk budget."""
+    from dynamo_tpu.engine.scheduler import Scheduler, SequenceState
+    from dynamo_tpu.tokens import TokenBlockSequence
+
+    cfg = EngineConfig(
+        model="debug-tiny",
+        block_size=4,
+        num_blocks=256,
+        max_batch=8,
+        max_model_len=64,
+        prefill_chunk=4,  # smaller than max_batch
+        dtype="float32",
+    )
+    kv = KvBlockManager(256, 4)
+    sched = Scheduler(cfg, kv)
+
+    def mk(rid):
+        seq = SequenceState(
+            request_id=rid,
+            prompt=[1, 2, 3, 4],
+            block_seq=TokenBlockSequence(block_size=4),
+            num_computed=4,
+        )
+        seq.output = [42]
+        seq.block_ids = [kv.allocate_block(), kv.allocate_block()]
+        return seq
+
+    # 6 decoding rows (> prefill_chunk), 2 slots free, 1 waiting.
+    sched.running = [mk(f"r{i}") for i in range(6)]
+    waiter = SequenceState(
+        request_id="w",
+        prompt=[9, 9, 9],
+        block_seq=TokenBlockSequence(block_size=4),
+    )
+    sched.add(waiter)
+
+    plan = sched.schedule()
+    # The newcomer must be admitted (slot + blocks free) with a prompt
+    # chunk in the plan, alongside all 6 decode rows.
+    assert waiter in sched.running
+    kinds = sorted(n for _, _, n in plan.items)
+    assert kinds == [1, 1, 1, 1, 1, 1, 3]
+    assert not plan.pure_decode
+
+    # With all slots decoding and one waiting, the batch must stay fused.
+    sched.waiting.clear()
+    sched.running = [mk(f"s{i}") for i in range(8)]
+    sched.add(waiter2 := SequenceState(
+        request_id="w2",
+        prompt=[7, 7, 7],
+        block_seq=TokenBlockSequence(block_size=4),
+    ))
+    plan2 = sched.schedule()
+    assert plan2.pure_decode
+    assert waiter2 in sched.waiting
