@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
 import signal
 import sys
 from typing import Optional
@@ -49,10 +50,18 @@ def _tokenizer_spec(args) -> dict:
     if tok:
         if tok.endswith(".gguf"):
             return {"kind": "gguf", "file": tok}
+        if os.path.isdir(tok):
+            return {"kind": "hf", "dir": tok}
         return {"kind": "hf", "file": tok}
     ckpt = getattr(args, "checkpoint", None)
-    if ckpt and ckpt.endswith(".gguf"):
-        return {"kind": "gguf", "file": ckpt}  # embedded tokenizer
+    if ckpt:
+        # build_tpu_engine resolved the checkpoint spec to a local path;
+        # serve its own tokenizer + chat template when it ships one.
+        from .models.hub import tokenizer_spec
+
+        spec = tokenizer_spec(ckpt)
+        if spec is not None:
+            return spec
     return {"kind": "byte"}
 
 
@@ -117,7 +126,10 @@ async def _run(args) -> None:
         try:
             publisher = await first.start(timeout=60.0)
         except (OSError, asyncio.TimeoutError):
-            await first.close()  # release the port before rebinding
+            # abort, not close: a 'close' broadcast would make any
+            # already-connected follower exit permanently instead of
+            # reconnecting to the rebound publisher.
+            await first.abort()
             print(
                 f"step plane: cannot serve followers on {step_host}, "
                 "falling back to 0.0.0.0 (firewall the port / set "
@@ -371,6 +383,18 @@ def main(argv: Optional[list] = None) -> None:
     p_run.add_argument("--max-batch", type=int, default=8, dest="max_batch")
     p_run.add_argument("--max-model-len", type=int, default=1024, dest="max_model_len")
     p_run.add_argument("--prefill-chunk", type=int, default=512, dest="prefill_chunk")
+    p_run.add_argument(
+        "--dtype", default="bfloat16",
+        help="weight/activation dtype (bfloat16 on TPU; float32 for CPU runs)",
+    )
+    p_run.add_argument(
+        "--decode-steps", type=int, default=4, dest="decode_steps",
+        help="decode iterations fused into one device dispatch",
+    )
+    p_run.add_argument(
+        "--pipeline-depth", type=int, default=2, dest="pipeline_depth",
+        help="fused decode dispatches kept in flight",
+    )
     p_run.add_argument(
         "--kv-cache-dtype", default=None, dest="cache_dtype",
         help="KV page dtype (e.g. float8_e4m3fn halves KV memory)",

@@ -15,6 +15,26 @@ def build_tpu_engine(args):
     arch = getattr(args, "arch", None)
     checkpoint = getattr(args, "checkpoint", None)
     model_config_path = getattr(args, "model_config", None)
+    if checkpoint:
+        # Resolve BEFORE anything else, like the reference's dynamo-run
+        # (launch/dynamo-run/src/lib.rs:125-130): local dirs pass through,
+        # names/repo-ids acquire via models/hub.py (HF snapshot or the
+        # pre-staged offline cache).
+        from ..models.hub import resolve_model
+
+        checkpoint = resolve_model(checkpoint)
+        args.checkpoint = checkpoint  # tokenizer discovery reads it too
+    if (
+        checkpoint
+        and not arch
+        and not checkpoint.endswith(".gguf")
+        and not model_config_path
+    ):
+        # The checkpoint's own config.json is the architecture source of
+        # truth (reference: MDC from checkpoint metadata).
+        from ..models.config import ModelConfig, register_config
+
+        arch = register_config(ModelConfig.from_local_path(checkpoint)).name
     if checkpoint and checkpoint.endswith(".gguf") and not arch:
         # GGUF carries its own architecture metadata (reference: the
         # ModelDeploymentCard's gguf path, lib/llm/src/gguf/*).
@@ -45,6 +65,9 @@ def build_tpu_engine(args):
         ep=getattr(args, "ep", 1),
         sp=getattr(args, "sp", 1),
         sp_prefill_min=getattr(args, "sp_prefill_min", 1024),
+        dtype=getattr(args, "dtype", "bfloat16"),
+        decode_steps=getattr(args, "decode_steps", 4),
+        pipeline_depth=getattr(args, "pipeline_depth", 2),
         cache_dtype=getattr(args, "cache_dtype", None),
         kv_scale=getattr(args, "kv_scale", 1.0),
         checkpoint_path=getattr(args, "checkpoint", None),

@@ -121,11 +121,21 @@ class StepPublisher:
             await self.publish("close")
         except Exception:
             pass
+        await self.abort()
+
+    async def abort(self) -> None:
+        """Tear down WITHOUT the 'close' broadcast: connections just drop.
+        Used when the leader rebinds (cli step-plane fallback) — a follower
+        that received no step yet treats the drop as transient and
+        reconnects (follower_serve), whereas a 'close' frame would make it
+        exit for good and the rebound publisher could never reach quorum."""
         for _, writer in self._writers:
             writer.close()
+        self._writers.clear()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+            self._server = None
 
 
 async def follower_serve(
@@ -140,22 +150,36 @@ async def follower_serve(
     """
     host, port = leader.rsplit(":", 1)
     deadline = asyncio.get_event_loop().time() + timeout
-    while True:
+    while True:  # outer: reconnect while no step has been replayed yet
+        while True:
+            try:
+                reader, writer = await asyncio.open_connection(host, int(port))
+                break
+            except OSError:
+                if asyncio.get_event_loop().time() > deadline:
+                    raise
+                await asyncio.sleep(retry_s)
+        writer.write(_hello_frame())
+        await writer.drain()
+        logger.info("connected to step leader %s", leader)
+        replayed = 0
         try:
-            reader, writer = await asyncio.open_connection(host, int(port))
-            break
-        except OSError:
+            while True:
+                kind, payload = await _recv(reader)
+                if kind == "close":
+                    return
+                await engine.mirror_step(kind, payload)
+                replayed += 1
+        except (asyncio.IncompleteReadError, ConnectionError):
+            if replayed:
+                # Mid-stream loss after state was applied: resuming on a
+                # new connection would diverge from SPMD lockstep — fatal.
+                raise
             if asyncio.get_event_loop().time() > deadline:
                 raise
+            # Dropped before any dispatch (e.g. the leader rebound its
+            # step plane to another interface): safe to reconnect.
+            logger.info("step leader dropped pre-stream; reconnecting")
             await asyncio.sleep(retry_s)
-    writer.write(_hello_frame())
-    await writer.drain()
-    logger.info("connected to step leader %s", leader)
-    try:
-        while True:
-            kind, payload = await _recv(reader)
-            if kind == "close":
-                return
-            await engine.mirror_step(kind, payload)
-    finally:
-        writer.close()
+        finally:
+            writer.close()

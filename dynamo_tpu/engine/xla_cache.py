@@ -29,6 +29,9 @@ def setup_compilation_cache(path: Optional[str] = None) -> Optional[str]:
     dir; an empty string disables.  Returns the active cache dir or None.
     """
     global _configured
+    # An explicit path — argument or env var — is an opt-in that overrides
+    # the CPU-backend default-off below.
+    explicit = path is not None or bool(os.environ.get("DYN_XLA_CACHE_DIR"))
     if path is None:
         path = os.environ.get(
             "DYN_XLA_CACHE_DIR",
@@ -41,7 +44,7 @@ def setup_compilation_cache(path: Optional[str] = None) -> Optional[str]:
     import jax
 
     backend = jax.default_backend()
-    if backend == "cpu" and not os.environ.get("DYN_XLA_CACHE_DIR"):
+    if backend == "cpu" and not explicit:
         # XLA:CPU AOT cache entries embed the compile machine's CPU feature
         # set and can fail (or SIGILL) when loaded under a different feature
         # detection — observed between the serving process and hermetic

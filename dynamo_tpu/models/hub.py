@@ -1,0 +1,109 @@
+"""Checkpoint acquisition: model name → local directory.
+
+Reference behavior: ``dynamo-run`` resolves its model argument before
+anything else — an existing path is used as-is, anything else is treated as
+a HuggingFace repo id and snapshot-downloaded into the local cache
+(/root/reference/launch/dynamo-run/src/lib.rs:125-130,
+/root/reference/lib/llm/src/hub.rs).  This module is the TPU build's
+equivalent, shared by the CLI (`--arch`/`--checkpoint`), the engine
+(EngineConfig.checkpoint_path), and the model card builder.
+
+Resolution order for ``resolve_model(spec)``:
+  1. an existing local directory (or .gguf file) → returned unchanged;
+  2. a known alias (e.g. the north-star ``deepseek-r1-distill-llama-8b``)
+     → its HF repo id;
+  3. a HF repo id → ``huggingface_hub.snapshot_download`` of just the
+     serving artifacts (safetensors + tokenizer + configs), honoring
+     HF_HOME / DYN_MODEL_CACHE; offline environments get a clear error
+     naming the directory to pre-stage instead of a hang.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+# North-star + convenience aliases → HF repo ids (BASELINE.md workloads).
+ALIASES = {
+    "deepseek-r1-distill-llama-8b": "deepseek-ai/DeepSeek-R1-Distill-Llama-8B",
+    "deepseek-r1-distill-llama-70b": "deepseek-ai/DeepSeek-R1-Distill-Llama-70B",
+    "llama-3.1-8b-instruct": "meta-llama/Llama-3.1-8B-Instruct",
+    "llama-3.1-70b-instruct": "meta-llama/Llama-3.1-70B-Instruct",
+    "mixtral-8x7b-instruct": "mistralai/Mixtral-8x7B-Instruct-v0.1",
+}
+
+# Only the artifacts serving needs: weights, tokenizer, configs.  Skips
+# original/consolidated torch shards, README blobs, etc.
+_PATTERNS = [
+    "*.safetensors",
+    "*.safetensors.index.json",
+    "config.json",
+    "generation_config.json",
+    "tokenizer.json",
+    "tokenizer_config.json",
+    "special_tokens_map.json",
+]
+
+
+def cache_dir() -> str:
+    return os.environ.get(
+        "DYN_MODEL_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "dynamo_tpu", "models"),
+    )
+
+
+def resolve_model(spec: str, revision: Optional[str] = None) -> str:
+    """Resolve a model spec to a local checkpoint directory (see module
+    docstring).  Raises FileNotFoundError with remediation guidance when the
+    spec is remote and the environment cannot download."""
+    if os.path.isdir(spec) or spec.endswith(".gguf"):
+        return spec
+    repo = ALIASES.get(spec.lower(), spec)
+    # A pre-staged copy under the cache dir wins (offline deployments stage
+    # checkpoints here, or point DYN_MODEL_CACHE at a shared volume).
+    staged = os.path.join(cache_dir(), repo.replace("/", "--"))
+    if os.path.isdir(staged) and os.path.exists(
+        os.path.join(staged, "config.json")
+    ):
+        return staged
+    if "/" not in repo:
+        raise FileNotFoundError(
+            f"model {spec!r} is neither a local directory, a known alias, "
+            f"nor a HF repo id (org/name); known aliases: {sorted(ALIASES)}"
+        )
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as e:  # pragma: no cover - hub is in the image
+        raise FileNotFoundError(
+            f"model {spec!r} needs huggingface_hub to download; pre-stage "
+            f"the checkpoint at {staged} instead"
+        ) from e
+    logger.info("downloading %s (revision=%s)", repo, revision or "main")
+    try:
+        # No explicit cache_dir: huggingface_hub already resolves HF_HOME /
+        # HF_HUB_CACHE to the standard $HF_HOME/hub layout, so an existing
+        # cached snapshot (pulled by transformers or hf CLI) is reused.
+        return snapshot_download(
+            repo_id=repo,
+            revision=revision,
+            allow_patterns=_PATTERNS,
+        )
+    except Exception as e:
+        raise FileNotFoundError(
+            f"could not download {repo!r} ({type(e).__name__}: {e}); in an "
+            f"offline deployment pre-stage the serving artifacts "
+            f"({', '.join(_PATTERNS)}) at {staged}"
+        ) from e
+
+
+def tokenizer_spec(path: str) -> Optional[dict]:
+    """Tokenizer spec dict (llm/discovery.make_tokenizer input) for a
+    resolved checkpoint directory, or None if it ships no tokenizer."""
+    if path.endswith(".gguf"):
+        return {"kind": "gguf", "file": path}
+    if os.path.exists(os.path.join(path, "tokenizer.json")):
+        return {"kind": "hf", "dir": path}
+    return None

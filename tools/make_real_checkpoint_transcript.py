@@ -1,0 +1,98 @@
+import os, sys, asyncio, json
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, "/root/repo"); sys.path.insert(0, "/root/repo/tests")
+from test_real_checkpoint import build_checkpoint, reference_greedy, CHAT_TEMPLATE
+
+async def main():
+    from argparse import Namespace
+    from aiohttp import ClientSession
+    from dynamo_tpu.engine import build_tpu_engine
+    from dynamo_tpu.llm.backend import Backend
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.tokenizer import HFTokenizer
+    from dynamo_tpu.runtime.pipeline import build_pipeline
+
+    path = "/tmp/golden_ckpt/model"
+    build_checkpoint(path)
+    args = Namespace(arch=None, checkpoint=path, model_config=None,
+                     block_size=4, num_blocks=128, max_batch=2,
+                     max_model_len=256, prefill_chunk=16, decode_steps=4,
+                     pipeline_depth=2, dtype="float32")
+    engine = build_tpu_engine(args)
+    tok = HFTokenizer.from_pretrained_dir(path)
+    pipeline = build_pipeline([OpenAIPreprocessor(tok, "golden"), Backend(tok)], engine)
+    svc = HttpService(host="127.0.0.1", port=0)
+    svc.models.add_chat_model("golden", pipeline)
+    await svc.start()
+    req = {"model": "golden",
+           "messages": [{"role": "user", "content": "hello world the sky is"}],
+           "temperature": 0.0, "max_tokens": 8, "nvext": {"ignore_eos": True}}
+    async with ClientSession() as s:
+        r = await s.post(f"http://127.0.0.1:{svc.port}/v1/chat/completions", json=req)
+        body = await r.json()
+    prompt_ids = tok.encode("<|user|> hello world the sky is <|assistant|>")
+    golden = reference_greedy(path, prompt_ids, 8)
+    files = sorted(os.listdir(path))
+    await svc.close(); await engine.close()
+
+    md = f"""# Transcript: real-checkpoint serving (CPU, golden-token run)
+
+Captured by `python tools/make_real_checkpoint_transcript.py` on the CI
+(CPU) backend.  The checkpoint is a complete HF-format model directory
+built on disk; the flow below is byte-for-byte what
+`tests/test_real_checkpoint.py` asserts on every run.
+
+The benchmark environment has no network egress, so the north-star
+DeepSeek-R1-Distill-Llama-8B cannot be downloaded here; `models/hub.py`
+performs the HF snapshot download in connected deployments
+(reference parity: launch/dynamo-run/src/lib.rs:125-130) and this
+transcript proves the identical post-resolution path — config-from-
+checkpoint, safetensors load, checkpoint tokenizer + chat template,
+paged engine, OpenAI edge — with golden-token verification against an
+independent dense forward.
+
+## Checkpoint directory
+
+```
+{chr(10).join(files)}
+```
+
+## Request
+
+```json
+{json.dumps(req, indent=2)}
+```
+
+## Chat template applied by the preprocessor
+
+```
+{CHAT_TEMPLATE}
+→ "<|user|> hello world the sky is <|assistant|>"
+→ token ids {prompt_ids}
+```
+
+## Response
+
+```json
+{json.dumps(body, indent=2)}
+```
+
+## Golden check
+
+Independent dense-attention greedy decode of the same safetensors
+(no engine code, `tests/test_real_checkpoint.py::reference_greedy`):
+
+```
+golden token ids: {golden}
+decoded:          {tok.decode(golden)!r}
+served content:   {body["choices"][0]["message"]["content"]!r}
+MATCH: {tok.decode(golden) == body["choices"][0]["message"]["content"]}
+```
+"""
+    os.makedirs("/root/repo/docs/transcripts", exist_ok=True)
+    with open("/root/repo/docs/transcripts/real_checkpoint.md", "w") as f:
+        f.write(md)
+    print("MATCH:", tok.decode(golden) == body["choices"][0]["message"]["content"])
+
+asyncio.run(main())
