@@ -82,6 +82,14 @@ class EngineConfig:
     # (default ~/.cache/dynamo_tpu/xla); "" disables.  Makes warmup ~free on
     # worker restart (engine/xla_cache.py; r3 cold warmup was 139.6s).
     compilation_cache_dir: Optional[str] = None
+    # Mixed-phase cadence: while prompts are prefilling, decode rows are
+    # excluded from the (fetch-free) prefill steps and advance via a fused
+    # decode_steps burst once every this many prefill chunks — balancing
+    # prefill throughput against decode stall (engine.py _run_loop).
+    # Swept on the tunneled v5e at ISL3000/OSL150 conc 16: K=4 → 183,
+    # K=8 → 266 (ITL p99 0.84s), K=12 → 266, K=16 → 279 (ITL p99 1.1s)
+    # tok/s; 8 takes the best latency at ~peak throughput.
+    prefill_chunks_per_burst: int = 8
 
     def __post_init__(self) -> None:
         if not self.batch_buckets:

@@ -122,6 +122,9 @@ class TpuEngine(AsyncEngine):
         # Per-dispatch trace: (kind, wall_s, rows, device_tokens); the
         # pipeline records dispatch and fetch separately since they overlap.
         self.step_trace: List[Tuple[str, float, int, int]] = []
+        # Mixed-phase cadence: prefill chunks run since the last decode
+        # burst (see _run_loop).
+        self._chunks_since_burst = 0
 
         # --- device state -------------------------------------------------
         mesh_cfg = MeshConfig(dp=cfg.dp, tp=cfg.tp, ep=cfg.ep, sp=cfg.sp)
@@ -821,6 +824,39 @@ class TpuEngine(AsyncEngine):
                     did_work = await self._decode_pipeline(
                         [seq for seq, _, _ in plan.items]
                     )
+                if not did_work and self.cfg.decode_steps > 1:
+                    # Mixed phase (prefill + decode in one plan): running
+                    # decode rows inside the unified step gives them ONE
+                    # token per dispatch+fetch round trip — with prefill
+                    # almost always active under continuous arrivals, that
+                    # made conc 16 SLOWER than conc 8 (r4 ladder).  Instead:
+                    # fetch-free prefill-only steps at device rate, and
+                    # every cfg.prefill_chunks_per_burst of them one fused
+                    # burst advancing every decode row decode_steps tokens
+                    # for a single round trip.  (Bursting after EVERY chunk
+                    # was tried first and throttled prefill ~3x: 8 requests'
+                    # first wave alone is ~47 chunks.)
+                    decode_items = [
+                        it for it in plan.items if it[1] >= len(it[0].prompt)
+                    ]
+                    prefill_items = [
+                        it for it in plan.items if it[1] < len(it[0].prompt)
+                    ]
+                    if decode_items and prefill_items:
+                        await self._run_unified(StepPlan(prefill_items))
+                        self._chunks_since_burst += 1
+                        if (
+                            self._chunks_since_burst
+                            >= self.cfg.prefill_chunks_per_burst
+                        ):
+                            self._chunks_since_burst = 0
+                            if not await self._decode_burst(
+                                [s for s, _, _ in decode_items]
+                            ):
+                                # No KV headroom for a whole burst: the
+                                # 1-token slots are already allocated.
+                                await self._run_unified(StepPlan(decode_items))
+                        did_work = True
                 if not did_work:
                     # Not enough KV headroom for a fused window (or not a
                     # pure-decode state): single unified step still advances
@@ -1223,6 +1259,105 @@ class TpuEngine(AsyncEngine):
         for seq in finished_members:
             self.scheduler.remove(seq)
         return dispatched_any
+
+    async def _decode_burst(self, members: List[SequenceState]) -> bool:
+        """ONE fused multi-step dispatch for ``members`` (all decoding):
+        decode_steps tokens per row for a single device round trip, used in
+        mixed phases where prefill rows keep the full pipeline from
+        engaging.  Same discard semantics as the pipeline: tokens past a
+        row's stop/limit are dropped host-side.  Returns False (dispatching
+        nothing) when KV headroom for a full burst is missing."""
+        cfg = self.cfg
+        bs = cfg.block_size
+        S, T = cfg.max_batch, cfg.decode_steps
+        n = len(members)
+        tok0 = np.zeros((S,), np.int32)
+        pos0 = np.full((S,), -1, np.int32)
+        tables = np.zeros((S, cfg.max_blocks_per_seq), np.int32)
+        limits = np.zeros((S,), np.int32)
+        for i, seq in enumerate(members):
+            if seq.finished:
+                return False  # membership changed under us: replan
+            if not self.scheduler._ensure_slot(seq, lookahead=T):
+                return False
+            all_toks = seq.prompt + seq.output
+            tok0[i] = all_toks[seq.num_computed]
+            pos0[i] = seq.num_computed
+            self._tables_row(tables, i, seq)
+            limits[i] = min(
+                len(seq.block_ids) * bs, cfg.max_blocks_per_seq * bs
+            )
+        samp = self._sampling_arrays(members)
+        need_lp = bool(samp.need_logprobs)
+        c_tok, c_steps = tok0, samp.steps
+        if self._rep_sharding is not None:
+            c_tok, c_steps = self._prep((c_tok, c_steps))
+            d_args = self._prep((pos0, tables, limits, samp))
+        else:
+            d_args = (pos0, tables, limits, samp)
+        multi = self._multi_fn
+
+        def run():
+            outs, _last, _steps, _counts, self.cache = multi(
+                self.params, self.cache, c_tok, c_steps, samp.counts, *d_args
+            )
+            if need_lp:
+                return (
+                    np.asarray(outs.tokens),
+                    np.asarray(outs.logprob),
+                    np.asarray(outs.top_ids),
+                    np.asarray(outs.top_logprobs),
+                )
+            return np.asarray(outs.tokens), None, None, None
+
+        t0 = time.perf_counter()
+        async with self._device_lock:
+            if self._publisher is not None:
+                await self._publisher.publish(
+                    "multi",
+                    (
+                        tok0,
+                        pos0,
+                        tables.copy(),
+                        limits,
+                        jax.tree_util.tree_map(np.asarray, samp),
+                    ),
+                )
+            sampled, logp, top_ids, top_lp = await asyncio.to_thread(run)
+        self.step_trace.append(
+            ("decode_burst", time.perf_counter() - t0, n, n * T)
+        )
+        finished: List[SequenceState] = []
+        for t in range(T):
+            for i, seq in enumerate(members):
+                if seq.finished or pos0[i] < 0:
+                    continue
+                if seq.num_computed != pos0[i] + t:
+                    continue  # stopped earlier in this burst
+                if seq.num_computed >= len(seq.block_ids) * bs:
+                    continue  # beyond allocation: never KV-backed
+                fed = (seq.prompt + seq.output)[seq.num_computed]
+                if seq.num_computed >= len(seq.prompt):
+                    seq.block_seq.append(fed)
+                seq.num_computed += 1
+                self._seal_completed_blocks(seq)
+                self._accept_token(
+                    seq,
+                    int(sampled[t, i]),
+                    defer_removal=True,
+                    logprobs=self._lp_info(
+                        seq,
+                        i,
+                        None if logp is None else logp[t],
+                        None if top_ids is None else top_ids[t],
+                        None if top_lp is None else top_lp[t],
+                    ),
+                )
+                if seq.finished:
+                    finished.append(seq)
+        for seq in finished:
+            self.scheduler.remove(seq)
+        return True
 
     def _any_useful_rows(
         self, members: List[SequenceState], pos_disp: np.ndarray

@@ -43,6 +43,7 @@ _PATTERNS = [
     "config.json",
     "generation_config.json",
     "tokenizer.json",
+    "tokenizer.model",  # sentencepiece-only repos (older Llama/Mistral)
     "tokenizer_config.json",
     "special_tokens_map.json",
 ]
@@ -106,4 +107,14 @@ def tokenizer_spec(path: str) -> Optional[dict]:
         return {"kind": "gguf", "file": path}
     if os.path.exists(os.path.join(path, "tokenizer.json")):
         return {"kind": "hf", "dir": path}
+    if os.path.exists(os.path.join(path, "tokenizer.model")):
+        # sentencepiece-only checkpoint: the fast-tokenizer runtime needs
+        # tokenizer.json — serving real weights through the byte-fallback
+        # tokenizer would silently produce garbage text, so refuse loudly.
+        raise ValueError(
+            f"{path} ships only a sentencepiece tokenizer.model; convert it "
+            "to tokenizer.json (transformers: "
+            "AutoTokenizer.from_pretrained(...).save_pretrained) or pass "
+            "--tokenizer explicitly"
+        )
     return None

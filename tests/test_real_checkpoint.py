@@ -254,7 +254,6 @@ def test_real_checkpoint_serves_golden_tokens(checkpoint):
             assert r.status == 200, await r.text()
             body = await r.json()
         text = body["choices"][0]["message"]["content"]
-        served_again = text
 
         # The served text must decode the EXACT golden token sequence.
         assert text == tokenizer.decode(golden), (text, golden)
@@ -273,10 +272,9 @@ def test_real_checkpoint_serves_golden_tokens(checkpoint):
                 },
             )
             body2 = await r.json()
-        assert body2["choices"][0]["message"]["content"] == served_again
+        assert body2["choices"][0]["message"]["content"] == text
 
         await svc.close()
         await engine.close()
-        return prompt_ids, golden, text, body
 
     asyncio.run(main())

@@ -1,6 +1,7 @@
 import os, sys, asyncio, json
 os.environ["JAX_PLATFORMS"] = "cpu"
-sys.path.insert(0, "/root/repo"); sys.path.insert(0, "/root/repo/tests")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO); sys.path.insert(0, os.path.join(REPO, "tests"))
 from test_real_checkpoint import build_checkpoint, reference_greedy, CHAT_TEMPLATE
 
 async def main():
@@ -90,8 +91,8 @@ served content:   {body["choices"][0]["message"]["content"]!r}
 MATCH: {tok.decode(golden) == body["choices"][0]["message"]["content"]}
 ```
 """
-    os.makedirs("/root/repo/docs/transcripts", exist_ok=True)
-    with open("/root/repo/docs/transcripts/real_checkpoint.md", "w") as f:
+    os.makedirs(os.path.join(REPO, "docs/transcripts"), exist_ok=True)
+    with open(os.path.join(REPO, "docs/transcripts/real_checkpoint.md"), "w") as f:
         f.write(md)
     print("MATCH:", tok.decode(golden) == body["choices"][0]["message"]["content"])
 
