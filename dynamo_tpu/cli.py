@@ -318,6 +318,46 @@ async def _run_mock_worker(args) -> None:
         await runtime.close()
 
 
+async def _run_operator(args) -> None:
+    """In-cluster reconcile loop (reference: the Go operator binary)."""
+    from .deploy.controller import KubeApi, Reconciler
+
+    kube = KubeApi(namespace=args.namespace, base=args.api_server)
+    print(
+        f"operator reconciling {args.namespace}/dynamotpudeployments "
+        f"every {args.poll_interval}s",
+        flush=True,
+    )
+    try:
+        await Reconciler(kube).run(poll_interval=args.poll_interval)
+    finally:
+        await kube.close()
+
+
+async def _run_api_store(args) -> None:
+    """Deployment-management REST API (reference: api-store FastAPI app)."""
+    from .deploy.api_store import ApiStore
+    from .runtime.transports.hub import HubClient
+
+    hub = await HubClient(args.hub).connect()
+    reconciler = None
+    if args.kube:
+        from .deploy.controller import KubeApi, Reconciler
+
+        reconciler = Reconciler(KubeApi(namespace=args.namespace))
+    store = await ApiStore(
+        hub, reconciler, host=args.host, port=args.port
+    ).start()
+    print(f"api-store on http://{args.host}:{store.port}", flush=True)
+    try:
+        await _wait_forever()
+    finally:
+        await store.close()
+        if reconciler is not None:
+            await reconciler.kube.close()
+        await hub.close()
+
+
 async def _wait_forever() -> None:
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -472,6 +512,29 @@ def main(argv: Optional[list] = None) -> None:
     p_mock.add_argument("--component", default="TpuWorker")
     p_mock.add_argument("--interval", type=float, default=0.5)
 
+    p_op = sub.add_parser(
+        "operator",
+        help="k8s controller: reconcile DynamoTpuDeployment CRs in-cluster",
+    )
+    p_op.add_argument("--namespace", default="default")
+    p_op.add_argument("--poll-interval", type=float, default=10.0,
+                      dest="poll_interval")
+    p_op.add_argument("--api-server", default=None, dest="api_server",
+                      help="override the in-cluster API server URL")
+
+    p_store = sub.add_parser(
+        "api-store",
+        help="deployment-management REST API over the hub store",
+    )
+    p_store.add_argument("--hub", required=True)
+    p_store.add_argument("--host", default="0.0.0.0")
+    p_store.add_argument("--port", type=int, default=7070)
+    p_store.add_argument(
+        "--kube", action="store_true",
+        help="also reconcile created deployments against the k8s API",
+    )
+    p_store.add_argument("--namespace", default="default")
+
     args = parser.parse_args(argv)
     if args.cmd == "model" and args.verb in ("add", "remove") and not args.name:
         parser.error(f"model {args.verb} requires a model name")
@@ -518,6 +581,10 @@ def main(argv: Optional[list] = None) -> None:
             asyncio.run(_run_metrics(args))
         elif args.cmd == "mock-worker":
             asyncio.run(_run_mock_worker(args))
+        elif args.cmd == "operator":
+            asyncio.run(_run_operator(args))
+        elif args.cmd == "api-store":
+            asyncio.run(_run_api_store(args))
         else:
             asyncio.run(_run(args))
     except KeyboardInterrupt:

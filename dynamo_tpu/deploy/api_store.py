@@ -1,0 +1,148 @@
+"""Deployment-management REST API (the reference's api-store).
+
+Reference counterpart: /root/reference/deploy/dynamo/api-store/
+ai_dynamo_store/api/* — a FastAPI CRUD surface over deployment records that
+the operator consumes.  Here the records are DynamoTpuDeployment CR dicts
+persisted in the hub KV (durable across hub restarts via its snapshot
+layer), and the same Reconciler that serves the k8s controller can run
+against this store's CRs — deployment management without a k8s control
+plane, or as the source feeding one.
+
+Routes (mirroring the reference's shape):
+  POST   /api/v1/deployments          create (body = CR spec or full CR)
+  GET    /api/v1/deployments          list
+  GET    /api/v1/deployments/{name}   fetch (includes last status)
+  DELETE /api/v1/deployments/{name}   delete
+  GET    /api/v1/deployments/{name}/manifests   rendered children (preview)
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from .renderer import render
+
+logger = logging.getLogger(__name__)
+
+PREFIX = "deployments/"
+
+
+def _as_cr(name: str, body: Dict[str, Any]) -> Dict[str, Any]:
+    """Accept either a full CR or a bare spec."""
+    if "spec" in body:
+        cr = dict(body)
+        cr.setdefault("apiVersion", "dynamo.tpu/v1alpha1")
+        cr.setdefault("kind", "DynamoTpuDeployment")
+        cr.setdefault("metadata", {})["name"] = name
+        return cr
+    return {
+        "apiVersion": "dynamo.tpu/v1alpha1",
+        "kind": "DynamoTpuDeployment",
+        "metadata": {"name": name},
+        "spec": body,
+    }
+
+
+class ApiStore:
+    """REST surface over hub-persisted deployment CRs.
+
+    ``hub`` is anything with kv_put/kv_get/kv_get_prefix/kv_delete (the
+    runtime hub client or InprocHub).  ``reconciler`` is optional: when
+    given, create/delete trigger an immediate reconcile pass.
+    """
+
+    def __init__(self, hub, reconciler=None, host="0.0.0.0", port=7070):
+        self.hub = hub
+        self.reconciler = reconciler
+        self.host, self.port = host, port
+        self._runner: Optional[web.AppRunner] = None
+        self.app = web.Application()
+        self.app.router.add_post("/api/v1/deployments", self._create)
+        self.app.router.add_get("/api/v1/deployments", self._list)
+        self.app.router.add_get("/api/v1/deployments/{name}", self._get)
+        self.app.router.add_delete("/api/v1/deployments/{name}", self._delete)
+        self.app.router.add_get(
+            "/api/v1/deployments/{name}/manifests", self._manifests
+        )
+
+    # ------------------------------------------------------------- handlers
+    async def _create(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        name = (
+            body.get("name")
+            or (body.get("metadata") or {}).get("name")
+        )
+        if not name:
+            return web.json_response(
+                {"error": "missing deployment name"}, status=400
+            )
+        body.pop("name", None)
+        cr = _as_cr(name, body)
+        try:
+            render(cr)  # validate: reject specs the renderer can't map
+        except Exception as e:
+            return web.json_response(
+                {"error": f"invalid spec: {e}"}, status=400
+            )
+        existed = await self.hub.kv_get(PREFIX + name) is not None
+        await self.hub.kv_put(PREFIX + name, cr)
+        if self.reconciler is not None:
+            try:
+                status = await self.reconciler.reconcile(cr)
+                cr = dict(cr, status=status)
+                await self.hub.kv_put(PREFIX + name, cr)
+            except Exception:
+                logger.exception("reconcile on create failed")
+        return web.json_response(cr, status=200 if existed else 201)
+
+    async def _list(self, request: web.Request) -> web.Response:
+        items = await self.hub.kv_get_prefix(PREFIX)
+        return web.json_response({"items": list(items.values())})
+
+    async def _get(self, request: web.Request) -> web.Response:
+        cr = await self.hub.kv_get(PREFIX + request.match_info["name"])
+        if cr is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(cr)
+
+    async def _delete(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        cr = await self.hub.kv_get(PREFIX + name)
+        if cr is None:
+            return web.json_response({"error": "not found"}, status=404)
+        await self.hub.kv_delete(PREFIX + name)
+        if self.reconciler is not None:
+            try:
+                await self.reconciler.teardown(name)
+            except Exception:
+                logger.exception("teardown on delete failed")
+        return web.json_response({"deleted": name})
+
+    async def _manifests(self, request: web.Request) -> web.Response:
+        cr = await self.hub.kv_get(PREFIX + request.match_info["name"])
+        if cr is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({"manifests": render(cr)})
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> "ApiStore":
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            self.port = s._server.sockets[0].getsockname()[1]
+            break
+        logger.info("api-store on http://%s:%s", self.host, self.port)
+        return self
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

@@ -1,0 +1,326 @@
+"""Operator controller: watches DynamoTpuDeployment CRs and reconciles the
+cluster to `render(cr)`.
+
+Reference counterpart: the Go operator's reconcile loop
+(/root/reference/deploy/dynamo/operator/internal/controller/
+dynamonimdeployment_controller.go:1-2169) — fetch CR, generate child
+resources, create/update/delete to match, write status.  controller-runtime
+gives the Go version its watch/cache machinery; here the same loop is an
+asyncio poll-or-watch over a minimal cluster client protocol, so the whole
+reconcile path is unit-testable against an in-memory fake (the reference
+tests the same way with controller-runtime's fake client).
+
+Split of responsibilities (mirrors the reference):
+- deploy/renderer.py — PURE mapping CR → desired children;
+- Reconciler (here)  — diffing desired vs observed, ownership, drift
+  repair, status writing;
+- KubeApi (here)     — the only piece that talks to a real API server.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import json
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+from .renderer import render
+
+logger = logging.getLogger(__name__)
+
+GROUP = "dynamo.tpu"
+OWNER_LABEL = f"{GROUP}/owner"
+CR_PLURAL = "dynamotpudeployments"
+
+
+def _kind_name(m: Dict[str, Any]) -> Tuple[str, str]:
+    return m["kind"], m["metadata"]["name"]
+
+
+def _spec_equal(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
+    """Compare the fields the controller owns (spec + labels); ignores
+    server-populated metadata and status."""
+
+    def norm(m):
+        return json.dumps(
+            {
+                "spec": m.get("spec"),
+                "labels": (m.get("metadata") or {}).get("labels"),
+            },
+            sort_keys=True,
+        )
+
+    return norm(a) == norm(b)
+
+
+class FakeKube:
+    """In-memory cluster for tests (the reference uses controller-runtime's
+    fake client the same way).  Stores manifests by (kind, name); simulates
+    readiness by echoing spec replicas into status when `auto_ready`."""
+
+    def __init__(self, auto_ready: bool = True):
+        self.objects: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self.auto_ready = auto_ready
+        self.applied: List[Tuple[str, str]] = []  # audit trail
+        self.deleted: List[Tuple[str, str]] = []
+
+    async def list(
+        self, kind: str, label: Optional[Tuple[str, str]] = None
+    ) -> List[Dict[str, Any]]:
+        out = []
+        for (k, _), m in self.objects.items():
+            if k != kind:
+                continue
+            if label is not None:
+                labels = (m.get("metadata") or {}).get("labels") or {}
+                if labels.get(label[0]) != label[1]:
+                    continue
+            out.append(copy.deepcopy(m))
+        return out
+
+    async def apply(self, manifest: Dict[str, Any]) -> None:
+        key = _kind_name(manifest)
+        m = copy.deepcopy(manifest)
+        if self.auto_ready and m["kind"] in ("Deployment", "StatefulSet"):
+            reps = (m.get("spec") or {}).get("replicas", 1)
+            m["status"] = {"readyReplicas": reps, "replicas": reps}
+        prev = self.objects.get(key)
+        if prev is not None and "status" in prev and "status" not in m:
+            m["status"] = prev["status"]
+        self.objects[key] = m
+        self.applied.append(key)
+
+    async def delete(self, kind: str, name: str) -> bool:
+        self.deleted.append((kind, name))
+        return self.objects.pop((kind, name), None) is not None
+
+    async def update_status(self, cr: Dict[str, Any], status: Dict[str, Any]) -> None:
+        key = ("DynamoTpuDeployment", cr["metadata"]["name"])
+        if key in self.objects:
+            self.objects[key]["status"] = copy.deepcopy(status)
+
+
+class KubeApi:
+    """Minimal in-cluster API-server client (aiohttp).  Reads the standard
+    serviceaccount token/CA; `apply` uses server-side apply so the loop is
+    idempotent without resourceVersion bookkeeping."""
+
+    SA = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+    _PATHS = {
+        "Deployment": "/apis/apps/v1/namespaces/{ns}/deployments",
+        "StatefulSet": "/apis/apps/v1/namespaces/{ns}/statefulsets",
+        "Service": "/api/v1/namespaces/{ns}/services",
+        "DynamoTpuDeployment": (
+            f"/apis/{GROUP}/v1alpha1/namespaces/{{ns}}/{CR_PLURAL}"
+        ),
+    }
+
+    def __init__(self, namespace: str = "default", base: Optional[str] = None):
+        import os
+
+        self.namespace = namespace
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        self.base = base or f"https://{host}:{port}"
+        self._token: Optional[str] = None
+        self._session = None
+
+    async def _http(self):
+        import os
+
+        if self._session is None:
+            import ssl
+
+            import aiohttp
+
+            ctx: Any = None
+            ca = os.path.join(self.SA, "ca.crt")
+            if os.path.exists(ca):
+                ctx = ssl.create_default_context(cafile=ca)
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(ssl=ctx)
+            )
+        # Projected serviceaccount tokens are time-bound and the kubelet
+        # refreshes the FILE — re-read per request, or a long-running
+        # operator goes permanently 401 after ~1h.
+        tokf = os.path.join(self.SA, "token")
+        if os.path.exists(tokf):
+            with open(tokf) as f:
+                self._token = f.read().strip()
+        return self._session
+
+    def _headers(self, content_type: Optional[str] = None) -> Dict[str, str]:
+        h = {}
+        if self._token:
+            h["Authorization"] = f"Bearer {self._token}"
+        if content_type:
+            h["Content-Type"] = content_type
+        return h
+
+    def _path(self, kind: str, name: Optional[str] = None) -> str:
+        p = self.base + self._PATHS[kind].format(ns=self.namespace)
+        return f"{p}/{name}" if name else p
+
+    async def list(self, kind, label=None):
+        s = await self._http()
+        params = {}
+        if label is not None:
+            params["labelSelector"] = f"{label[0]}={label[1]}"
+        async with s.get(
+            self._path(kind), params=params, headers=self._headers()
+        ) as r:
+            r.raise_for_status()
+            return (await r.json()).get("items", [])
+
+    async def apply(self, manifest):
+        s = await self._http()
+        kind, name = _kind_name(manifest)
+        async with s.patch(
+            self._path(kind, name),
+            params={"fieldManager": "dynamo-tpu-operator", "force": "true"},
+            data=json.dumps(manifest),
+            headers=self._headers("application/apply-patch+yaml"),
+        ) as r:
+            r.raise_for_status()
+
+    async def delete(self, kind, name) -> bool:
+        s = await self._http()
+        async with s.delete(
+            self._path(kind, name), headers=self._headers()
+        ) as r:
+            return r.status < 300
+
+    async def update_status(self, cr, status):
+        s = await self._http()
+        name = cr["metadata"]["name"]
+        body = {
+            "apiVersion": f"{GROUP}/v1alpha1",
+            "kind": "DynamoTpuDeployment",
+            "metadata": {"name": name},
+            "status": status,
+        }
+        async with s.patch(
+            self._path("DynamoTpuDeployment", name) + "/status",
+            params={"fieldManager": "dynamo-tpu-operator", "force": "true"},
+            data=json.dumps(body),
+            headers=self._headers("application/apply-patch+yaml"),
+        ) as r:
+            if r.status >= 300:  # CRD without status subresource: best effort
+                logger.debug("status write failed: HTTP %s", r.status)
+
+    async def close(self):
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+class Reconciler:
+    """Drives one CR (or all CRs) to its rendered desired state."""
+
+    CHILD_KINDS = ("Deployment", "StatefulSet", "Service")
+
+    def __init__(self, kube):
+        self.kube = kube
+
+    async def reconcile(self, cr: Dict[str, Any]) -> Dict[str, Any]:
+        """One reconcile pass for ``cr``; returns the status written."""
+        name = cr["metadata"]["name"]
+        desired = []
+        for m in render(cr):
+            m = copy.deepcopy(m)
+            m["metadata"].setdefault("labels", {})[OWNER_LABEL] = name
+            desired.append(m)
+        desired_keys = {_kind_name(m) for m in desired}
+
+        observed: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        for kind in self.CHILD_KINDS:
+            for m in await self.kube.list(kind, label=(OWNER_LABEL, name)):
+                observed[_kind_name(m)] = m
+
+        # Create missing / update drifted (covers spec drift AND manual
+        # deletion — the apply re-creates).
+        for m in desired:
+            cur = observed.get(_kind_name(m))
+            if cur is None or not _spec_equal(cur, m):
+                await self.kube.apply(m)
+
+        # Delete owned children no longer rendered (a service removed from
+        # the CR takes its Deployment + Service with it).
+        for key, _ in observed.items():
+            if key not in desired_keys:
+                await self.kube.delete(*key)
+
+        status = await self._status(cr, desired)
+        await self.kube.update_status(cr, status)
+        return status
+
+    async def teardown(self, name: str) -> int:
+        """Delete every child owned by CR ``name``; returns count deleted.
+        Shared by the orphan sweep and the api-store's delete handler."""
+        count = 0
+        for kind in self.CHILD_KINDS:
+            for m in await self.kube.list(kind, label=(OWNER_LABEL, name)):
+                await self.kube.delete(*_kind_name(m))
+                count += 1
+        return count
+
+    async def _status(self, cr, desired) -> Dict[str, Any]:
+        name = cr["metadata"]["name"]
+        ready, total = 0, 0
+        services = []
+        observed = {}
+        for kind in ("Deployment", "StatefulSet"):
+            for m in await self.kube.list(kind, label=(OWNER_LABEL, name)):
+                observed[_kind_name(m)] = m
+        for m in desired:
+            if m["kind"] not in ("Deployment", "StatefulSet"):
+                continue
+            total += 1
+            cur = observed.get(_kind_name(m)) or {}
+            want = (m.get("spec") or {}).get("replicas", 1)
+            have = (cur.get("status") or {}).get("readyReplicas", 0)
+            ok = have >= want
+            ready += bool(ok)
+            services.append(
+                {"name": m["metadata"]["name"], "ready": have, "want": want}
+            )
+        return {
+            "observedGeneration": cr["metadata"].get("generation", 0),
+            "phase": "Ready" if ready == total else "Progressing",
+            "readyServices": ready,
+            "totalServices": total,
+            "services": services,
+        }
+
+    async def run(self, poll_interval: float = 10.0) -> None:
+        """Level-triggered loop: every interval, list CRs and reconcile
+        each (the reference's watch is an optimization over the same
+        level-triggered semantics; polling keeps this client-minimal)."""
+        while True:
+            try:
+                crs = await self.kube.list("DynamoTpuDeployment")
+                for cr in crs:
+                    try:
+                        await self.reconcile(cr)
+                    except Exception:
+                        logger.exception(
+                            "reconcile failed for %s",
+                            cr["metadata"]["name"],
+                        )
+                # Orphan sweep: children whose owner CR is gone.
+                names = {c["metadata"]["name"] for c in crs}
+                orphaned = set()
+                for kind in self.CHILD_KINDS:
+                    for m in await self.kube.list(kind):
+                        owner = (m["metadata"].get("labels") or {}).get(
+                            OWNER_LABEL
+                        )
+                        if owner is not None and owner not in names:
+                            orphaned.add(owner)
+                for owner in orphaned:
+                    await self.teardown(owner)
+            except Exception:
+                logger.exception("controller pass failed")
+            await asyncio.sleep(poll_interval)

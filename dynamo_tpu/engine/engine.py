@@ -821,6 +821,9 @@ class TpuEngine(AsyncEngine):
             try:
                 did_work = False
                 if plan.pure_decode and self.cfg.decode_steps > 1:
+                    # Leaving the mixed regime: a stale chunk count must not
+                    # trigger an immediate burst in the NEXT mixed phase.
+                    self._chunks_since_burst = 0
                     did_work = await self._decode_pipeline(
                         [seq for seq, _, _ in plan.items]
                     )

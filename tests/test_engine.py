@@ -443,3 +443,82 @@ def test_scheduler_decode_rows_do_not_consume_prefill_budget():
     plan2 = sched.schedule()
     assert plan2.pure_decode
     assert waiter2 in sched.waiting
+
+
+def test_engine_mixed_phase_burst_matches_serial():
+    """While one request decodes and another prefills a long prompt, decode
+    advances via fused bursts (decode_burst dispatches) — and the tokens
+    must match serial execution exactly (burst cadence is a scheduling
+    change, never a numerics change)."""
+
+    async def main():
+        from dynamo_tpu.runtime.engine import Context, collect
+
+        cfg = dict(CFG)
+        cfg.update(
+            max_batch=4,
+            prefill_chunk=8,
+            decode_steps=4,
+            pipeline_depth=2,
+            prefill_chunks_per_burst=2,
+            max_model_len=256,
+            num_blocks=256,
+        )
+        long_prompt = list(range(1, 97))  # 96 tokens → 12 chunks of 8
+        short = [7, 8, 9]
+
+        engine = TpuEngine(EngineConfig(**cfg))
+        serial_a, _ = await _generate(engine, short, max_tokens=40)
+        serial_b, _ = await _generate(engine, long_prompt, max_tokens=6)
+        await engine.close()
+
+        engine2 = TpuEngine(EngineConfig(**cfg))
+
+        async def run_a():
+            return await _generate(engine2, short, max_tokens=40)
+
+        async def run_b():
+            # Let A reach steady decode before B's prefill starts.
+            stream_a = await engine2.generate(Context(_req(short, 40)))
+            it = stream_a.__aiter__()
+            first = await it.__anext__()
+            toks_a = list(first["token_ids"])
+            out_b = await _generate(engine2, long_prompt, max_tokens=6)
+            async for item in it:
+                toks_a.extend(item.get("token_ids", ()))
+            return toks_a, out_b
+
+        toks_a, (toks_b, _) = await run_b()
+        assert toks_a == serial_a
+        assert toks_b == serial_b
+        kinds = {k for k, *_ in engine2.step_trace}
+        assert "decode_burst" in kinds, f"no burst dispatched: {kinds}"
+        await engine2.close()
+
+    asyncio.run(main())
+
+
+def test_engine_burst_headroom_fallback():
+    """When KV headroom for a full burst is missing, the engine must fall
+    back to the unified step (decode still advances one token) instead of
+    stalling decode rows."""
+
+    async def main():
+        cfg = dict(CFG)
+        cfg.update(
+            max_batch=2,
+            prefill_chunk=8,
+            decode_steps=64,  # a full burst wants 64 lookahead slots
+            prefill_chunks_per_burst=1,
+            num_blocks=18,  # tiny pool: lookahead can't allocate
+            max_model_len=64,
+        )
+        engine = TpuEngine(EngineConfig(**cfg))
+        results = await asyncio.gather(
+            _generate(engine, [1, 2, 3], max_tokens=10),
+            _generate(engine, list(range(5, 37)), max_tokens=6),
+        )
+        assert [len(r[0]) for r in results] == [10, 6]
+        await engine.close()
+
+    asyncio.run(main())
