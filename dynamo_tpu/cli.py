@@ -212,6 +212,8 @@ async def _run(args) -> None:
                 import_path=import_ep.path,
             )
             await import_ep.serve_endpoint(worker.kv_import_handler)
+            stats_ep = endpoint.component.endpoint("disagg_stats")
+            await stats_ep.serve_endpoint(worker.stats_handler)
             served_engine = worker
 
         await endpoint.serve_endpoint(served_engine)
@@ -440,8 +442,12 @@ def main(argv: Optional[list] = None) -> None:
         help="KV page dtype (e.g. float8_e4m3fn halves KV memory)",
     )
     p_run.add_argument(
-        "--kv-scale", type=float, default=1.0, dest="kv_scale",
-        help="static scale for quantized KV pages",
+        "--kv-scale",
+        type=lambda s: s if s == "auto" else float(s),
+        default=1.0,
+        dest="kv_scale",
+        help="quantized KV pages: a static scale, or 'auto' to calibrate "
+        "per-layer scales from a probe forward at startup",
     )
     p_run.add_argument(
         "--attn-impl",

@@ -11,7 +11,7 @@ every workload mix.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Any, List, Optional
 
 
 def _pow2_buckets(lo: int, hi: int) -> List[int]:
@@ -43,13 +43,14 @@ class EngineConfig:
     sp_prefill_min: int = 1024
     dtype: str = "bfloat16"
     # KV cache dtype; defaults to dtype.  Quantized page dtypes halve KV
-    # memory (2x context capacity) with one static kv_scale — the TPU
-    # kernel's native k_scale/v_scale path.  "float8_e4m3fn" works with the
-    # default scale; "int8" REQUIRES a calibrated kv_scale (stored values
-    # are value/kv_scale rounded to integers — at the 1.0 default, normal
-    # sub-unit activations all round to 0).
+    # memory (2x context capacity).  kv_scale: a static float, "auto"
+    # (per-layer scales calibrated from a probe forward at engine start —
+    # engine._calibrate_kv_scales), or a per-layer sequence.  "int8"
+    # REQUIRES calibration/a real scale (stored values are value/kv_scale
+    # rounded to integers — at the 1.0 default, normal sub-unit activations
+    # all round to 0).  Accuracy evidence: tests/test_quantized_kv.py.
     cache_dtype: Optional[str] = None
-    kv_scale: float = 1.0
+    kv_scale: Any = 1.0
     seed: int = 0
     # derived buckets
     batch_buckets: List[int] = field(default_factory=list)

@@ -61,6 +61,23 @@ logger = logging.getLogger(__name__)
 _FINISHED = object()  # queue sentinel
 
 
+def _scales_close(a, b, rtol: float = 0.05) -> bool:
+    """Stored-representation scale compatibility for KV transfers.
+
+    Exact equality would silently disable disagg transfers between two
+    workers that each ran kv_scale='auto' (independent calibration drifts
+    at the ULP level across device generations / compiler versions); a few
+    percent of relative drift is within the quantization noise floor.
+    """
+    if a is None or b is None:
+        return a is None and b is None
+    av = np.asarray(a, np.float32).reshape(-1)
+    bv = np.asarray(b, np.float32).reshape(-1)
+    if av.shape != bv.shape and av.size != 1 and bv.size != 1:
+        return False
+    return bool(np.allclose(av, bv, rtol=rtol))
+
+
 class TpuEngine(AsyncEngine):
     """Token-in/token-out engine (ExecutionContext equivalent)."""
 
@@ -149,6 +166,19 @@ class TpuEngine(AsyncEngine):
                 params = load_params(self.model_config, cfg.checkpoint_path)
             else:
                 params = init_params(self.model_config, jax.random.PRNGKey(cfg.seed))
+        # Quantized-scale resolution BEFORE shard_tree: the calibration
+        # probe jits over the plain params (see _calibrate_kv_scales).
+        if jnp.dtype(cfg.cache_dtype).itemsize == 1:
+            if isinstance(cfg.kv_scale, str):
+                if cfg.kv_scale != "auto":
+                    raise ValueError(f"unknown kv_scale {cfg.kv_scale!r}")
+                self.kv_scale = self._calibrate_kv_scales(params)
+            elif isinstance(cfg.kv_scale, (list, tuple, np.ndarray)):
+                self.kv_scale = np.asarray(cfg.kv_scale, np.float32)
+            else:
+                self.kv_scale = float(cfg.kv_scale)
+        else:
+            self.kv_scale = None
         cache = PagedKVCache.create(
             self.model_config,
             cfg.num_blocks,
@@ -170,12 +200,10 @@ class TpuEngine(AsyncEngine):
         self.attn_impl = attn_impl
         S = cfg.max_batch
         mesh = self.mesh
-        # Quantized (1-byte) KV pages use one static scale (config.py).
-        self.kv_scale = (
-            float(cfg.kv_scale)
-            if jnp.dtype(cfg.cache_dtype).itemsize == 1
-            else None
-        )
+        # Quantized (1-byte) KV pages: a static scale, or per-layer scales
+        # calibrated at init (kv_scale == "auto"; resolved above, before
+        # sharding).  Arrays fold into the forward algebraically
+        # (models/llama.py), so they stay fully traced.
         kv_scale = self.kv_scale
 
         def _step(params, cache, rb, samp):
@@ -342,6 +370,70 @@ class TpuEngine(AsyncEngine):
             ) if jax.process_count() == 1 else self._prep(
                 np.zeros((S, self.model_config.vocab_size), np.int16)
             )
+
+    def _calibrate_kv_scales(self, params) -> np.ndarray:
+        """Per-layer quantization scales from a probe forward: run a short
+        deterministic token run through the model with a throwaway bf16
+        cache, take each layer's max |K/V|, and map it to the target
+        dtype's representable max.  Runs on the UNSHARDED params (before
+        shard_tree), so it is single-process only — multi-host deployments
+        pass the calibrated vector explicitly via kv_scale."""
+        if jax.process_count() > 1:
+            raise ValueError(
+                "kv_scale='auto' calibrates on one process; run calibration "
+                "single-host and pass the resulting scales explicitly"
+            )
+        cfg, mc = self.cfg, self.model_config
+        # Probe length bounded so nb (+1 slack) fits a single row's table.
+        T = min(128, (cfg.max_blocks_per_seq - 1) * cfg.block_size)
+        nb = (T + cfg.block_size - 1) // cfg.block_size + 1
+        probe = PagedKVCache.create(mc, nb, cfg.block_size, dtype=jnp.bfloat16)
+        toks = ((np.arange(T) * 2654435761) % mc.vocab_size).astype(np.int32)
+        pos = np.arange(T, dtype=np.int32)
+        S = cfg.max_batch
+        # Table width = the probe's own nb pages, NOT max_blocks_per_seq:
+        # the XLA fallback materializes [T, width*bs, 2KV, hd] f32, which
+        # at long-context configs would be tens of GB.
+        tables = np.zeros((S, nb), np.int32)
+        tables[0, :nb] = np.arange(nb)
+        cu = np.zeros((S + 1,), np.int32)
+        cu[1:] = T
+        rb = RaggedBatch(
+            token_ids=toks,
+            positions=pos,
+            slot_mapping=pos,  # consecutive slots in blocks 0..nb
+            kv_lens=np.asarray([T] + [0] * (S - 1), np.int32),
+            page_indices=tables,
+            cu_q_lens=cu,
+            num_seqs=np.asarray([1], np.int32),
+        )
+        _, probe = jax.jit(
+            lambda p, c: forward_ragged(p, mc, rb, c, attn_impl="xla")
+        )(params, probe)
+        # [L, nb, ps, 2KV, hd] → per-layer max |value| over everything else.
+        maxabs = np.asarray(
+            jnp.max(
+                jnp.abs(probe.pages.astype(jnp.float32)), axis=(1, 2, 3, 4)
+            )
+        )
+        dt = jnp.dtype(cfg.cache_dtype)
+        if jnp.issubdtype(dt, jnp.integer):
+            qmax = float(jnp.iinfo(dt).max)
+        else:
+            qmax = float(jnp.finfo(dt).max)  # e4m3 → 448
+        scales = np.maximum(maxabs / qmax, 1e-6).astype(np.float32)
+        logger.info(
+            "calibrated per-layer kv scales (dtype %s): min %.4g max %.4g",
+            dt, scales.min(), scales.max(),
+        )
+        return scales
+
+    def _kv_scale_repr(self):
+        """JSON-safe scale for transfer payloads: None, float, or list."""
+        if self.kv_scale is None:
+            return None
+        a = np.asarray(self.kv_scale, np.float32).reshape(-1)
+        return [float(x) for x in a] if a.size > 1 else float(a[0])
 
     # ------------------------------------------------------------ multi-host
     def attach_publisher(self, publisher) -> None:
@@ -542,11 +634,12 @@ class TpuEngine(AsyncEngine):
                 f"{self.cfg.max_model_len}"
             )
         self._ensure_loop()
+        prepared = 0
         if self.host_kv is not None and len(self.host_kv):
             # Pull any evicted prefix blocks back from host RAM BEFORE
             # admission, so the scheduler sees them as prefix-cache hits
             # (the reference's restore-ahead-of-prefill TTFT win).
-            await self._restore_from_host(list(pre.token_ids))
+            prepared += await self._restore_from_host(list(pre.token_ids))
         if (
             self._sp_fn is not None
             and len(pre.token_ids) >= self.cfg.sp_prefill_min
@@ -554,8 +647,16 @@ class TpuEngine(AsyncEngine):
         ):
             # Long prompt: one sequence-parallel whole-prompt pass seals the
             # complete blocks ahead of admission (ring attention over "sp").
-            await self._sp_prefill(list(pre.token_ids))
+            prepared += await self._sp_prefill(list(pre.token_ids))
         seq = SequenceState.from_request(request.id, pre, self.cfg)
+        if prepared:
+            # PIN the just-sealed prefix until admission: the sealed blocks
+            # sit in the reuse pool, where a concurrent request's
+            # allocations could LRU-evict them before allocate_sequence
+            # matches — silently wasting the whole sp/restore pass.  The
+            # scheduler releases the pin when admission lands (or the
+            # request is rejected/cancelled).
+            seq.pin_ids = self._pin_prefix(list(pre.token_ids))
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[request.id] = queue
         self._contexts[request.id] = request.ctx
@@ -663,7 +764,7 @@ class TpuEngine(AsyncEngine):
             # Stored representation metadata: the importer must match (a
             # different quantization scale/dtype would seal wrongly-scaled
             # KV under valid hashes).
-            "kv_scale": self.kv_scale,
+            "kv_scale": self._kv_scale_repr(),
             "shape": list(k.shape),
             "k": np.ascontiguousarray(k).tobytes(),
             "v": np.ascontiguousarray(v).tobytes(),
@@ -704,17 +805,20 @@ class TpuEngine(AsyncEngine):
             )
             self.kv.free_sequence(alloc[0])
             return 0
+        local_scale = self._kv_scale_repr()
         if (
             payload.get("dtype", str(jnp.dtype(self.cfg.cache_dtype)))
             != str(jnp.dtype(self.cfg.cache_dtype))
-            or payload.get("kv_scale", self.kv_scale) != self.kv_scale
+            or not _scales_close(
+                payload.get("kv_scale", local_scale), local_scale
+            )
         ):
             # Stored-representation mismatch (quantization dtype/scale):
             # importing raw rows would mis-scale the prefix silently.
             logger.warning(
                 "rejecting KV import: stored repr %s/scale %s != local %s/%s",
                 payload.get("dtype"), payload.get("kv_scale"),
-                jnp.dtype(self.cfg.cache_dtype), self.kv_scale,
+                jnp.dtype(self.cfg.cache_dtype), local_scale,
             )
             self.kv.free_sequence(alloc[0])
             return 0
@@ -781,6 +885,15 @@ class TpuEngine(AsyncEngine):
             self.kv.seal_block(bid, tb)
         self.kv.free_sequence(ids)
         return n * self.cfg.block_size
+
+    def _pin_prefix(self, token_ids: List[int]):
+        """Take references on the resident prefix blocks of ``token_ids``
+        (see generate(): keeps pre-admission sp/restore work alive)."""
+        from ..tokens import hash_token_blocks
+
+        return self.kv.acquire_prefix(
+            hash_token_blocks(token_ids, self.cfg.block_size)
+        )
 
     def estimate_prefix_hit(self, token_ids: List[int]) -> int:
         """Tokens of ``token_ids`` already resident locally (router input)."""
@@ -1468,9 +1581,11 @@ class TpuEngine(AsyncEngine):
         )
         # [L, Tg, 2KV, hd] → complete-block pages [L, n, bs, 2KV, hd]
         L = kv_rows.shape[0]
-        if self.kv_scale is not None and self.kv_scale != 1.0:
-            # Quantized cache stores value/scale (write_kv_ragged contract).
-            kv_rows = kv_rows.astype(jnp.float32) / self.kv_scale
+        if self.kv_scale is not None:
+            # Quantized cache stores value/scale (write_kv_ragged contract);
+            # per-layer calibration vectors broadcast over [L, Tg, 2KV, hd].
+            sc = np.asarray(self.kv_scale, np.float32).reshape(-1, 1, 1, 1)
+            kv_rows = kv_rows.astype(jnp.float32) / sc
         pages = kv_rows[:, : n_complete * bs].reshape(
             L, n_complete, bs, kv_rows.shape[2], kv_rows.shape[3]
         )[:, resident:]
@@ -1511,11 +1626,9 @@ class TpuEngine(AsyncEngine):
         # tail: the prefix blocks sit in the reuse pool and are otherwise
         # legitimate LRU eviction victims for our own allocations — which
         # would replace recompute-the-tail with recompute-everything.
-        prefix_ids: List[int] = []
-        if resident:
-            alloc = self.kv.allocate_sequence(blocks[:resident], resident)
-            if alloc is not None:
-                prefix_ids = alloc[0]
+        prefix_ids: List[int] = (
+            self.kv.acquire_prefix(blocks[:resident]) or [] if resident else []
+        )
         try:
             ids: List[int] = []
             for _ in run:
@@ -1652,7 +1765,9 @@ async def transfer_blocks_device(src: TpuEngine, dst: TpuEngine, token_ids) -> i
         return 0
     if src.cache.pages.shape[0] != dst.cache.pages.shape[0]:
         return 0  # different layer counts: not the same model
-    if src.cache.pages.dtype != dst.cache.pages.dtype or src.kv_scale != dst.kv_scale:
+    if src.cache.pages.dtype != dst.cache.pages.dtype or not _scales_close(
+        src._kv_scale_repr(), dst._kv_scale_repr()
+    ):
         return 0  # stored representation differs: host path will also refuse
     blocks = hash_token_blocks(token_ids, src.cfg.block_size)
     src_ids: List[int] = []

@@ -159,6 +159,23 @@ class KvBlockManager:
             ids.append(bid)
         return ids, len(matched) * self.block_size
 
+    def acquire_prefix(self, token_blocks: Sequence[TokenBlock]) -> Optional[List[int]]:
+        """Take references on the resident leading blocks WITHOUT touching
+        the hit-rate counters (pre-admission pinning is bookkeeping, not a
+        cache lookup — counting it would double-count every pinned prefix
+        and inflate gpu_prefix_cache_hit_rate)."""
+        matched = self.match_prefix(token_blocks)
+        if not matched:
+            return None
+        ids: List[int] = []
+        for bid in matched:
+            blk = self._blocks[bid]
+            if blk.ref_count == 0:
+                self._free_reusable.pop(bid, None)
+            blk.ref_count += 1
+            ids.append(bid)
+        return ids
+
     def allocate_block(self) -> Optional[int]:
         """One fresh anonymous block (decode growth)."""
         bid = self._take_free_block()

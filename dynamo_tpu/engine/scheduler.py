@@ -48,6 +48,11 @@ class SequenceState:
     ignore_eos: bool = False
 
     output: List[int] = field(default_factory=list)
+    # Reference-held prefix blocks (sp-prefill / host-restore sealed them
+    # just before admission): keeps the reuse-pool LRU from evicting the
+    # work between sealing and allocate_sequence.  Released by the
+    # scheduler once admission lands (or the request leaves the queue).
+    pin_ids: Optional[List[int]] = None
     # Original request prompt length.  Preemption folds generated tokens into
     # ``prompt`` for recompute, so stop checks and usage must count output as
     # total_tokens - orig_prompt_len, never len(output).
@@ -151,6 +156,12 @@ class Scheduler:
         if seq.block_ids:
             self.kv.free_sequence(seq.block_ids)
             seq.block_ids = []
+        self._release_pin(seq)
+
+    def _release_pin(self, seq: SequenceState) -> None:
+        if seq.pin_ids:
+            self.kv.free_sequence(seq.pin_ids)
+            seq.pin_ids = None
 
     # --------------------------------------------------------------- planning
     def schedule(self) -> Optional[StepPlan]:
@@ -218,10 +229,13 @@ class Scheduler:
                 break
             seq = self.waiting[0]
             if not self._try_admit(seq):
-                if not self.running and self.kv.active_blocks == 0:
-                    # Pool is entirely free and it still doesn't fit: this
+                own_pins = len(seq.pin_ids or [])
+                if not self.running and self.kv.active_blocks <= own_pins:
+                    # Pool entirely free (apart from this request's OWN
+                    # pre-admission pin) and it still doesn't fit: this
                     # request can never run — reject instead of deadlocking.
                     self.waiting.popleft()
+                    self._release_pin(seq)
                     self.rejected.append(seq)
                     continue
                 admission_blocked = True
@@ -271,6 +285,9 @@ class Scheduler:
             seq.block_seq = TokenBlockSequence(block_size=self.cfg.block_size)
             return False
         seq.block_ids, cached_tokens = alloc
+        # Admission holds its own references now; the pre-admission pin
+        # (sp-prefill / host-restore) has done its job.
+        self._release_pin(seq)
         # A fully-cached prompt must still recompute its last token to get
         # logits for sampling the first output token.
         if cached_tokens >= len(seq.prompt):

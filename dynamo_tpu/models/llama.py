@@ -136,7 +136,12 @@ def forward_ragged(
     *,
     attn_impl: str = "xla",  # "tpu" (pallas kernel) | "xla" (gather fallback)
     mesh=None,
-    kv_scale=None,  # static scale for quantized (fp8/int8) page dtypes
+    # Quantized (fp8/int8) page-dtype scale: a float, or a [L] per-layer
+    # calibration vector.  The scale is folded ALGEBRAICALLY around the
+    # attention call — stored = value/scale, q pre-scaled and the output
+    # post-scaled by scale — so per-layer values stay fully traceable (the
+    # pallas kernel's native k_scale/v_scale only accepts static floats).
+    kv_scale=None,
 ) -> Tuple[jnp.ndarray, PagedKVCache]:
     """Unified mixed prefill+decode forward over a flat ragged token run.
 
@@ -158,8 +163,19 @@ def forward_ragged(
     scale = hd**-0.5
     L, P_layer, ps = cache.pages.shape[0], cache.pages.shape[1], cache.pages.shape[2]
 
-    def attn_and_write(q, k, v, pages, slots, kv_lens, tables, cu, num):
-        pages = write_kv_ragged(pages, k, v, slots, kv_scale=kv_scale)
+    ks_vec = (
+        None
+        if kv_scale is None
+        else jnp.asarray(kv_scale, jnp.float32).reshape(-1)  # [1] or [L]
+    )
+
+    def attn_and_write(q, k, v, s_l, pages, slots, kv_lens, tables, cu, num):
+        # s_l: this layer's scale ([] f32) or None.  q·(K·s) == (q·s)·K and
+        # softmax(p)·(V·s) == (softmax(p)·V)·s, so scaling q in and the
+        # output back out dequantizes exactly without kernel support.
+        pages = write_kv_ragged(pages, k, v, slots, kv_scale=s_l)
+        if s_l is not None:
+            q = (q.astype(jnp.float32) * s_l).astype(q.dtype)
         out = ragged_attention(
             q,
             pages,
@@ -169,8 +185,9 @@ def forward_ragged(
             num,
             sm_scale=scale,
             impl=attn_impl,
-            kv_scale=kv_scale,
         )
+        if s_l is not None:
+            out = (out.astype(jnp.float32) * s_l).astype(out.dtype)
         return out, pages
 
     if mesh is not None:
@@ -179,16 +196,31 @@ def forward_ragged(
 
         heads = P(None, "tp", None)  # [T, heads, hd]
         pages_s = P(None, None, "tp", None)  # [L*pages, page_size, 2KV, hd]
-        rep = P()  # ragged metadata: replicated on every shard
-        attn_and_write = shard_map(
-            attn_and_write,
-            mesh=mesh,
-            in_specs=(heads, heads, heads, pages_s, rep, rep, rep, rep, rep),
-            out_specs=(heads, pages_s),
-            # Outputs are tp-sharded only — skip the strict replication
-            # (varying-mesh-axes) check for the dp/ep axes.
-            check_vma=False,
-        )
+        rep = P()  # ragged metadata + scale: replicated on every shard
+        inner = attn_and_write
+
+        def attn_and_write(q, k, v, s_l, pages, slots, kv_lens, tables, cu, num):
+            if s_l is None:
+                mapped = shard_map(
+                    lambda q, k, v, *rest: inner(q, k, v, None, *rest),
+                    mesh=mesh,
+                    in_specs=(heads, heads, heads, pages_s,
+                              rep, rep, rep, rep, rep),
+                    out_specs=(heads, pages_s),
+                    # Outputs are tp-sharded only — skip the strict
+                    # replication check for the dp/ep axes.
+                    check_vma=False,
+                )
+                return mapped(q, k, v, pages, slots, kv_lens, tables, cu, num)
+            mapped = shard_map(
+                inner,
+                mesh=mesh,
+                in_specs=(heads, heads, heads, rep, pages_s,
+                          rep, rep, rep, rep, rep),
+                out_specs=(heads, pages_s),
+                check_vma=False,
+            )
+            return mapped(q, k, v, s_l, pages, slots, kv_lens, tables, cu, num)
 
     h = params["embed"][rb.token_ids]  # [T, D]
 
@@ -213,8 +245,13 @@ def forward_ragged(
             rb.slot_mapping < 0, -1, rb.slot_mapping + l * (P_layer * ps)
         )
         tables_l = rb.page_indices + l * P_layer
+        s_l = (
+            None
+            if ks_vec is None
+            else ks_vec[jnp.minimum(l, ks_vec.shape[0] - 1)]
+        )
         attn, pages = attn_and_write(
-            q, k, v, pages, slots_l, rb.kv_lens,
+            q, k, v, s_l, pages, slots_l, rb.kv_lens,
             tables_l, rb.cu_q_lens, rb.num_seqs,
         )
         h = h + attn.reshape(T, H * hd) @ lp["wo"]

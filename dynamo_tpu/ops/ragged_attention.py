@@ -47,7 +47,7 @@ def write_kv_ragged(
     k_new: jnp.ndarray,  # [T, kv_heads, head_dim]
     v_new: jnp.ndarray,  # [T, kv_heads, head_dim]
     slot_mapping: jnp.ndarray,  # [T] int32 flat slot ids; -1 = padding (dropped)
-    kv_scale: float | None = None,  # quantized cache: store value/scale
+    kv_scale=None,  # quantized cache: store value/scale (float OR traced scalar)
 ) -> jnp.ndarray:
     """Scatter new K/V rows into their cache slots (one combined scatter)."""
     P, ps, KV2, D = pages.shape
@@ -55,7 +55,9 @@ def write_kv_ragged(
     # Interleave to the combined layout: [T, KV, 2, D] -> [T, 2KV, D]
     # puts k_h at combined index 2h and v_h at 2h+1.
     comb = jnp.stack([k_new, v_new], axis=2).reshape(T, KV2, D)
-    if kv_scale is not None and kv_scale != 1.0:
+    if kv_scale is not None:
+        # kv_scale may be a per-layer traced scalar (the layer scan indexes
+        # a [L] calibration vector), so no Python != 1.0 fast path here.
         comb = comb.astype(jnp.float32) / kv_scale
     if jnp.issubdtype(pages.dtype, jnp.integer):
         # Integer caches: round-to-nearest (astype truncates toward zero,
@@ -104,6 +106,12 @@ def ragged_attention(
         ps, KV2, hd = pages.shape[1], pages.shape[2], pages.shape[3]
         nkv = max(1, (4 << 20) // max(1, 2 * ps * KV2 * hd * 2))
         nkv = min(page_indices.shape[1], nkv)
+        # Quantized (1-byte) pages: real scaling is folded around this call
+        # by the model (q pre-scaled, output post-scaled — models/llama.py),
+        # but the kernel only CASTS fp8/int8 K/V up to q's dtype inside its
+        # `if k_scale is not None` branch — so a unit scale must be passed
+        # or raw quantized values feed the MXU dot and tracing rejects.
+        unit = 1.0 if pages.dtype.itemsize == 1 and kv_scale is None else kv_scale
         try:
             return ragged_paged_attention(
                 q,
@@ -118,8 +126,8 @@ def ragged_attention(
                 # not the hardware ceiling; long-context shapes need headroom
                 # (vLLM's TPU backend raises it the same way).
                 vmem_limit_bytes=64 << 20,
-                k_scale=kv_scale,
-                v_scale=kv_scale,
+                k_scale=unit,
+                v_scale=unit,
             )
         except Exception as e:  # trace-time rejection
             # The kernel enforces its own contract during tracing.  Only

@@ -125,6 +125,31 @@ def test_cli_three_process_serving():
                 pass
 
 
+def _disagg_stats(hub_addr: str) -> dict:
+    """Query the decode worker's disagg_stats endpoint over the hub."""
+    import asyncio
+
+    async def main():
+        from dynamo_tpu.runtime.component import DistributedRuntime
+        from dynamo_tpu.runtime.engine import Context, collect
+
+        runtime = await DistributedRuntime.connect(hub_addr)
+        try:
+            ep = (
+                runtime.namespace("dynamo")
+                .component("TpuWorker")
+                .endpoint("disagg_stats")
+            )
+            client = await ep.client()
+            await client.wait_for_instances(1)
+            items = await collect(await client.generate(Context({})))
+            return items[0]
+        finally:
+            await runtime.close()
+
+    return asyncio.run(main())
+
+
 def test_cli_disaggregated_serving():
     """Hub + dedicated prefill worker + disagg decode worker + frontend as
     four CLI processes; a long prompt (above --max-local-prefill) goes
@@ -182,6 +207,15 @@ def test_cli_disaggregated_serving():
         assert body["choices"][0]["finish_reason"] == "length"
         assert body["usage"]["completion_tokens"] == 5
         assert body["usage"]["prompt_tokens"] == 60
+
+        # The request completing is NOT enough: on remote-prefill timeout
+        # the decode worker silently falls back to local prefill and the
+        # assertions above still pass.  The stats endpoint must prove the
+        # remote path actually ran (VERDICT r3 weak #5).
+        stats = _disagg_stats(hub)
+        assert stats["remote_prefills"] >= 1, stats
+        assert stats["local_prefills"] == 0, f"timeout fallback ran: {stats}"
+        assert stats["transfer_ms_last"] is not None
     finally:
         for p in procs:
             p.kill()
