@@ -265,10 +265,20 @@ async def main() -> None:
         for conc in levels:
             n = min(args.rpc * conc, args.max_requests)
             print(f"loadgen: conc={conc} n={n} ...", file=sys.stderr)
+            if engine is not None:
+                engine.step_trace.clear()
             row = await _sweep_level(url, args.model, conc, n, args.isl,
                                      args.osl, vocab)
             rows.append(row)
             print(json.dumps(row), flush=True)
+            if engine is not None:
+                print(
+                    f"loadgen: steps {json.dumps(engine.step_summary())} "
+                    f"preempted={engine.scheduler.preempted} "
+                    f"kv_usage={engine.kv.usage:.2f} "
+                    f"waiting={engine.scheduler.num_waiting}",
+                    file=sys.stderr,
+                )
     finally:
         if service is not None:
             await service.close()

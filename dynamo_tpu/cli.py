@@ -168,6 +168,15 @@ async def _run(args) -> None:
             )
         served_engine = engine
         cleanups = []
+        if getattr(args, "record", None):
+            # Tap every request/response stream to JSONL (reference:
+            # recorder.rs) — replayable via runtime.recorder.replay_into.
+            from .runtime.recorder import RecordingEngine, StreamRecorder
+
+            recorder = StreamRecorder(args.record)
+            served_engine = engine = RecordingEngine(engine, recorder)
+            cleanups.append(lambda: asyncio.to_thread(recorder.close))
+            print(f"recording streams to {args.record}", flush=True)
 
         if role == "prefill":
             # Dedicated prefill worker: drains the queue; serves no endpoint.
@@ -455,6 +464,11 @@ def main(argv: Optional[list] = None) -> None:
         choices=["auto", "xla", "pallas", "jax"],
         dest="attn_impl",
         help="decode attention backend",
+    )
+    p_run.add_argument(
+        "--record", default=None,
+        help="capture every request/response stream to this JSONL file "
+        "(replayable — runtime/recorder.py)",
     )
     p_run.add_argument(
         "--disagg",

@@ -137,8 +137,9 @@ class TpuEngine(AsyncEngine):
 
             self.host_kv = HostKvStore(cfg.host_cache_bytes)
         # Per-dispatch trace: (kind, wall_s, rows, device_tokens); the
-        # pipeline records dispatch and fetch separately since they overlap.
-        self.step_trace: List[Tuple[str, float, int, int]] = []
+        # pipeline records dispatch and fetch separately since they
+        # overlap.  Bounded: a long-lived server must not grow it forever.
+        self.step_trace: deque = deque(maxlen=65536)
         # Mixed-phase cadence: prefill chunks run since the last decode
         # burst (see _run_loop).
         self._chunks_since_burst = 0

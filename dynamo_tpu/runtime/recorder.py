@@ -1,0 +1,130 @@
+"""General timestamped stream record/replay.
+
+Reference counterpart: lib/llm/src/recorder.rs (674 LoC) — capture ANY
+request/response stream to JSONL with timestamps, replay it later with or
+without the original pacing (debugging, billing audit, load reproduction).
+The KV-event recorder (llm/kv_router/recorder.py) is the specialized
+sibling; this one wraps arbitrary engines/streams.
+
+Line format (one JSON object per line):
+  {"ts": <epoch s>, "stream": <id>, "kind": "request"|"item"|"end",
+   "data": <payload>}
+
+Usage:
+  rec = StreamRecorder(path)
+  engine = RecordingEngine(inner_engine, rec)   # drop-in AsyncEngine wrap
+  ...
+  async for req, items in replay_streams(path): ...        # audit
+  await replay_into(path, engine, timed=True)              # load replay
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Any, AsyncIterator, Dict, List, Optional, TextIO, Tuple
+
+from .engine import AsyncEngine, Context, ResponseStream
+
+
+class StreamRecorder:
+    """Append-only JSONL for timestamped multi-stream capture."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh: Optional[TextIO] = open(path, "a", encoding="utf-8")
+        self.count = 0
+
+    def record(self, stream: str, kind: str, data: Any) -> None:
+        assert self._fh is not None, "recorder closed"
+        self._fh.write(
+            json.dumps(
+                {"ts": time.time(), "stream": stream, "kind": kind, "data": data}
+            )
+            + "\n"
+        )
+        self.count += 1
+
+    def flush(self) -> None:
+        if self._fh is not None:
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class RecordingEngine(AsyncEngine):
+    """Drop-in AsyncEngine wrapper: records every request and every
+    response item flowing through, without altering either (the reference
+    wires its recorder the same way, as a pipeline tap)."""
+
+    def __init__(self, inner: AsyncEngine, recorder: StreamRecorder):
+        self.inner = inner
+        self.recorder = recorder
+
+    def __getattr__(self, name):  # passthrough (metrics, stats, close, ...)
+        return getattr(self.inner, name)
+
+    async def generate(self, request: Context) -> ResponseStream:
+        sid = request.id or uuid.uuid4().hex
+        self.recorder.record(sid, "request", request.data)
+        inner_stream = await self.inner.generate(request)
+        rec = self.recorder
+
+        async def tap() -> AsyncIterator[Any]:
+            try:
+                async for item in inner_stream:
+                    rec.record(sid, "item", item)
+                    yield item
+            finally:
+                rec.record(sid, "end", None)
+                rec.flush()
+
+        return ResponseStream(tap(), request.ctx)
+
+
+def load_streams(path: str) -> List[Tuple[Dict[str, Any], List[Any], List[float]]]:
+    """Parse a recording into [(request, items, timestamps)] per stream,
+    in request order."""
+    streams: Dict[str, Tuple[Dict[str, Any], List[Any], List[float]]] = {}
+    order: List[str] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            sid = row["stream"]
+            if row["kind"] == "request":
+                streams[sid] = (row["data"], [], [row["ts"]])
+                order.append(sid)
+            elif row["kind"] == "item" and sid in streams:
+                streams[sid][1].append(row["data"])
+                streams[sid][2].append(row["ts"])
+    return [streams[sid] for sid in order if sid in streams]
+
+
+async def replay_into(
+    path: str, engine: AsyncEngine, timed: bool = False
+) -> List[List[Any]]:
+    """Re-issue every recorded request against ``engine`` (in recorded
+    order; with ``timed`` the original inter-request gaps are honored).
+    Returns each replayed stream's items — diffable against the recording
+    for regression audits."""
+    rows = load_streams(path)
+    out: List[List[Any]] = []
+    prev_ts: Optional[float] = None
+    for request, _items, tss in rows:
+        if timed and prev_ts is not None:
+            await asyncio.sleep(max(0.0, tss[0] - prev_ts))
+        prev_ts = tss[0]
+        stream = await engine.generate(Context(request))
+        got = []
+        async for item in stream:
+            got.append(item)
+        out.append(got)
+    return out

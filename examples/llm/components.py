@@ -60,7 +60,7 @@ class TpuWorker:
         await register_model(
             self.runtime,
             self.config.get("served_model_name", "example-model"),
-            "examples/TpuWorker/generate",
+            "examples.TpuWorker.generate",  # ns.component.endpoint
             tokenizer={"kind": "byte"},
             kv_block_size=cfg.block_size,
         )

@@ -102,3 +102,57 @@ async def test_static_model_registration_survives_registrar():
     finally:
         await rt2.close()
         await hub.close()
+
+
+# ------------------------------------------------------- general recorder
+def test_stream_recorder_record_and_replay(tmp_path):
+    """General request/response record + replay (reference recorder.rs):
+    a wrapped engine taps streams to JSONL; replay re-issues the requests
+    and reproduces the same outputs (deterministic greedy engine)."""
+    import asyncio
+
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.llm.protocols import PreprocessedRequest, StopConditions
+    from dynamo_tpu.runtime.engine import Context, collect
+    from dynamo_tpu.runtime.recorder import (
+        RecordingEngine,
+        StreamRecorder,
+        load_streams,
+        replay_into,
+    )
+
+    path = str(tmp_path / "streams.jsonl")
+    cfg = EngineConfig(
+        model="debug-tiny", block_size=4, num_blocks=64, max_batch=2,
+        max_model_len=64, prefill_chunk=16, dtype="float32",
+    )
+
+    async def main():
+        inner = TpuEngine(cfg)
+        rec = StreamRecorder(path)
+        engine = RecordingEngine(inner, rec)
+        outs = []
+        for prompt in ([1, 2, 3], [9, 8, 7, 6]):
+            req = PreprocessedRequest(
+                token_ids=prompt,
+                stop_conditions=StopConditions(max_tokens=5, ignore_eos=True),
+            )
+            outs.append(
+                await collect(await engine.generate(Context(req.to_dict())))
+            )
+        rec.close()
+
+        rows = load_streams(path)
+        assert len(rows) == 2
+        for (request, items, tss), live in zip(rows, outs):
+            assert items == live  # every stream item captured verbatim
+            assert len(tss) >= len(items)
+            assert tss == sorted(tss)  # timestamps monotone
+
+        # Replay against the same (deterministic) engine → same outputs.
+        replayed = await replay_into(path, inner)
+        assert replayed == outs
+        await inner.close()
+
+    asyncio.run(main())
