@@ -175,6 +175,8 @@ async def _run(args) -> None:
 
             recorder = StreamRecorder(args.record)
             served_engine = engine = RecordingEngine(engine, recorder)
+            # Streams still draining at shutdown record into a closed
+            # recorder — record() drops those instead of raising.
             cleanups.append(lambda: asyncio.to_thread(recorder.close))
             print(f"recording streams to {args.record}", flush=True)
 

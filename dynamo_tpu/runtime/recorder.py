@@ -38,7 +38,13 @@ class StreamRecorder:
         self.count = 0
 
     def record(self, stream: str, kind: str, data: Any) -> None:
-        assert self._fh is not None, "recorder closed"
+        # Tolerate records after close (a stream still draining during
+        # shutdown must not blow up its teardown) — they are dropped.
+        # Writes are synchronous line appends; heavy production capture
+        # should point at fast local disk (the reference's recorder has
+        # the same property).
+        if self._fh is None or self._fh.closed:
+            return
         self._fh.write(
             json.dumps(
                 {"ts": time.time(), "stream": stream, "kind": kind, "data": data}
@@ -92,6 +98,7 @@ def load_streams(path: str) -> List[Tuple[Dict[str, Any], List[Any], List[float]
     in request order."""
     streams: Dict[str, Tuple[Dict[str, Any], List[Any], List[float]]] = {}
     order: List[str] = []
+    live: Dict[str, str] = {}  # raw sid → current unique key
     with open(path, encoding="utf-8") as f:
         for line in f:
             line = line.strip()
@@ -100,31 +107,52 @@ def load_streams(path: str) -> List[Tuple[Dict[str, Any], List[Any], List[float]
             row = json.loads(line)
             sid = row["stream"]
             if row["kind"] == "request":
-                streams[sid] = (row["data"], [], [row["ts"]])
-                order.append(sid)
-            elif row["kind"] == "item" and sid in streams:
-                streams[sid][1].append(row["data"])
-                streams[sid][2].append(row["ts"])
-    return [streams[sid] for sid in order if sid in streams]
+                # Request ids are client-settable and files append across
+                # runs, so a sid can repeat — keep every occurrence
+                # distinct instead of silently dropping the earlier one.
+                key = sid
+                n = 1
+                while key in streams:
+                    key = f"{sid}#{n}"
+                    n += 1
+                live[sid] = key
+                streams[key] = (row["data"], [], [row["ts"]])
+                order.append(key)
+            elif row["kind"] == "item" and live.get(sid) in streams:
+                key = live[sid]
+                streams[key][1].append(row["data"])
+                streams[key][2].append(row["ts"])
+    return [streams[key] for key in order]
 
 
 async def replay_into(
     path: str, engine: AsyncEngine, timed: bool = False
 ) -> List[List[Any]]:
-    """Re-issue every recorded request against ``engine`` (in recorded
-    order; with ``timed`` the original inter-request gaps are honored).
-    Returns each replayed stream's items — diffable against the recording
-    for regression audits."""
+    """Re-issue every recorded request against ``engine``.  Untimed:
+    strictly serial, in recorded order (deterministic audit diffs).
+    Timed: every request LAUNCHES at its recorded offset from the first —
+    overlapping recorded load replays as overlapping load, which is the
+    point of load reproduction.  Returns each stream's items in recorded
+    request order."""
     rows = load_streams(path)
-    out: List[List[Any]] = []
-    prev_ts: Optional[float] = None
-    for request, _items, tss in rows:
-        if timed and prev_ts is not None:
-            await asyncio.sleep(max(0.0, tss[0] - prev_ts))
-        prev_ts = tss[0]
+    if not rows:
+        return []
+
+    async def one(request) -> List[Any]:
         stream = await engine.generate(Context(request))
-        got = []
-        async for item in stream:
-            got.append(item)
-        out.append(got)
-    return out
+        return [item async for item in stream]
+
+    if not timed:
+        return [await one(request) for request, _items, _tss in rows]
+
+    t0 = rows[0][2][0]
+
+    async def timed_one(request, offset: float) -> List[Any]:
+        await asyncio.sleep(max(0.0, offset))
+        return await one(request)
+
+    return list(
+        await asyncio.gather(
+            *(timed_one(req, tss[0] - t0) for req, _items, tss in rows)
+        )
+    )
