@@ -129,10 +129,10 @@ class TpuEngine(AsyncEngine):
         self._offload_queue: List[Tuple[int, Any]] = []
         self._offload_task: Optional[asyncio.Task] = None
         if cfg.host_cache_bytes > 0:
-            if jax.process_count() > 1:
-                # Sharded pages can't be gathered to one host's RAM; a
-                # per-host sharded store is future work.
-                raise ValueError("host_cache_bytes requires a single process")
+            # Multi-process: every host keeps a PER-HOST SHARDED tier — it
+            # stores only the shards its own devices hold (gathers and
+            # restores ride the leader→follower mirror plane, so all
+            # processes run the same device programs in the same order).
             from .host_cache import HostKvStore
 
             self.host_kv = HostKvStore(cfg.host_cache_bytes)
@@ -513,6 +513,14 @@ class TpuEngine(AsyncEngine):
 
             async with self._device_lock:
                 await asyncio.to_thread(run_i)
+        elif kind == "offload":
+            ids, hashes = payload
+            async with self._device_lock:
+                await asyncio.to_thread(self._offload_store, ids, hashes)
+        elif kind == "restore_host":
+            page_ids, hashes = payload
+            async with self._device_lock:
+                await asyncio.to_thread(self._restore_inject, page_ids, hashes)
         else:
             raise ValueError(f"unknown mirror step kind {kind!r}")
 
@@ -648,6 +656,12 @@ class TpuEngine(AsyncEngine):
         ):
             # Long prompt: one sequence-parallel whole-prompt pass seals the
             # complete blocks ahead of admission (ring attention over "sp").
+            # DELIBERATELY single-process: sp prefill is scoped to dedicated
+            # disagg PREFILL WORKERS (cli run --disagg prefill --sp N), each
+            # a single-host engine owning its own sp mesh — decode fleets
+            # scale across hosts via dp/tp while prefill workers ring over
+            # their local slice and ship blocks through the KV transfer
+            # plane (the reference's disagg split, docs/architecture.md).
             prepared += await self._sp_prefill(list(pre.token_ids))
         seq = SequenceState.from_request(request.id, pre, self.cfg)
         if prepared:
@@ -1542,12 +1556,44 @@ class TpuEngine(AsyncEngine):
             pad = 1 << max(0, (len(live) - 1).bit_length())
             ids = np.zeros((pad,), np.int32)
             ids[: len(live)] = [bid for bid, _ in live]
-            pages = await asyncio.to_thread(
-                lambda: np.asarray(self._gather_fn(self.cache, ids))
-            )
-        for i, (_, tb) in enumerate(live):
-            self.host_kv.put(tb.sequence_hash, np.ascontiguousarray(pages[:, i]))
+            hashes = [tb.sequence_hash for _, tb in live]
+            # Leader stores FIRST, publish only on success — still under
+            # the device lock, so no other dispatch can interleave and the
+            # followers' execution position matches the leader's.  A
+            # leader-side failure then leaves every tier unchanged instead
+            # of followers holding blocks the leader lacks (tier skew would
+            # surface later as a fatal restore divergence).
+            await asyncio.to_thread(self._offload_store, ids, hashes)
+            if self._publisher is not None:
+                await self._publisher.publish("offload", (ids, hashes))
         return len(live)
+
+    def _offload_store(self, ids: np.ndarray, hashes: List[int]) -> None:
+        """Gather ``ids``'s pages and store THIS PROCESS's portion in the
+        host tier.  Single-process: the whole block (contiguous, one
+        array).  Multi-process: one slice per locally-held shard, keyed by
+        the shard's heads-axis offset (combined-head axis 3)."""
+        # _prep: in multi-process runs the gather's index operand must be a
+        # replicated GLOBAL array like every other mirrored dispatch.
+        pages_g = self._gather_fn(self.cache, self._prep(ids))
+        if jax.process_count() == 1:
+            pages = np.asarray(pages_g)
+            for i, h in enumerate(hashes):
+                self.host_kv.put(h, np.ascontiguousarray(pages[:, i]))
+            return
+        shards: Dict[int, np.ndarray] = {}
+        for s in pages_g.addressable_shards:
+            start = s.index[3].start or 0
+            if start not in shards:
+                shards[start] = np.asarray(s.data)
+        for i, h in enumerate(hashes):
+            self.host_kv.put(
+                h,
+                {
+                    start: np.ascontiguousarray(arr[:, i])
+                    for start, arr in shards.items()
+                },
+            )
 
     async def _sp_prefill(self, token_ids: List[int]) -> int:
         """Whole-prompt sequence-parallel prefill: compute the prompt's KV in
@@ -1616,7 +1662,10 @@ class TpuEngine(AsyncEngine):
         resident = len(self.kv.match_prefix(blocks))
         run: List[Tuple[Any, np.ndarray]] = []
         for tb in blocks[resident:]:
-            host = self.host_kv.get(tb.sequence_hash)
+            # peek, not get: this is candidate selection (possibly
+            # truncated below); touching the LRU here would diverge the
+            # leader's eviction order from the followers'.
+            host = self.host_kv.peek(tb.sequence_hash)
             if host is None:
                 break
             run.append((tb, host))
@@ -1645,15 +1694,48 @@ class TpuEngine(AsyncEngine):
             pad = 1 << max(0, (n - 1).bit_length())
             page_ids = np.full((pad,), self.cfg.num_blocks, np.int32)  # OOB pad
             page_ids[:n] = ids
-            comb = np.stack([h for _, h in run], axis=1)  # [L, n, ps, 2KV, hd]
-            comb_p = np.zeros(comb.shape[:1] + (pad,) + comb.shape[2:], comb.dtype)
-            comb_p[:, :n] = comb
-            async with self._device_lock:
-                if self._publisher is not None:
-                    await self._publisher.publish("inject", (page_ids, comb_p))
-                self.cache = await asyncio.to_thread(
-                    self._inject_fn, self.cache, *self._prep((page_ids, comb_p))
+            if jax.process_count() > 1:
+                # Per-host sharded tier: every process reassembles ITS
+                # devices' slice of each block from its own store — the
+                # broadcast carries only ids + hashes, never page data.
+                hashes = [tb.sequence_hash for tb, _ in run]
+                async with self._device_lock:
+                    # Revalidate UNDER the lock: the offload pump may have
+                    # LRU-evicted a candidate while we awaited it.  Tiers
+                    # mutate only under this lock and in broadcast order,
+                    # so leader-present-here implies follower-present-there;
+                    # a miss now means recompute-prefill, not a crash.
+                    if any(
+                        not isinstance(self.host_kv.peek(h), dict)
+                        for h in hashes
+                    ):
+                        self.kv.free_sequence(ids)
+                        return 0
+                    # Inject locally first; publish only on success (same
+                    # ordering argument as drain_offload).
+                    await asyncio.to_thread(
+                        self._restore_inject, page_ids, hashes
+                    )
+                    if self._publisher is not None:
+                        await self._publisher.publish(
+                            "restore_host", (page_ids, hashes)
+                        )
+            else:
+                comb = np.stack([h for _, h in run], axis=1)  # [L,n,ps,2KV,hd]
+                comb_p = np.zeros(
+                    comb.shape[:1] + (pad,) + comb.shape[2:], comb.dtype
                 )
+                comb_p[:, :n] = comb
+                async with self._device_lock:
+                    if self._publisher is not None:
+                        await self._publisher.publish(
+                            "inject", (page_ids, comb_p)
+                        )
+                    self.cache = await asyncio.to_thread(
+                        self._inject_fn,
+                        self.cache,
+                        *self._prep((page_ids, comb_p)),
+                    )
             for bid, (tb, _) in zip(ids, run):
                 self.kv.seal_block(bid, tb)
             self.kv.free_sequence(ids)
@@ -1662,6 +1744,65 @@ class TpuEngine(AsyncEngine):
         finally:
             if prefix_ids:
                 self.kv.free_sequence(prefix_ids)
+
+    def _restore_inject(self, page_ids: np.ndarray, hashes: List[int]) -> None:
+        """Multi-process host restore: build this process's devices' slices
+        of the [L, pad, ps, 2KV, hd] block stack from the per-host sharded
+        tier and scatter them into the cache (every process runs this — the
+        leader inline, followers via the 'restore_host' mirror step)."""
+        from jax.sharding import NamedSharding
+
+        from ..parallel.mesh import pages_pspec
+
+        L, _, ps, KV2, hd = self.cache.pages.shape
+        pad = int(page_ids.shape[0])
+        shape = (L, pad, ps, KV2, hd)
+        sharding = NamedSharding(self.mesh, pages_pspec())
+        # Touch each hash exactly once (same broadcast order on every
+        # process → identical LRU order), then build ONE local stack per
+        # distinct head-shard offset — local devices sharing an offset
+        # (dp/ep replicas) reuse the same array.
+        fetched = []
+        for h in hashes:
+            blk = self.host_kv.get(h)
+            if not isinstance(blk, dict):
+                # Tiers mutate only in broadcast order, so after the
+                # leader's under-lock revalidation this cannot happen on a
+                # healthy deployment — fail LOUDLY rather than inject
+                # zeros under a valid hash.
+                raise RuntimeError(f"host tier missing block {h:#x}")
+            fetched.append(blk)
+        idx_map = sharding.addressable_devices_indices_map(shape)
+        locals_by_start: Dict[int, np.ndarray] = {}
+        for index in idx_map.values():
+            start = index[3].start or 0
+            if start in locals_by_start:
+                continue
+            parts = []
+            for h, blk in zip(hashes, fetched):
+                if start not in blk:
+                    raise RuntimeError(
+                        f"host tier missing shard {start} of block {h:#x}"
+                    )
+                parts.append(blk[start])  # [L, ps, local_heads, hd]
+            local = np.stack(parts, axis=1)  # [L, n, ps, lh, hd]
+            if pad != len(hashes):
+                z = np.zeros(
+                    local.shape[:1] + (pad,) + local.shape[2:], local.dtype
+                )
+                z[:, : len(hashes)] = local
+                local = z
+            locals_by_start[start] = local
+        arrays = [
+            jax.device_put(locals_by_start[index[3].start or 0], dev)
+            for dev, index in idx_map.items()
+        ]
+        comb = jax.make_array_from_single_device_arrays(
+            shape, sharding, arrays
+        )
+        self.cache = self._inject_fn(
+            self.cache, self._prep(page_ids), comb
+        )
 
     def _lp_info(
         self, seq: SequenceState, i: int, logp, top_ids, top_lp

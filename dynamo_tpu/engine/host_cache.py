@@ -22,16 +22,28 @@ import numpy as np
 
 
 class HostKvStore:
-    """hash → one block's pages [L, page_size, 2*kv_heads, head_dim]."""
+    """hash → one block's pages [L, page_size, 2*kv_heads, head_dim].
+
+    Multi-host deployments store a PER-HOST SHARD instead: a dict mapping
+    the combined-head-axis offset of each locally-held shard to its slice
+    (engine._offload_store) — each process's tier holds only what its own
+    devices held, and restores reassemble the global array from every
+    process's local contribution."""
 
     def __init__(self, capacity_bytes: int):
         self.capacity_bytes = capacity_bytes
-        self._data: "OrderedDict[int, np.ndarray]" = OrderedDict()
+        self._data: "OrderedDict[int, object]" = OrderedDict()
         self._bytes = 0
         # counters (metrics / tests)
         self.stored_blocks = 0
         self.restored_blocks = 0
         self.evicted_blocks = 0
+
+    @staticmethod
+    def _nbytes(block) -> int:
+        if isinstance(block, dict):
+            return sum(a.nbytes for a in block.values())
+        return block.nbytes
 
     def __len__(self) -> int:
         return len(self._data)
@@ -43,16 +55,16 @@ class HostKvStore:
     def contains(self, seq_hash: int) -> bool:
         return seq_hash in self._data
 
-    def put(self, seq_hash: int, block: np.ndarray) -> None:
+    def put(self, seq_hash: int, block) -> None:
         if seq_hash in self._data:
             self._data.move_to_end(seq_hash)
             return
-        nbytes = block.nbytes
+        nbytes = self._nbytes(block)
         if nbytes > self.capacity_bytes:
             return
         while self._bytes + nbytes > self.capacity_bytes and self._data:
             _, old = self._data.popitem(last=False)  # LRU
-            self._bytes -= old.nbytes
+            self._bytes -= self._nbytes(old)
             self.evicted_blocks += 1
         self._data[seq_hash] = block
         self._bytes += nbytes
@@ -63,3 +75,10 @@ class HostKvStore:
         if blk is not None:
             self._data.move_to_end(seq_hash)  # touch
         return blk
+
+    def peek(self, seq_hash: int):
+        """Read WITHOUT the LRU touch.  Multi-host tiers must mutate in
+        broadcast order only — a leader-local speculative read (candidate
+        selection that may be truncated before the restore is broadcast)
+        must not reorder the leader's LRU relative to the followers'."""
+        return self._data.get(seq_hash)

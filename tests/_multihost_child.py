@@ -1,8 +1,10 @@
 """Child process for tests/test_multihost.py.
 
-Usage: python _multihost_child.py <role> <coordinator_port> <step_port>
+Usage: python _multihost_child.py <role> <coordinator_port> <step_port> [mode]
 Roles: leader (rank 0 of 2), follower (rank 1 of 2), single (one process,
 8 local devices — the reference output the 2-process run must match).
+Mode "hostcache" enables the per-host sharded KV offload tier and drives an
+offload → HBM-flood → restore cycle (leader prints restored-block proof).
 Prints one JSON line with the generated tokens (leader/single).
 """
 
@@ -11,6 +13,7 @@ import json
 import sys
 
 ROLE, COORD_PORT, STEP_PORT = sys.argv[1], sys.argv[2], sys.argv[3]
+MODE = sys.argv[4] if len(sys.argv) > 4 else ""
 
 from dynamo_tpu.parallel.distributed import MultiHostConfig, init_multihost
 
@@ -38,7 +41,7 @@ from dynamo_tpu.runtime.engine import Context, collect
 CFG = EngineConfig(
     model="debug-tiny",
     block_size=4,
-    num_blocks=64,
+    num_blocks=16 if MODE == "hostcache" else 64,  # tiny pool → evictions
     max_batch=4,
     max_model_len=64,
     prefill_chunk=32,
@@ -46,6 +49,7 @@ CFG = EngineConfig(
     tp=2,
     dtype="float32",
     decode_steps=4,
+    host_cache_bytes=(64 << 20) if MODE == "hostcache" else 0,
 )
 
 PROMPTS = [[1, 2, 3, 4, 5], [9, 8, 7]]
@@ -73,6 +77,41 @@ async def generate_all(engine):
     )
 
 
+async def hostcache_cycle(engine):
+    """Offload a prompt's blocks, flood HBM to evict them, then re-serve
+    the prompt: the tokens must be identical and the restore must have
+    come from the per-host sharded tier."""
+    prompt = list(range(1, 13))  # 3 full blocks
+    first = await one_greedy(engine, prompt)
+    for _ in range(100):
+        await engine.drain_offload()
+        if len(engine.host_kv) >= 3:
+            break
+        await asyncio.sleep(0.02)
+    assert len(engine.host_kv) >= 3, "offload never stored"
+    for base in (20, 40, 60, 80, 100, 120):  # flood the 16-block pool
+        await one_greedy(engine, [base + i for i in range(12)])
+        await engine.drain_offload()
+    from dynamo_tpu.tokens import hash_token_blocks
+
+    assert len(engine.kv.match_prefix(hash_token_blocks(prompt, 4))) < 3
+    again = await one_greedy(engine, prompt)
+    return {
+        "match": again == first,
+        "restored": engine.host_kv.restored_blocks,
+    }
+
+
+async def one_greedy(engine, p):
+    req = PreprocessedRequest(
+        token_ids=p,
+        stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0),
+    ).to_dict()
+    out = await collect(await engine.generate(Context(req)))
+    return [t for item in out for t in item["token_ids"]]
+
+
 async def main() -> None:
     engine = TpuEngine(CFG)
     if ROLE == "leader":
@@ -81,6 +120,11 @@ async def main() -> None:
         pub = await StepPublisher("127.0.0.1", int(STEP_PORT), 1).start()
         engine.attach_publisher(pub)
         await engine.run_warmup()
+        if MODE == "hostcache":
+            proof = await hostcache_cycle(engine)
+            await engine.close()
+            print("RESULT " + json.dumps(proof), flush=True)
+            return
         toks = await generate_all(engine)
         await engine.close()
         print("RESULT " + json.dumps(toks), flush=True)
