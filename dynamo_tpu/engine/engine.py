@@ -143,6 +143,15 @@ class TpuEngine(AsyncEngine):
         # Mixed-phase cadence: prefill chunks run since the last decode
         # burst (see _run_loop).
         self._chunks_since_burst = 0
+        # Deferred token fetches (FIFO).  Prompt-completing unified steps
+        # AND mixed-phase decode bursts start their token D2H
+        # asynchronously, park their rows (awaiting_fetch), and keep the
+        # loop dispatching; accepts happen at harvest points once the
+        # round trip has overlapped with real work.  r4 measured one
+        # blocking ~230ms fetch per request plus ~230ms of queue+RTT per
+        # burst on the tunneled chip — together over half of
+        # mid-concurrency wall time.
+        self._pending_fetches: List[Tuple] = []
 
         # --- device state -------------------------------------------------
         mesh_cfg = MeshConfig(dp=cfg.dp, tp=cfg.tp, ep=cfg.ep, sp=cfg.sp)
@@ -931,10 +940,32 @@ class TpuEngine(AsyncEngine):
     async def _run_loop(self) -> None:
         while not self._closed:
             self._cancel_stopped()
+            try:
+                while (
+                    self._pending_fetches
+                    and self._pending_fetches[0][1].done()
+                ):
+                    # Completed background fetches apply for free — parked
+                    # rows resume without the loop ever blocking on D2H.
+                    await self._harvest_pending()
+            except Exception:
+                # Same engine-fatal contract as the step path below: a
+                # failed D2H must fail all streams, never strand them.
+                logger.exception("deferred fetch failed")
+                self._fail_all()
+                return
             plan = self.scheduler.schedule()
             for seq in self.scheduler.take_rejected():
                 self._finish(seq, FinishReason.ERROR)
             if plan is None:
+                if self._pending_fetches:
+                    try:
+                        await self._harvest_pending(all_pending=True)
+                    except Exception:
+                        logger.exception("deferred fetch failed")
+                        self._fail_all()
+                        return
+                    continue
                 if self.scheduler.num_waiting and not self.scheduler.num_running:
                     # e.g. decode just preempted everyone back to waiting:
                     # retry admission immediately (terminates: each pass
@@ -949,6 +980,11 @@ class TpuEngine(AsyncEngine):
             try:
                 did_work = False
                 if plan.pure_decode and self.cfg.decode_steps > 1:
+                    if self._pending_fetches:
+                        # Parked rows must not sit out a whole fused
+                        # pipeline run — fold them in first.
+                        await self._harvest_pending(all_pending=True)
+                        continue
                     # Leaving the mixed regime: a stale chunk count must not
                     # trigger an immediate burst in the NEXT mixed phase.
                     self._chunks_since_burst = 0
@@ -986,6 +1022,9 @@ class TpuEngine(AsyncEngine):
                             ):
                                 # No KV headroom for a whole burst: the
                                 # 1-token slots are already allocated.
+                                self.step_trace.append(
+                                    ("burst_fallback", 0.0, len(decode_items), 0)
+                                )
                                 await self._run_unified(StepPlan(decode_items))
                         did_work = True
                 if not did_work:
@@ -1009,7 +1048,9 @@ class TpuEngine(AsyncEngine):
                 self._finish(seq, FinishReason.CANCELLED)
 
     def _fail_all(self) -> None:
+        self._pending_fetches.clear()  # drop in-flight token fetches
         for seq in list(self.scheduler.running) + list(self.scheduler.waiting):
+            seq.awaiting_fetch = False
             self.scheduler.remove(seq)
             self._finish(seq, FinishReason.ERROR)
 
@@ -1128,19 +1169,23 @@ class TpuEngine(AsyncEngine):
         else:
             rb_d, samp_d = rb, samp
         step = self._step_fn
+        while self._pending_fetches and self._pending_fetches[0][1].done():
+            await self._harvest_pending()  # free: task already complete
 
         def run():
             out, self.cache = step(self.params, self.cache, rb_d, samp_d)
-            if not need_tokens:
-                return None, None, None, None
-            if need_lp:
-                return (
-                    np.asarray(out.tokens),
-                    np.asarray(out.logprob),
-                    np.asarray(out.top_ids),
-                    np.asarray(out.top_logprobs),
-                )
-            return np.asarray(out.tokens), None, None, None
+            if need_tokens:
+                # Start the D2H now; the accept is deferred to a harvest
+                # point so the round trip overlaps later dispatches.
+                try:
+                    out.tokens.copy_to_host_async()
+                    if need_lp:
+                        out.logprob.copy_to_host_async()
+                        out.top_ids.copy_to_host_async()
+                        out.top_logprobs.copy_to_host_async()
+                except AttributeError:
+                    pass
+            return out
 
         t0 = time.perf_counter()
         async with self._device_lock:
@@ -1152,11 +1197,17 @@ class TpuEngine(AsyncEngine):
                     "unified",
                     (rb, jax.tree_util.tree_map(np.asarray, samp)),
                 )
-            sampled, logp, top_ids, top_lp = await asyncio.to_thread(run)
+            out = await asyncio.to_thread(run)
         self.step_trace.append(
-            ("unified", time.perf_counter() - t0, len(plan.items), len(rb.token_ids))
+            (
+                "unified_fetch" if need_tokens else "unified",
+                time.perf_counter() - t0,
+                len(plan.items),
+                len(rb.token_ids),
+            )
         )
 
+        pending_rows: List[Tuple[SequenceState, int]] = []
         for i, (seq, start, n) in enumerate(plan.items):
             if seq.finished:
                 continue
@@ -1166,13 +1217,96 @@ class TpuEngine(AsyncEngine):
             seq.num_computed = start + n
             self._seal_completed_blocks(seq)
             if not seq.in_prefill:
-                # sampled is present whenever any row reaches this point
-                # (need_tokens covered it: start + n >= len(prompt)).
-                self._accept_token(
-                    seq,
-                    int(sampled[i]),
-                    logprobs=self._lp_info(seq, i, logp, top_ids, top_lp),
+                # This row's sampled token is in flight; park the row until
+                # a harvest point applies it.
+                seq.awaiting_fetch = True
+                pending_rows.append((seq, i))
+        if pending_rows:
+            self._stash_fetch("first", out, need_lp, pending_rows)
+
+    def _stash_fetch(self, kind: str, out, need_lp: bool, *meta) -> None:
+        """Park a dispatched step's token fetch: the np.asarray runs on a
+        worker thread STARTING NOW (the D2H was already initiated with
+        copy_to_host_async), and the loop applies the result at a harvest
+        point once the task completes — the device round trip never blocks
+        dispatching."""
+
+        def fetch():
+            if need_lp:
+                return (
+                    np.asarray(out.tokens),
+                    np.asarray(out.logprob),
+                    np.asarray(out.top_ids),
+                    np.asarray(out.top_logprobs),
                 )
+            return np.asarray(out.tokens), None, None, None
+
+        task = asyncio.get_running_loop().create_task(asyncio.to_thread(fetch))
+        self._pending_fetches.append((kind, task, *meta))
+
+    async def _harvest_pending(self, all_pending: bool = False) -> None:
+        """Apply deferred fetches in dispatch order.  Harvests the oldest
+        entry (awaiting its background task), or everything outstanding."""
+        while self._pending_fetches:
+            entry = self._pending_fetches.pop(0)
+            kind, task = entry[0], entry[1]
+
+            t0 = time.perf_counter()
+            sampled, logp, top_ids, top_lp = await task
+            self.step_trace.append(
+                (
+                    f"{kind}_harvest",
+                    time.perf_counter() - t0,
+                    len(entry[2]),
+                    0,
+                )
+            )
+            if kind == "first":
+                for seq, i in entry[2]:
+                    seq.awaiting_fetch = False
+                    if seq.finished:
+                        continue  # cancelled while the token was in flight
+                    self._accept_token(
+                        seq,
+                        int(sampled[i]),
+                        logprobs=self._lp_info(seq, i, logp, top_ids, top_lp),
+                    )
+            else:  # burst
+                members, pos0 = entry[2], entry[3]
+                bs = self.cfg.block_size
+                finished: List[SequenceState] = []
+                for t in range(sampled.shape[0]):
+                    for i, seq in enumerate(members):
+                        seq.awaiting_fetch = False
+                        if seq.finished or pos0[i] < 0:
+                            continue
+                        if seq.num_computed != pos0[i] + t:
+                            continue  # stopped earlier in this burst
+                        if seq.num_computed >= len(seq.block_ids) * bs:
+                            continue  # beyond allocation: never KV-backed
+                        fed = (seq.prompt + seq.output)[seq.num_computed]
+                        if seq.num_computed >= len(seq.prompt):
+                            seq.block_seq.append(fed)
+                        seq.num_computed += 1
+                        self._seal_completed_blocks(seq)
+                        self._accept_token(
+                            seq,
+                            int(sampled[t, i]),
+                            defer_removal=True,
+                            logprobs=self._lp_info(
+                                seq,
+                                i,
+                                None if logp is None else logp[t],
+                                None if top_ids is None else top_ids[t],
+                                None if top_lp is None else top_lp[t],
+                            ),
+                        )
+                        if seq.finished:
+                            finished.append(seq)
+                for seq in finished:
+                    self.scheduler.remove(seq)
+            if not all_pending:
+                break
 
     # -------------------------------------------------- fused decode pipeline
     async def _decode_pipeline(self, members: List[SequenceState]) -> bool:
@@ -1418,6 +1552,8 @@ class TpuEngine(AsyncEngine):
             limits[i] = min(
                 len(seq.block_ids) * bs, cfg.max_blocks_per_seq * bs
             )
+        while self._pending_fetches and self._pending_fetches[0][1].done():
+            await self._harvest_pending()  # free: task already complete
         samp = self._sampling_arrays(members)
         need_lp = bool(samp.need_logprobs)
         c_tok, c_steps = tok0, samp.steps
@@ -1432,14 +1568,19 @@ class TpuEngine(AsyncEngine):
             outs, _last, _steps, _counts, self.cache = multi(
                 self.params, self.cache, c_tok, c_steps, samp.counts, *d_args
             )
-            if need_lp:
-                return (
-                    np.asarray(outs.tokens),
-                    np.asarray(outs.logprob),
-                    np.asarray(outs.top_ids),
-                    np.asarray(outs.top_logprobs),
-                )
-            return np.asarray(outs.tokens), None, None, None
+            # Async D2H + deferred accept: the burst's tokens are only
+            # needed at the next harvest point (its rows are parked), so
+            # the round trip overlaps the following prefill chunks instead
+            # of stalling behind the device queue.
+            try:
+                outs.tokens.copy_to_host_async()
+                if need_lp:
+                    outs.logprob.copy_to_host_async()
+                    outs.top_ids.copy_to_host_async()
+                    outs.top_logprobs.copy_to_host_async()
+            except AttributeError:
+                pass
+            return outs
 
         t0 = time.perf_counter()
         async with self._device_lock:
@@ -1454,40 +1595,13 @@ class TpuEngine(AsyncEngine):
                         jax.tree_util.tree_map(np.asarray, samp),
                     ),
                 )
-            sampled, logp, top_ids, top_lp = await asyncio.to_thread(run)
+            outs = await asyncio.to_thread(run)
         self.step_trace.append(
             ("decode_burst", time.perf_counter() - t0, n, n * T)
         )
-        finished: List[SequenceState] = []
-        for t in range(T):
-            for i, seq in enumerate(members):
-                if seq.finished or pos0[i] < 0:
-                    continue
-                if seq.num_computed != pos0[i] + t:
-                    continue  # stopped earlier in this burst
-                if seq.num_computed >= len(seq.block_ids) * bs:
-                    continue  # beyond allocation: never KV-backed
-                fed = (seq.prompt + seq.output)[seq.num_computed]
-                if seq.num_computed >= len(seq.prompt):
-                    seq.block_seq.append(fed)
-                seq.num_computed += 1
-                self._seal_completed_blocks(seq)
-                self._accept_token(
-                    seq,
-                    int(sampled[t, i]),
-                    defer_removal=True,
-                    logprobs=self._lp_info(
-                        seq,
-                        i,
-                        None if logp is None else logp[t],
-                        None if top_ids is None else top_ids[t],
-                        None if top_lp is None else top_lp[t],
-                    ),
-                )
-                if seq.finished:
-                    finished.append(seq)
-        for seq in finished:
-            self.scheduler.remove(seq)
+        for seq in members:
+            seq.awaiting_fetch = True
+        self._stash_fetch("burst", outs, need_lp, members, pos0)
         return True
 
     def _any_useful_rows(
@@ -1736,6 +1850,11 @@ class TpuEngine(AsyncEngine):
                         self.cache,
                         *self._prep((page_ids, comb_p)),
                     )
+                # Candidate selection peeked; refresh recency for the
+                # blocks actually restored (single-process has no
+                # cross-process lockstep to preserve).
+                for tb, _ in run:
+                    self.host_kv.get(tb.sequence_hash)
             for bid, (tb, _) in zip(ids, run):
                 self.kv.seal_block(bid, tb)
             self.kv.free_sequence(ids)

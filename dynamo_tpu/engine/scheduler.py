@@ -53,6 +53,10 @@ class SequenceState:
     # work between sealing and allocate_sequence.  Released by the
     # scheduler once admission lands (or the request leaves the queue).
     pin_ids: Optional[List[int]] = None
+    # A sampled token for this row is in flight device→host (the engine's
+    # deferred first-token fetch): the scheduler must not plan the row
+    # until the engine harvests it (engine.py _harvest_pending).
+    awaiting_fetch: bool = False
     # Original request prompt length.  Preemption folds generated tokens into
     # ``prompt`` for recompute, so stop checks and usage must count output as
     # total_tokens - orig_prompt_len, never len(output).
@@ -180,15 +184,24 @@ class Scheduler:
         # already in ``items`` would leave a stale row whose blocks were
         # freed (block_ids=[]) and crash _build_ragged downstream.
         scheduled: set = set()
-        for seq in [s for s in self.running if not s.in_prefill and not s.finished]:
+        for seq in [
+            s
+            for s in self.running
+            if not s.in_prefill and not s.finished and not s.awaiting_fetch
+        ]:
             if seq not in self.running:
                 continue  # preempted as a victim below
             ok = self._ensure_slot(seq)
             while not ok:
+                # Rows parked on an in-flight token fetch are not victims:
+                # preempting one would fold/rewind state the engine's
+                # harvest is about to append a token to.
                 victims = [
                     s
                     for s in self.running
-                    if s is not seq and id(s) not in scheduled
+                    if s is not seq
+                    and id(s) not in scheduled
+                    and not s.awaiting_fetch
                 ]
                 if not victims:
                     break
