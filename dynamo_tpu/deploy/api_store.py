@@ -34,12 +34,12 @@ def _as_cr(name: str, body: Dict[str, Any]) -> Dict[str, Any]:
     """Accept either a full CR or a bare spec."""
     if "spec" in body:
         cr = dict(body)
-        cr.setdefault("apiVersion", "dynamo.tpu/v1alpha1")
+        cr.setdefault("apiVersion", "dynamo.tpu.io/v1alpha1")
         cr.setdefault("kind", "DynamoTpuDeployment")
         cr.setdefault("metadata", {})["name"] = name
         return cr
     return {
-        "apiVersion": "dynamo.tpu/v1alpha1",
+        "apiVersion": "dynamo.tpu.io/v1alpha1",
         "kind": "DynamoTpuDeployment",
         "metadata": {"name": name},
         "spec": body,

@@ -29,7 +29,7 @@ from .renderer import render
 
 logger = logging.getLogger(__name__)
 
-GROUP = "dynamo.tpu"
+GROUP = "dynamo.tpu.io"  # matches deploy/k8s/crd.yaml
 OWNER_LABEL = f"{GROUP}/owner"
 CR_PLURAL = "dynamotpudeployments"
 
