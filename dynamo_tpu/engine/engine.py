@@ -320,20 +320,13 @@ class TpuEngine(AsyncEngine):
             # Donated in-place page scatter for KV imports; padding ids are
             # out of range and dropped, so callers can bucket the page count
             # to bound recompiles.
-            dt = cache.pages.dtype
-            if jnp.issubdtype(dt, jnp.integer):
-                # Integer (quantized) pages: round-to-nearest + clip, exactly
-                # like write_kv_ragged — a plain astype truncates toward zero
-                # and wraps on overflow, so sp-prefilled blocks would differ
-                # numerically from normal-prefill blocks (ADVICE r3 medium).
-                info = jnp.iinfo(dt)
-                new_pages = jnp.clip(
-                    jnp.round(new_pages.astype(jnp.float32)),
-                    info.min,
-                    info.max,
-                )
+            # Same quantization as the ragged write path (shared helper) —
+            # injected/sp-prefilled blocks must never diverge numerically
+            # from normal-prefill blocks under the same hashes.
+            from ..ops.ragged_attention import quantize_for_cache
+
             pages = cache.pages.at[:, page_ids].set(
-                new_pages.astype(dt), mode="drop"
+                quantize_for_cache(new_pages, cache.pages.dtype), mode="drop"
             )
             return PagedKVCache(pages)
 

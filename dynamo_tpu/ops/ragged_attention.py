@@ -42,6 +42,25 @@ def on_tpu() -> bool:
     return dev is None or getattr(dev, "platform", None) == "tpu"
 
 
+def quantize_for_cache(x: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Make already-scaled values representable in a quantized page dtype.
+
+    int8: round-to-nearest + clip (astype truncates toward zero — biased —
+    and wraps on overflow).  float8: clip to ±finfo.max (e4m3fn has NO inf,
+    so casting past the max saturates to NaN and one NaN K row poisons
+    every later attention read of the block).  Shared by the ragged write
+    path and the engine's block-inject path so normal-prefill and
+    injected/sp-prefilled blocks can never diverge numerically."""
+    dtype = jnp.dtype(dtype)
+    if jnp.issubdtype(dtype, jnp.integer):
+        info = jnp.iinfo(dtype)
+        x = jnp.clip(jnp.round(x.astype(jnp.float32)), info.min, info.max)
+    elif dtype.itemsize == 1:
+        fmax = float(jnp.finfo(dtype).max)
+        x = jnp.clip(x.astype(jnp.float32), -fmax, fmax)
+    return x.astype(dtype)
+
+
 def write_kv_ragged(
     pages: jnp.ndarray,  # [num_pages, page_size, 2*kv_heads, head_dim]
     k_new: jnp.ndarray,  # [T, kv_heads, head_dim]
@@ -59,15 +78,10 @@ def write_kv_ragged(
         # kv_scale may be a per-layer traced scalar (the layer scan indexes
         # a [L] calibration vector), so no Python != 1.0 fast path here.
         comb = comb.astype(jnp.float32) / kv_scale
-    if jnp.issubdtype(pages.dtype, jnp.integer):
-        # Integer caches: round-to-nearest (astype truncates toward zero,
-        # which both biases the quantization and zeroes |x| < 1) and clip
-        # to the representable range.
-        info = jnp.iinfo(pages.dtype)
-        comb = jnp.clip(jnp.round(comb.astype(jnp.float32)), info.min, info.max)
+    comb = quantize_for_cache(comb, pages.dtype)
     slots = jnp.where(jnp.asarray(slot_mapping) < 0, P * ps, slot_mapping)
     flat = pages.reshape(P * ps, KV2, D)
-    flat = flat.at[slots].set(comb.astype(flat.dtype), mode="drop")
+    flat = flat.at[slots].set(comb, mode="drop")
     return flat.reshape(P, ps, KV2, D)
 
 

@@ -138,3 +138,37 @@ def test_scales_travel_with_kv_transfer():
         await c.close()
 
     asyncio.run(main())
+
+
+def test_fp8_overflow_saturates_finite():
+    """float8_e4m3fn has no inf: a raw cast past ±448 produces NaN, and one
+    NaN K row poisons every later attention read of the block (observed on
+    TPU hardware before the clip).  The shared quantize path must saturate
+    to the finite max instead — for both the ragged write and the inject
+    paths."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops.ragged_attention import (
+        quantize_for_cache,
+        write_kv_ragged,
+    )
+
+    dt = jnp.dtype("float8_e4m3fn")
+    # Sanity: the failure mode is real (raw cast overflows to NaN).
+    assert jnp.isnan(jnp.asarray([1e4], jnp.float32).astype(dt).astype(jnp.float32))[0]
+
+    big = jnp.asarray([[[1e4, -1e4, 5.0]]], jnp.float32)  # [T=1, KV=1, D=3]
+    q = quantize_for_cache(big, dt).astype(jnp.float32)
+    assert bool(jnp.isfinite(q).all())
+    assert float(q[0, 0, 0]) == float(jnp.finfo(dt).max)
+    assert float(q[0, 0, 1]) == -float(jnp.finfo(dt).max)
+
+    pages = jnp.zeros((2, 2, 2, 3), dt)  # [P, ps, 2KV, D], KV=1
+    out = write_kv_ragged(
+        pages, big, -big, jnp.asarray([0], jnp.int32)
+    ).astype(jnp.float32)
+    assert bool(jnp.isfinite(out).all())
+
+    # int8 stays round-to-nearest + clip through the same helper.
+    q8 = quantize_for_cache(jnp.asarray([[[1.6, -300.0]]], jnp.float32), "int8")
+    assert int(q8[0, 0, 0]) == 2 and int(q8[0, 0, 1]) == -128
