@@ -172,6 +172,14 @@ class PrefillWorkerLoop:
     never stage in host RAM."""
 
     MAX_ATTEMPTS = 3
+    # Adaptive chunk sizing targets this per-chunk transfer latency: large
+    # enough to amortize framing, small enough that the decode side keeps
+    # sealing (and decoding against) early blocks while the tail is in
+    # flight.  On a fast intra-pod link the chunk grows toward max; over a
+    # slow DCN hop it shrinks so pipelining stays fine-grained.
+    TARGET_CHUNK_S = 0.05
+    MIN_CHUNK_BLOCKS = 4
+    MAX_CHUNK_BLOCKS = 256
 
     def __init__(
         self,
@@ -179,10 +187,12 @@ class PrefillWorkerLoop:
         queue: PrefillQueue,
         chunk_blocks: int = 32,
         direct: Optional[Dict[str, "DisaggDecodeWorker"]] = None,
+        adaptive_chunks: bool = True,
     ):
         self.engine = engine
         self.queue = queue
         self.chunk_blocks = max(1, chunk_blocks)
+        self.adaptive_chunks = adaptive_chunks
         self.direct = direct or {}
         self._task: Optional[asyncio.Task] = None
         self._clients: Dict[str, Client] = {}
@@ -190,6 +200,17 @@ class PrefillWorkerLoop:
         self.handled = 0
         self.dropped = 0
         self.direct_transfers = 0
+
+    def _adapt_chunk(self, blocks_sent: int, elapsed_s: float) -> None:
+        """Move chunk_blocks toward TARGET_CHUNK_S of measured link time
+        (half-step toward the bandwidth-implied size — smooths jitter)."""
+        if not self.adaptive_chunks or blocks_sent <= 0 or elapsed_s <= 0:
+            return
+        ideal = blocks_sent * self.TARGET_CHUNK_S / elapsed_s
+        stepped = (self.chunk_blocks + ideal) / 2
+        self.chunk_blocks = int(
+            min(self.MAX_CHUNK_BLOCKS, max(self.MIN_CHUNK_BLOCKS, stepped))
+        )
 
     async def start(self) -> "PrefillWorkerLoop":
         self._task = asyncio.get_running_loop().create_task(self._run())
@@ -293,6 +314,9 @@ class PrefillWorkerLoop:
                 break
             start += payload["n_blocks"]
             last = start >= total_blocks or payload["n_blocks"] < self.chunk_blocks
+            import time as _time
+
+            t0 = _time.perf_counter()
             resp = await client.generate(
                 Context(
                     {
@@ -305,6 +329,9 @@ class PrefillWorkerLoop:
             )
             async for _ack in resp:
                 pass
+            self._adapt_chunk(
+                payload["n_blocks"], _time.perf_counter() - t0
+            )
             if last:
                 break
 

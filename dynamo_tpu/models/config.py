@@ -41,6 +41,8 @@ class ModelConfig:
     num_shared_experts: int = 0
     moe_intermediate_size: int = 0
     eos_token_ids: tuple = ()
+    # Qwen2-style attention: q/k/v projections carry biases.
+    qkv_bias: bool = False
 
     @property
     def is_moe(self) -> bool:
@@ -62,6 +64,11 @@ class ModelConfig:
         """Convert a HuggingFace ``config.json`` dict (llama/mixtral style)."""
         num_heads = cfg["num_attention_heads"]
         head_dim = cfg.get("head_dim") or cfg["hidden_size"] // num_heads
+        # Qwen2 checkpoints carry q/k/v biases but don't always write an
+        # explicit attention_bias flag.
+        qkv_bias = bool(
+            cfg.get("attention_bias", cfg.get("model_type") == "qwen2")
+        )
         eos = cfg.get("eos_token_id", ())
         if isinstance(eos, int):
             eos = (eos,)
@@ -85,6 +92,7 @@ class ModelConfig:
             if cfg.get("num_local_experts")
             else 0,
             eos_token_ids=tuple(eos),
+            qkv_bias=qkv_bias,
         )
 
     @classmethod
@@ -159,6 +167,23 @@ register_config(
         num_experts_per_token=2,
         moe_intermediate_size=14336,
         eos_token_ids=(2,),
+    )
+)
+
+register_config(
+    ModelConfig(
+        name="qwen2.5-7b",
+        vocab_size=152064,
+        hidden_size=3584,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        head_dim=128,
+        intermediate_size=18944,
+        rope_theta=1e6,
+        tie_word_embeddings=False,
+        qkv_bias=True,
+        eos_token_ids=(151643, 151645),
     )
 )
 

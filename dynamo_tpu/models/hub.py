@@ -33,6 +33,7 @@ ALIASES = {
     "llama-3.1-8b-instruct": "meta-llama/Llama-3.1-8B-Instruct",
     "llama-3.1-70b-instruct": "meta-llama/Llama-3.1-70B-Instruct",
     "mixtral-8x7b-instruct": "mistralai/Mixtral-8x7B-Instruct-v0.1",
+    "qwen2.5-7b-instruct": "Qwen/Qwen2.5-7B-Instruct",
 }
 
 # Only the artifacts serving needs: weights, tokenizer, configs.  Skips

@@ -102,6 +102,14 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
         "wo": norm(keys[4], L, H * hd, D),
         "mlp_norm": jnp.ones((L, D), dt),
     }
+    if config.qkv_bias:
+        layers.update(
+            {
+                "bq": norm(keys[10], L, H * hd),
+                "bk": norm(keys[11], L, KV * hd),
+                "bv": norm(keys[0], L, KV * hd),
+            }
+        )
     if config.is_moe:
         layers.update(init_moe_params(config, keys[5], dt))
     else:
@@ -236,9 +244,12 @@ def forward_ragged(
         h, pages = carry
         lp, l = xs
         x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
-        q = (x @ lp["wq"]).reshape(T, H, hd)
-        k = (x @ lp["wk"]).reshape(T, KV, hd)
-        v = (x @ lp["wv"]).reshape(T, KV, hd)
+        q, k, v = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
+        if "bq" in lp:  # Qwen2-style attention biases
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(T, H, hd)
+        k = k.reshape(T, KV, hd)
+        v = v.reshape(T, KV, hd)
         q = apply_rope(q, rb.positions, inv_freq)
         k = apply_rope(k, rb.positions, inv_freq)
         slots_l = jnp.where(
@@ -330,9 +341,12 @@ def forward_sp_prefill(
     def layer(carry, lp):
         h = carry
         x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
-        q = apply_rope((x @ lp["wq"]).reshape(Tg, H, hd), positions, inv_freq)
-        k = apply_rope((x @ lp["wk"]).reshape(Tg, KV, hd), positions, inv_freq)
-        v = (x @ lp["wv"]).reshape(Tg, KV, hd)
+        q, k, v = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
+        if "bq" in lp:  # Qwen2-style attention biases
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = apply_rope(q.reshape(Tg, H, hd), positions, inv_freq)
+        k = apply_rope(k.reshape(Tg, KV, hd), positions, inv_freq)
+        v = v.reshape(Tg, KV, hd)
         attn = ring(q, k, v, jnp.asarray([valid], jnp.int32))
         h = h + attn.reshape(Tg, H * hd) @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], config.rms_norm_eps)

@@ -23,6 +23,10 @@ from .config import ModelConfig
 
 _LAYER_MAP = {
     "input_layernorm.weight": ("attn_norm", False),
+    # Qwen2-style attention biases ([out] vectors, no transpose).
+    "self_attn.q_proj.bias": ("bq", False),
+    "self_attn.k_proj.bias": ("bk", False),
+    "self_attn.v_proj.bias": ("bv", False),
     "self_attn.q_proj.weight": ("wq", True),
     "self_attn.k_proj.weight": ("wk", True),
     "self_attn.v_proj.weight": ("wv", True),

@@ -80,6 +80,10 @@ def param_pspecs(config: ModelConfig) -> Any:
         "wk": P(None, None, "tp"),
         "wv": P(None, None, "tp"),
         "wo": P(None, "tp", None),
+        # Qwen2 attention biases: shard with their projections' outputs.
+        "bq": P(None, "tp"),
+        "bk": P(None, "tp"),
+        "bv": P(None, "tp"),
         "mlp_norm": P(),
         # dense FFN
         "w_gate": P(None, None, "tp"),

@@ -54,8 +54,8 @@ WORDS = (
 )
 
 
-def build_checkpoint(path: str) -> None:
-    """Write a complete HF-format model directory."""
+def build_checkpoint(path: str, model_type: str = "llama") -> None:
+    """Write a complete HF-format model directory (llama or qwen2)."""
     from tokenizers import Tokenizer
     from tokenizers.models import WordLevel
     from tokenizers.pre_tokenizers import Whitespace
@@ -69,8 +69,10 @@ def build_checkpoint(path: str) -> None:
     os.makedirs(path, exist_ok=True)
     hf_cfg = dict(
         TINY,
-        architectures=["LlamaForCausalLM"],
-        model_type="llama",
+        architectures=[
+            "Qwen2ForCausalLM" if model_type == "qwen2" else "LlamaForCausalLM"
+        ],
+        model_type=model_type,
         num_attention_heads=TINY["num_heads"],
         num_key_value_heads=TINY["num_kv_heads"],
         num_hidden_layers=TINY["num_layers"],
@@ -105,7 +107,9 @@ def build_checkpoint(path: str) -> None:
 
 def reference_greedy(path: str, prompt_ids, n_tokens: int):
     """Independent greedy decode: dense causal attention, no paging, no
-    engine code — only the checkpoint tensors and the rope helper."""
+    engine code — only the checkpoint tensors and the rope helper.
+    Applies q/k/v projection biases when the checkpoint ships them
+    (qwen2-style)."""
     import jax.numpy as jnp
 
     from dynamo_tpu.ops.rope import apply_rope, rope_frequencies
@@ -137,9 +141,16 @@ def reference_greedy(path: str, prompt_ids, n_tokens: int):
         for l in range(TINY["num_layers"]):
             p = f"model.layers.{l}."
             x = norm(h, t[p + "input_layernorm.weight"])
-            q = (x @ t[p + "self_attn.q_proj.weight"].T).reshape(T, H, hd)
-            k = (x @ t[p + "self_attn.k_proj.weight"].T).reshape(T, KV, hd)
-            v = (x @ t[p + "self_attn.v_proj.weight"].T).reshape(T, KV, hd)
+            q = x @ t[p + "self_attn.q_proj.weight"].T
+            k = x @ t[p + "self_attn.k_proj.weight"].T
+            v = x @ t[p + "self_attn.v_proj.weight"].T
+            if p + "self_attn.q_proj.bias" in t:
+                q = q + t[p + "self_attn.q_proj.bias"]
+                k = k + t[p + "self_attn.k_proj.bias"]
+                v = v + t[p + "self_attn.v_proj.bias"]
+            q, k, v = (
+                q.reshape(T, H, hd), k.reshape(T, KV, hd), v.reshape(T, KV, hd)
+            )
             q = np.asarray(apply_rope(jnp.asarray(q), pos, inv_freq))
             k = np.asarray(apply_rope(jnp.asarray(k), pos, inv_freq))
             G = H // KV
@@ -273,6 +284,80 @@ def test_real_checkpoint_serves_golden_tokens(checkpoint):
             )
             body2 = await r.json()
         assert body2["choices"][0]["message"]["content"] == text
+
+        await svc.close()
+        await engine.close()
+
+    asyncio.run(main())
+
+
+def test_qwen2_family_serves_golden_tokens(tmp_path):
+    """Qwen2-style checkpoints (q/k/v projection BIASES, model_type qwen2)
+    go through the same full stack and reproduce the independent dense
+    forward exactly — second model family beyond plain llama/mixtral."""
+
+    async def main():
+        from argparse import Namespace
+
+        from aiohttp import ClientSession
+
+        from dynamo_tpu.engine import build_tpu_engine
+        from dynamo_tpu.llm.backend import Backend
+        from dynamo_tpu.llm.http_service import HttpService
+        from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+        from dynamo_tpu.llm.tokenizer import HFTokenizer
+        from dynamo_tpu.runtime.pipeline import build_pipeline
+
+        path = str(tmp_path / "qwen")
+        build_checkpoint(path, model_type="qwen2")
+        engine = build_tpu_engine(
+            Namespace(
+                arch=None,
+                checkpoint=path,
+                model_config=None,
+                block_size=4,
+                num_blocks=128,
+                max_batch=2,
+                max_model_len=256,
+                prefill_chunk=16,
+                decode_steps=4,
+                pipeline_depth=2,
+                dtype="float32",
+            )
+        )
+        assert engine.model_config.qkv_bias  # detected from model_type
+        assert "bq" in engine.params["layers"]
+
+        tokenizer = HFTokenizer.from_pretrained_dir(path)
+        pipeline = build_pipeline(
+            [OpenAIPreprocessor(tokenizer, "qwen"), Backend(tokenizer)], engine
+        )
+        svc = HttpService(host="127.0.0.1", port=0)
+        svc.models.add_chat_model("qwen", pipeline)
+        await svc.start()
+
+        prompt_ids = tokenizer.encode(
+            "<|user|> hello world the sky is <|assistant|>"
+        )
+        golden = reference_greedy(path, prompt_ids, 8)
+
+        async with ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{svc.port}/v1/chat/completions",
+                json={
+                    "model": "qwen",
+                    "messages": [
+                        {"role": "user", "content": "hello world the sky is"}
+                    ],
+                    "temperature": 0.0,
+                    "max_tokens": 8,
+                    "nvext": {"ignore_eos": True},
+                },
+            )
+            assert r.status == 200, await r.text()
+            body = await r.json()
+        text = body["choices"][0]["message"]["content"]
+        assert text == tokenizer.decode(golden), (text, golden)
 
         await svc.close()
         await engine.close()
