@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 import uuid
 from typing import Any, AsyncIterator, Dict, Optional
 
@@ -144,13 +145,11 @@ class DisaggDecodeWorker(AsyncEngine):
                 "reply": {"address": self.import_address, "path": self.import_path},
             }
         )
-        import time as _time
-
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         try:
             covered = await asyncio.wait_for(fut, self.transfer_timeout)
             self.remote_prefills += 1
-            self.transfer_ms.append((_time.perf_counter() - t0) * 1e3)
+            self.transfer_ms.append((time.perf_counter() - t0) * 1e3)
             logger.info("remote prefill covered %d tokens", covered)
         except asyncio.TimeoutError:
             # Fall back to local prefill; a late transfer still lands as a
@@ -191,7 +190,11 @@ class PrefillWorkerLoop:
     ):
         self.engine = engine
         self.queue = queue
-        self.chunk_blocks = max(1, chunk_blocks)
+        self.chunk_blocks = max(1, chunk_blocks)  # default for new links
+        # Adaptive size is PER DESTINATION: a co-pod link converges large
+        # while a cross-region DCN link converges small — one shared value
+        # would thrash between them.
+        self._chunk_by_dest: Dict[str, int] = {}
         self.adaptive_chunks = adaptive_chunks
         self.direct = direct or {}
         self._task: Optional[asyncio.Task] = None
@@ -201,14 +204,17 @@ class PrefillWorkerLoop:
         self.dropped = 0
         self.direct_transfers = 0
 
-    def _adapt_chunk(self, blocks_sent: int, elapsed_s: float) -> None:
-        """Move chunk_blocks toward TARGET_CHUNK_S of measured link time
-        (half-step toward the bandwidth-implied size — smooths jitter)."""
+    def chunk_for(self, dest: str) -> int:
+        return self._chunk_by_dest.get(dest, self.chunk_blocks)
+
+    def _adapt_chunk(self, dest: str, blocks_sent: int, elapsed_s: float) -> None:
+        """Move ``dest``'s chunk size toward TARGET_CHUNK_S of measured link
+        time (half-step toward the bandwidth-implied size — smooths jitter)."""
         if not self.adaptive_chunks or blocks_sent <= 0 or elapsed_s <= 0:
             return
         ideal = blocks_sent * self.TARGET_CHUNK_S / elapsed_s
-        stepped = (self.chunk_blocks + ideal) / 2
-        self.chunk_blocks = int(
+        stepped = (self.chunk_for(dest) + ideal) / 2
+        self._chunk_by_dest[dest] = int(
             min(self.MAX_CHUNK_BLOCKS, max(self.MIN_CHUNK_BLOCKS, stepped))
         )
 
@@ -285,11 +291,13 @@ class PrefillWorkerLoop:
             return
 
         client = self._client_for(reply["address"], reply["path"])
+        dest = reply["address"]
         total_blocks = len(tokens) // self.engine.cfg.block_size
         start = 0
         while True:
+            chunk = self.chunk_for(dest)
             payload = await self.engine.export_prompt_blocks(
-                tokens, start_block=start, max_blocks=self.chunk_blocks
+                tokens, start_block=start, max_blocks=chunk
             )
             if payload is None:
                 if start == 0:
@@ -313,10 +321,8 @@ class PrefillWorkerLoop:
                     pass
                 break
             start += payload["n_blocks"]
-            last = start >= total_blocks or payload["n_blocks"] < self.chunk_blocks
-            import time as _time
-
-            t0 = _time.perf_counter()
+            last = start >= total_blocks or payload["n_blocks"] < chunk
+            t0 = time.perf_counter()
             resp = await client.generate(
                 Context(
                     {
@@ -330,7 +336,7 @@ class PrefillWorkerLoop:
             async for _ack in resp:
                 pass
             self._adapt_chunk(
-                payload["n_blocks"], _time.perf_counter() - t0
+                dest, payload["n_blocks"], time.perf_counter() - t0
             )
             if last:
                 break

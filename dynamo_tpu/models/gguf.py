@@ -177,6 +177,10 @@ class GGUFFile:
             rms_norm_eps=float(key("attention.layer_norm_rms_epsilon", 1e-5)),
             max_position=int(key("context_length", 4096)),
             eos_token_ids=(int(eos),) if eos is not None else (),
+            # qwen2 GGUFs ship q/k/v biases (llama.cpp writes them for the
+            # family); the loader errors if the config says bias but the
+            # tensors are missing, so detection by architecture is safe.
+            qkv_bias=arch == "qwen2",
         )
 
     # ------------------------------------------------------------ tokenizer
@@ -223,6 +227,10 @@ _GGUF_LAYER_MAP = {
     "attn_q.weight": ("wq", True),
     "attn_k.weight": ("wk", True),
     "attn_v.weight": ("wv", True),
+    # Qwen2-style attention biases ([out] vectors, no transpose).
+    "attn_q.bias": ("bq", False),
+    "attn_k.bias": ("bk", False),
+    "attn_v.bias": ("bv", False),
     "attn_output.weight": ("wo", True),
     "ffn_norm.weight": ("mlp_norm", False),
     "ffn_gate.weight": ("w_gate", True),

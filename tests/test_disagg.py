@@ -248,23 +248,32 @@ def test_adaptive_chunk_sizing_tracks_link_speed():
 
     loop = PrefillWorkerLoop.__new__(PrefillWorkerLoop)
     loop.chunk_blocks = 32
+    loop._chunk_by_dest = {}
     loop.adaptive_chunks = True
 
     # Fast link: 32 blocks in 5ms → ideal ~320, stepped halfway + capped.
     for _ in range(8):
-        loop._adapt_chunk(loop.chunk_blocks, loop.chunk_blocks * 5e-3 / 32)
-    assert loop.chunk_blocks == PrefillWorkerLoop.MAX_CHUNK_BLOCKS
+        loop._adapt_chunk("pod", loop.chunk_for("pod"),
+                          loop.chunk_for("pod") * 5e-3 / 32)
+    assert loop.chunk_for("pod") == PrefillWorkerLoop.MAX_CHUNK_BLOCKS
 
-    # Slow DCN hop: 10ms per BLOCK → converges to the bandwidth-implied 5.
+    # Slow DCN hop (DIFFERENT destination): 10ms per BLOCK → converges to
+    # the bandwidth-implied 5 without disturbing the fast link's size.
     for _ in range(8):
-        loop._adapt_chunk(loop.chunk_blocks, loop.chunk_blocks * 10e-3)
-    assert loop.chunk_blocks == 5
+        loop._adapt_chunk("dcn", loop.chunk_for("dcn"),
+                          loop.chunk_for("dcn") * 10e-3)
+    assert loop.chunk_for("dcn") == 5
+    assert loop.chunk_for("pod") == PrefillWorkerLoop.MAX_CHUNK_BLOCKS
+
     # Glacial link: clamped at the floor (pipelining granularity bound).
     for _ in range(8):
-        loop._adapt_chunk(loop.chunk_blocks, loop.chunk_blocks * 1.0)
-    assert loop.chunk_blocks == PrefillWorkerLoop.MIN_CHUNK_BLOCKS
+        loop._adapt_chunk("dcn", loop.chunk_for("dcn"), loop.chunk_for("dcn"))
+    assert loop.chunk_for("dcn") == PrefillWorkerLoop.MIN_CHUNK_BLOCKS
+
+    # Unknown destinations start at the configured default.
+    assert loop.chunk_for("new") == 32
 
     # Disabled: static.
     loop.adaptive_chunks = False
-    loop._adapt_chunk(4, 100.0)
-    assert loop.chunk_blocks == PrefillWorkerLoop.MIN_CHUNK_BLOCKS
+    loop._adapt_chunk("dcn", 4, 100.0)
+    assert loop.chunk_for("dcn") == PrefillWorkerLoop.MIN_CHUNK_BLOCKS

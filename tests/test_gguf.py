@@ -92,7 +92,7 @@ def test_gguf_load_params(tmp_path):
     path = str(tmp_path / "tiny.gguf")
     write_gguf(path, _tiny_meta(["a"] * 8), tensors)
     cfg = GGUFFile(path).to_model_config().with_overrides(dtype="float32")
-    params = load_params_gguf(cfg, path)
+    params = load_params_gguf(cfg, path, dtype="float32")
     assert params["layers"]["wq"].shape == (2, 16, 16)  # [L, D, H*hd]
     np.testing.assert_allclose(
         np.asarray(params["layers"]["wq"][0]),
@@ -163,3 +163,33 @@ def test_gguf_end_to_end_serving(tmp_path):
         await engine.close()
 
     asyncio.run(main())
+
+
+def test_gguf_qwen2_biases_load(tmp_path):
+    """Qwen2 GGUFs carry attn q/k/v biases: architecture detection sets
+    qkv_bias and the loader maps blk.N.attn_{q,k,v}.bias into the stacked
+    tree (previously dropped silently — wrong logits with no warning)."""
+    rng = np.random.default_rng(5)
+    path = str(tmp_path / "q.gguf")
+    meta = {
+        k.replace("llama.", "qwen2."): v for k, v in _tiny_meta(["a"] * 8).items()
+    }
+    meta["general.architecture"] = "qwen2"
+    tensors = _tiny_tensors(rng)
+    for i in range(2):
+        tensors[f"blk.{i}.attn_q.bias"] = rng.standard_normal(16).astype(np.float32)
+        tensors[f"blk.{i}.attn_k.bias"] = rng.standard_normal(8).astype(np.float32)
+        tensors[f"blk.{i}.attn_v.bias"] = rng.standard_normal(8).astype(np.float32)
+    write_gguf(path, meta, tensors)
+
+    g = GGUFFile(path)
+    cfg = g.to_model_config()
+    assert cfg.qkv_bias
+    params = load_params_gguf(cfg, path, dtype="float32")
+    assert params["layers"]["bq"].shape == (2, 16)
+    assert params["layers"]["bk"].shape == (2, 8)
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["bv"][1]),
+        tensors["blk.1.attn_v.bias"],
+        rtol=1e-6,
+    )
