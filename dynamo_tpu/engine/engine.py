@@ -274,7 +274,7 @@ class TpuEngine(AsyncEngine):
                 )
                 logits, cache = forward_ragged(
                     params, model_config, rb, cache, attn_impl=attn_impl,
-                    mesh=mesh, kv_scale=kv_scale,
+                    mesh=mesh, kv_scale=kv_scale, decode=True,
                 )
                 out = sample_tokens(
                     logits,

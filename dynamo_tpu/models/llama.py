@@ -150,6 +150,7 @@ def forward_ragged(
     # post-scaled by scale — so per-layer values stay fully traceable (the
     # pallas kernel's native k_scale/v_scale only accepts static floats).
     kv_scale=None,
+    decode: bool = False,  # static: every row is a single-token decode row
 ) -> Tuple[jnp.ndarray, PagedKVCache]:
     """Unified mixed prefill+decode forward over a flat ragged token run.
 
@@ -193,6 +194,7 @@ def forward_ragged(
             num,
             sm_scale=scale,
             impl=attn_impl,
+            decode=decode,
         )
         if s_l is not None:
             out = (out.astype(jnp.float32) * s_l).astype(out.dtype)
