@@ -213,7 +213,7 @@ async def _self_host(args):
         prefill_chunk=int(os.environ.get("LOADGEN_PREFILL_CHUNK", "512")),
         decode_steps=int(os.environ.get("LOADGEN_DECODE_STEPS", "16")),
         prefill_chunks_per_burst=int(
-            os.environ.get("LOADGEN_CHUNKS_PER_BURST", "8")
+            os.environ.get("LOADGEN_CHUNKS_PER_BURST", "24")
         ),
         pipeline_depth=4,
         dtype="float32" if backend == "cpu" else "bfloat16",

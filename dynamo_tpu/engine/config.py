@@ -87,10 +87,12 @@ class EngineConfig:
     # excluded from the (fetch-free) prefill steps and advance via a fused
     # decode_steps burst once every this many prefill chunks — balancing
     # prefill throughput against decode stall (engine.py _run_loop).
-    # Swept on the tunneled v5e at ISL3000/OSL150 conc 16: K=4 → 183,
-    # K=8 → 266 (ITL p99 0.84s), K=12 → 266, K=16 → 279 (ITL p99 1.1s)
-    # tok/s; 8 takes the best latency at ~peak throughput.
-    prefill_chunks_per_burst: int = 8
+    # Swept on the tunneled v5e at ISL3000/OSL150.  With deferred token
+    # fetches (r4) bursts are cheap and the optimum moved up: conc 32 at
+    # K=8 → 413, K=16 → 511, K=24 → 550 (ITL p99 0.97s), K=32 → 565
+    # (ITL p99 1.16s) tok/s; 24 takes near-peak throughput at the best
+    # high-K latency.
+    prefill_chunks_per_burst: int = 24
 
     def __post_init__(self) -> None:
         if not self.batch_buckets:
