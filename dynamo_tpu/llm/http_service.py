@@ -161,7 +161,12 @@ class HttpService:
 
         stream_mode = bool(body.get("stream", False))
         guard = self.metrics.guard(model, endpoint, "stream" if stream_mode else "unary")
-        ctx = Context(body)
+        # Request-id correlation (reference: context id propagated in
+        # headers): honor a caller-supplied x-request-id, else mint one;
+        # it becomes the engine context id (logs, recorder streams, KV
+        # events) and is echoed on every response.
+        rid = request.headers.get("x-request-id")
+        ctx = Context.with_id(body, rid) if rid else Context(body)
         try:
             stream = await engine.generate(ctx)
         except ValueError as e:
@@ -200,7 +205,7 @@ class HttpService:
             logger.exception("stream failed")
             return _error_response(500, str(e))
         guard.finish(Status.SUCCESS)
-        return web.json_response(full)
+        return web.json_response(full, headers={"x-request-id": ctx.id})
 
     async def _stream_response(
         self, request: web.Request, stream, ctx: Context, guard
@@ -211,6 +216,7 @@ class HttpService:
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
                 "Connection": "keep-alive",
+                "x-request-id": ctx.id,
             },
         )
         await resp.prepare(request)

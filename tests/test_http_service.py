@@ -220,3 +220,42 @@ async def test_model_discovery_watcher():
         await service.close()
         await frontend_rt.close()
         await hub.close()
+
+
+def test_request_id_correlation_headers():
+    """The edge honors a caller-supplied x-request-id (it becomes the engine
+    context id) and echoes it on both unary and streaming responses; absent
+    one, a server-minted id is returned (reference: context-id propagation)."""
+    import asyncio
+
+    from aiohttp import ClientSession
+
+    from dynamo_tpu.llm.engines import EchoEngineFull
+    from dynamo_tpu.llm.http_service import HttpService
+
+    async def main():
+        svc = HttpService(host="127.0.0.1", port=0)
+        svc.models.add_chat_model("echo", EchoEngineFull())
+        await svc.start()
+        base = f"http://127.0.0.1:{svc.port}/v1/chat/completions"
+        req = {
+            "model": "echo",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4,
+        }
+        async with ClientSession() as s:
+            r = await s.post(base, json=req, headers={"x-request-id": "corr-1"})
+            assert r.status == 200
+            assert r.headers["x-request-id"] == "corr-1"
+            r = await s.post(base, json=req)
+            minted = r.headers["x-request-id"]
+            assert minted and minted != "corr-1"
+            r = await s.post(
+                base, json=dict(req, stream=True),
+                headers={"x-request-id": "corr-2"},
+            )
+            assert r.headers["x-request-id"] == "corr-2"
+            await r.text()
+        await svc.close()
+
+    asyncio.run(main())
