@@ -162,11 +162,19 @@ class HttpService:
         stream_mode = bool(body.get("stream", False))
         guard = self.metrics.guard(model, endpoint, "stream" if stream_mode else "unary")
         # Request-id correlation (reference: context id propagated in
-        # headers): honor a caller-supplied x-request-id, else mint one;
-        # it becomes the engine context id (logs, recorder streams, KV
-        # events) and is echoed on every response.
+        # headers): a caller-supplied x-request-id becomes the PREFIX of the
+        # engine context id (logs, recorder streams, KV events), uniquified
+        # with a server suffix — request ids key the engine's response
+        # queues, so a client-chosen id must never collide with a
+        # concurrent request's (that would cross-deliver tokens).  The full
+        # unique id is echoed on every response, success or error.
         rid = request.headers.get("x-request-id")
-        ctx = Context.with_id(body, rid) if rid else Context(body)
+        if rid:
+            import uuid as _uuid
+
+            ctx = Context.with_id(body, f"{rid}-{_uuid.uuid4().hex[:8]}")
+        else:
+            ctx = Context(body)
         try:
             stream = await engine.generate(ctx)
         except ValueError as e:
@@ -176,11 +184,11 @@ class HttpService:
             # visible server-side.
             guard.finish(Status.REJECTED)
             logger.warning("request rejected: %s", e, exc_info=True)
-            return _error_response(400, str(e))
+            return _error_response(400, str(e), rid=ctx.id)
         except Exception as e:  # noqa: BLE001 — edge boundary
             guard.finish(Status.ERROR)
             logger.exception("engine rejected request")
-            return _error_response(500, str(e))
+            return _error_response(500, str(e), rid=ctx.id)
 
         if stream_mode:
             return await self._stream_response(request, stream, ctx, guard)
@@ -203,7 +211,7 @@ class HttpService:
         except Exception as e:  # noqa: BLE001
             guard.finish(Status.ERROR)
             logger.exception("stream failed")
-            return _error_response(500, str(e))
+            return _error_response(500, str(e), rid=ctx.id)
         guard.finish(Status.SUCCESS)
         return web.json_response(full, headers={"x-request-id": ctx.id})
 
@@ -254,8 +262,11 @@ class HttpService:
         return resp
 
 
-def _error_response(status: int, message: str) -> web.Response:
+def _error_response(
+    status: int, message: str, rid: Optional[str] = None
+) -> web.Response:
     return web.json_response(
         {"error": {"message": message, "type": "invalid_request_error", "code": status}},
         status=status,
+        headers={"x-request-id": rid} if rid else None,
     )

@@ -222,21 +222,17 @@ async def test_model_discovery_watcher():
         await hub.close()
 
 
-def test_request_id_correlation_headers():
-    """The edge honors a caller-supplied x-request-id (it becomes the engine
-    context id) and echoes it on both unary and streaming responses; absent
-    one, a server-minted id is returned (reference: context-id propagation)."""
-    import asyncio
-
+@pytest.mark.asyncio
+async def test_request_id_correlation_headers():
+    """The edge turns a caller-supplied x-request-id into the PREFIX of the
+    engine context id (uniquified — client-chosen ids must never collide in
+    the engine's queue keyspace) and echoes the full id on unary, streaming,
+    and error responses; absent one, a server-minted id is returned."""
     from aiohttp import ClientSession
 
-    from dynamo_tpu.llm.engines import EchoEngineFull
-    from dynamo_tpu.llm.http_service import HttpService
-
-    async def main():
-        svc = HttpService(host="127.0.0.1", port=0)
-        svc.models.add_chat_model("echo", EchoEngineFull())
-        await svc.start()
+    svc = make_service()
+    await svc.start()
+    try:
         base = f"http://127.0.0.1:{svc.port}/v1/chat/completions"
         req = {
             "model": "echo",
@@ -246,16 +242,29 @@ def test_request_id_correlation_headers():
         async with ClientSession() as s:
             r = await s.post(base, json=req, headers={"x-request-id": "corr-1"})
             assert r.status == 200
-            assert r.headers["x-request-id"] == "corr-1"
-            r = await s.post(base, json=req)
-            minted = r.headers["x-request-id"]
-            assert minted and minted != "corr-1"
-            r = await s.post(
+            rid = r.headers["x-request-id"]
+            assert rid.startswith("corr-1-") and len(rid) > len("corr-1-")
+            # Two requests with the SAME client id get distinct engine ids.
+            r2 = await s.post(base, json=req, headers={"x-request-id": "corr-1"})
+            assert r2.headers["x-request-id"] != rid
+            # Minted when absent.
+            r3 = await s.post(base, json=req)
+            assert r3.headers["x-request-id"]
+            # Streaming echoes too.
+            r4 = await s.post(
                 base, json=dict(req, stream=True),
                 headers={"x-request-id": "corr-2"},
             )
-            assert r.headers["x-request-id"] == "corr-2"
-            await r.text()
+            assert r4.headers["x-request-id"].startswith("corr-2-")
+            await r4.text()
+            # Error responses carry the id (the correlation case that
+            # matters most for debugging).
+            r5 = await s.post(
+                base,
+                json=dict(req, logprobs=True, top_logprobs=99),
+                headers={"x-request-id": "corr-3"},
+            )
+            assert r5.status == 400
+            assert r5.headers["x-request-id"].startswith("corr-3-")
+    finally:
         await svc.close()
-
-    asyncio.run(main())
