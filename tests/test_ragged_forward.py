@@ -203,3 +203,47 @@ def test_ragged_tp_sharded_matches_single_device():
         cfg, params_s, items, S=2, T=8, pages_per_seq=pp, cache=cache_s, mesh=mesh
     )
     np.testing.assert_allclose(got[:2], want[:2], rtol=1e-4, atol=1e-4)
+
+
+def test_decode_unroll_matches_scan_numerically():
+    """forward_ragged's decode=True unrolled layer loop must stay exactly
+    equivalent to the scan path — it is a loop-schedule change (weight
+    prefetch), never a numerics change."""
+    import jax
+    import numpy as np
+
+    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.models.llama import (
+        PagedKVCache,
+        RaggedBatch,
+        forward_ragged,
+        init_params,
+    )
+
+    cfg = get_config("debug-tiny").with_overrides(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    S, BS, PP = 4, 4, 4
+
+    def run(decode):
+        cache = PagedKVCache.create(cfg, 32, BS, dtype=np.float32)
+        tables = np.arange(S * PP, dtype=np.int32).reshape(S, PP)
+        pos = np.full((S,), 5, np.int32)
+        slots = (tables[np.arange(S), 5 // BS] * BS + 5 % BS).astype(np.int32)
+        rb = RaggedBatch(
+            token_ids=np.asarray([7, 8, 9, 10], np.int32),
+            positions=pos,
+            slot_mapping=slots,
+            kv_lens=np.full((S,), 6, np.int32),
+            page_indices=tables,
+            cu_q_lens=np.arange(S + 1, dtype=np.int32),
+            num_seqs=np.asarray([S], np.int32),
+        )
+        logits, cache = forward_ragged(
+            params, cfg, rb, cache, attn_impl="xla", decode=decode
+        )
+        return np.asarray(logits), np.asarray(cache.pages)
+
+    l_scan, c_scan = run(False)
+    l_unroll, c_unroll = run(True)
+    np.testing.assert_array_equal(l_scan, l_unroll)
+    np.testing.assert_array_equal(c_scan, c_unroll)
