@@ -56,11 +56,16 @@ def _tokenizer_spec(args) -> dict:
     ckpt = getattr(args, "checkpoint", None)
     if ckpt:
         # build_tpu_engine resolved the checkpoint spec to a local path;
-        # serve its own tokenizer + chat template when it ships one.
+        # serve its own tokenizer + chat template when it ships one.  The
+        # ORIGINAL spec rides along so a frontend on another host (which
+        # cannot see this worker's filesystem) can re-resolve it.
         from .models.hub import tokenizer_spec
 
         spec = tokenizer_spec(ckpt)
         if spec is not None:
+            source = getattr(args, "checkpoint_source", None)
+            if source:
+                spec["source"] = source
             return spec
     return {"kind": "byte"}
 
@@ -141,6 +146,16 @@ async def _run(args) -> None:
             ).start()
         engine.attach_publisher(publisher)
 
+    if getattr(args, "record", None):
+        # Tap every request/response stream to JSONL (reference:
+        # recorder.rs) — replayable via runtime.recorder.replay_into.
+        # Wrapped HERE so every input mode records (in=http included).
+        from .runtime.recorder import RecordingEngine, StreamRecorder
+
+        recorder = StreamRecorder(args.record)
+        engine = RecordingEngine(engine, recorder)
+        print(f"recording streams to {args.record}", flush=True)
+
     if inp == "http":
         service = HttpService(host=args.host, port=args.port)
         if level == "core":
@@ -168,17 +183,6 @@ async def _run(args) -> None:
             )
         served_engine = engine
         cleanups = []
-        if getattr(args, "record", None):
-            # Tap every request/response stream to JSONL (reference:
-            # recorder.rs) — replayable via runtime.recorder.replay_into.
-            from .runtime.recorder import RecordingEngine, StreamRecorder
-
-            recorder = StreamRecorder(args.record)
-            served_engine = engine = RecordingEngine(engine, recorder)
-            # Streams still draining at shutdown record into a closed
-            # recorder — record() drops those instead of raising.
-            cleanups.append(lambda: asyncio.to_thread(recorder.close))
-            print(f"recording streams to {args.record}", flush=True)
 
         if role == "prefill":
             # Dedicated prefill worker: drains the queue; serves no endpoint.

@@ -38,20 +38,35 @@ def _kind_name(m: Dict[str, Any]) -> Tuple[str, str]:
     return m["kind"], m["metadata"]["name"]
 
 
-def _spec_equal(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
-    """Compare the fields the controller owns (spec + labels); ignores
-    server-populated metadata and status."""
+def _subset(want: Any, have: Any) -> bool:
+    """True when every field ``want`` sets matches ``have``.  The API
+    server populates spec defaults the renderer omits (strategy,
+    restartPolicy, dnsPolicy, ...), so EQUALITY against the observed
+    object would re-apply every child on every poll forever; only the
+    fields the controller actually owns may trigger an apply."""
+    if isinstance(want, dict):
+        if not isinstance(have, dict):
+            return False
+        return all(k in have and _subset(v, have[k]) for k, v in want.items())
+    if isinstance(want, list):
+        if not isinstance(have, list) or len(want) != len(have):
+            return False
+        return all(_subset(w, h) for w, h in zip(want, have))
+    return want == have
 
-    def norm(m):
-        return json.dumps(
-            {
-                "spec": m.get("spec"),
-                "labels": (m.get("metadata") or {}).get("labels"),
-            },
-            sort_keys=True,
-        )
 
-    return norm(a) == norm(b)
+def _spec_equal(desired: Dict[str, Any], observed: Dict[str, Any]) -> bool:
+    """Drift check over the fields the controller owns (spec + labels)."""
+    return _subset(
+        {
+            "spec": desired.get("spec"),
+            "labels": (desired.get("metadata") or {}).get("labels"),
+        },
+        {
+            "spec": observed.get("spec"),
+            "labels": (observed.get("metadata") or {}).get("labels"),
+        },
+    )
 
 
 class FakeKube:
@@ -243,7 +258,7 @@ class Reconciler:
         # deletion — the apply re-creates).
         for m in desired:
             cur = observed.get(_kind_name(m))
-            if cur is None or not _spec_equal(cur, m):
+            if cur is None or not _spec_equal(m, cur):
                 await self.kube.apply(m)
 
         # Delete owned children no longer rendered (a service removed from

@@ -22,6 +22,7 @@ def build_tpu_engine(args):
         # pre-staged offline cache).
         from ..models.hub import resolve_model
 
+        args.checkpoint_source = checkpoint  # pre-resolution spec (registry)
         checkpoint = resolve_model(checkpoint)
         args.checkpoint = checkpoint  # tokenizer discovery reads it too
     if (

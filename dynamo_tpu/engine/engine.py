@@ -176,19 +176,6 @@ class TpuEngine(AsyncEngine):
                 params = load_params(self.model_config, cfg.checkpoint_path)
             else:
                 params = init_params(self.model_config, jax.random.PRNGKey(cfg.seed))
-        # Quantized-scale resolution BEFORE shard_tree: the calibration
-        # probe jits over the plain params (see _calibrate_kv_scales).
-        if jnp.dtype(cfg.cache_dtype).itemsize == 1:
-            if isinstance(cfg.kv_scale, str):
-                if cfg.kv_scale != "auto":
-                    raise ValueError(f"unknown kv_scale {cfg.kv_scale!r}")
-                self.kv_scale = self._calibrate_kv_scales(params)
-            elif isinstance(cfg.kv_scale, (list, tuple, np.ndarray)):
-                self.kv_scale = np.asarray(cfg.kv_scale, np.float32)
-            else:
-                self.kv_scale = float(cfg.kv_scale)
-        else:
-            self.kv_scale = None
         cache = PagedKVCache.create(
             self.model_config,
             cfg.num_blocks,
@@ -200,6 +187,22 @@ class TpuEngine(AsyncEngine):
             cache = shard_tree(cache, PagedKVCache(pages_pspec()), self.mesh)
         self.params = params
         self.cache = cache
+        # Quantized-scale resolution AFTER sharding: the calibration probe
+        # runs over the (possibly tp/dp-sharded) params on the engine's own
+        # mesh — a single-device probe would materialize the whole model on
+        # one chip, OOMing exactly the tp>1 configurations quantized KV
+        # exists for.
+        if jnp.dtype(cfg.cache_dtype).itemsize == 1:
+            if isinstance(cfg.kv_scale, str):
+                if cfg.kv_scale != "auto":
+                    raise ValueError(f"unknown kv_scale {cfg.kv_scale!r}")
+                self.kv_scale = self._calibrate_kv_scales(params)
+            elif isinstance(cfg.kv_scale, (list, tuple, np.ndarray)):
+                self.kv_scale = np.asarray(cfg.kv_scale, np.float32)
+            else:
+                self.kv_scale = float(cfg.kv_scale)
+        else:
+            self.kv_scale = None
 
         model_config, bs = self.model_config, cfg.block_size
         attn_impl = cfg.attn_impl
@@ -378,9 +381,10 @@ class TpuEngine(AsyncEngine):
         """Per-layer quantization scales from a probe forward: run a short
         deterministic token run through the model with a throwaway bf16
         cache, take each layer's max |K/V|, and map it to the target
-        dtype's representable max.  Runs on the UNSHARDED params (before
-        shard_tree), so it is single-process only — multi-host deployments
-        pass the calibrated vector explicitly via kv_scale."""
+        dtype's representable max.  Runs on the engine's own mesh (sharded
+        params + sharded probe cache), so tp>1 models that don't fit one
+        chip calibrate fine; multi-host deployments pass the calibrated
+        vector explicitly via kv_scale."""
         if jax.process_count() > 1:
             raise ValueError(
                 "kv_scale='auto' calibrates on one process; run calibration "
@@ -391,6 +395,8 @@ class TpuEngine(AsyncEngine):
         T = min(128, (cfg.max_blocks_per_seq - 1) * cfg.block_size)
         nb = (T + cfg.block_size - 1) // cfg.block_size + 1
         probe = PagedKVCache.create(mc, nb, cfg.block_size, dtype=jnp.bfloat16)
+        if self.mesh is not None:
+            probe = shard_tree(probe, PagedKVCache(pages_pspec()), self.mesh)
         toks = ((np.arange(T) * 2654435761) % mc.vocab_size).astype(np.int32)
         pos = np.arange(T, dtype=np.int32)
         S = cfg.max_batch
@@ -411,7 +417,9 @@ class TpuEngine(AsyncEngine):
             num_seqs=np.asarray([1], np.int32),
         )
         _, probe = jax.jit(
-            lambda p, c: forward_ragged(p, mc, rb, c, attn_impl="xla")
+            lambda p, c: forward_ragged(
+                p, mc, rb, c, attn_impl="xla", mesh=self.mesh
+            )
         )(params, probe)
         # [L, nb, ps, 2KV, hd] → per-layer max |value| over everything else.
         maxabs = np.asarray(

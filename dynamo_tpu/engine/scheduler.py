@@ -283,10 +283,19 @@ class Scheduler:
         prompt_blocks = (len(seq.prompt) + self.cfg.block_size) // self.cfg.block_size
         if prompt_blocks <= self.kv.free_blocks:
             return True  # fits even with zero prefix hits: skip the hashing
-        from ..tokens import hash_token_blocks
+        # The fused pipeline polls this twice per chunk at saturation; the
+        # prompt is immutable while waiting, so hash it once per sequence
+        # (invalidate on preemption, which folds output into the prompt).
+        cached = getattr(seq, "_admit_hash_cache", None)
+        if cached is None or cached[0] != len(seq.prompt):
+            from ..tokens import hash_token_blocks
 
-        blocks = hash_token_blocks(seq.prompt, self.cfg.block_size)
-        return self.kv.would_fit(blocks, prompt_blocks)
+            cached = (
+                len(seq.prompt),
+                hash_token_blocks(seq.prompt, self.cfg.block_size),
+            )
+            seq._admit_hash_cache = cached
+        return self.kv.would_fit(cached[1], prompt_blocks)
 
     def _try_admit(self, seq: SequenceState) -> bool:
         """Allocate prompt blocks (sharing any cached prefix)."""

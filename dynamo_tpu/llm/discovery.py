@@ -37,11 +37,35 @@ def make_tokenizer(spec: Dict[str, Any]) -> BaseTokenizer:
     if kind == "hf":
         if "file" in spec:
             return HFTokenizer(spec["file"])
-        return HFTokenizer.from_pretrained_dir(spec["dir"])
+        import os
+
+        d = spec["dir"]
+        if not os.path.exists(os.path.join(d, "tokenizer.json")) and spec.get(
+            "source"
+        ):
+            # Registered dirs are paths on the REGISTERING worker's
+            # filesystem; a frontend on another host re-resolves the
+            # original model spec (HF snapshot / pre-staged cache) instead
+            # of silently failing the model registration.
+            from ..models.hub import resolve_model
+
+            logger.info(
+                "tokenizer dir %s not on this host; resolving %r locally",
+                d, spec["source"],
+            )
+            d = resolve_model(spec["source"])
+        return HFTokenizer.from_pretrained_dir(d)
     if kind == "gguf":
+        import os
+
+        f = spec["file"]
+        if not os.path.exists(f) and spec.get("source"):
+            from ..models.hub import resolve_model
+
+            f = resolve_model(spec["source"])
         from ..models.gguf import GGUFFile
 
-        return GGUFFile(spec["file"]).to_tokenizer()
+        return GGUFFile(f).to_tokenizer()
     raise ValueError(f"unknown tokenizer kind {kind!r}")
 
 

@@ -317,3 +317,33 @@ def test_helm_chart_and_metrics_packaging():
             for name in re.findall(r"dynamo_tpu_[a-z_]+", t["expr"]):
                 base = re.sub(r"_(bucket|sum|count)$", "", name)
                 assert base in exported, f"dashboard queries unknown {name}"
+
+
+def test_controller_ignores_server_populated_defaults():
+    """Against a real API server, observed children carry defaulted fields
+    the renderer omits; the drift check must treat those as equal or the
+    operator re-applies every child on every poll forever."""
+    import asyncio
+
+    from dynamo_tpu.deploy.controller import FakeKube, Reconciler
+
+    async def main():
+        kube = FakeKube()
+        rec = Reconciler(kube)
+        cr = _mini_cr()
+        await rec.reconcile(cr)
+        # Simulate the API server defaulting fields on every child.
+        for m in kube.objects.values():
+            if m["kind"] in ("Deployment", "StatefulSet"):
+                m["spec"]["strategy"] = {"type": "RollingUpdate"}
+                m["spec"]["template"]["spec"]["dnsPolicy"] = "ClusterFirst"
+                m["spec"]["template"]["spec"]["restartPolicy"] = "Always"
+        kube.applied.clear()
+        await rec.reconcile(cr)
+        assert kube.applied == [], "defaulted fields must not count as drift"
+        # A REAL drift (owned field changed) still repairs.
+        kube.objects[("Deployment", "app-frontend")]["spec"]["replicas"] = 9
+        await rec.reconcile(cr)
+        assert ("Deployment", "app-frontend") in kube.applied
+
+    asyncio.run(main())

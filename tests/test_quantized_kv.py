@@ -172,3 +172,29 @@ def test_fp8_overflow_saturates_finite():
     # int8 stays round-to-nearest + clip through the same helper.
     q8 = quantize_for_cache(jnp.asarray([[[1.6, -300.0]]], jnp.float32), "int8")
     assert int(q8[0, 0, 0]) == 2 and int(q8[0, 0, 1]) == -128
+
+
+def test_auto_calibration_on_tp_mesh():
+    """kv_scale='auto' must calibrate on the engine's own mesh — a
+    single-device probe would OOM exactly the tp>1 models quantized KV
+    exists for.  Token parity with the single-device engine proves the
+    sharded probe produces equivalent scales."""
+
+    async def main():
+        single = TpuEngine(
+            EngineConfig(**CFG, cache_dtype="int8", kv_scale="auto")
+        )
+        tp2 = TpuEngine(
+            EngineConfig(**CFG, cache_dtype="int8", kv_scale="auto", tp=2)
+        )
+        assert isinstance(tp2.kv_scale, np.ndarray)
+        np.testing.assert_allclose(
+            tp2.kv_scale, single.kv_scale, rtol=1e-4
+        )
+        t1, _ = await _greedy_with_logprobs(single, PROMPTS[0])
+        t2, _ = await _greedy_with_logprobs(tp2, PROMPTS[0])
+        assert t1 == t2
+        await single.close()
+        await tp2.close()
+
+    asyncio.run(main())

@@ -363,3 +363,32 @@ def test_qwen2_family_serves_golden_tokens(tmp_path):
         await engine.close()
 
     asyncio.run(main())
+
+
+def test_tokenizer_spec_reresolves_on_foreign_host(checkpoint, tmp_path, monkeypatch):
+    """A model registered by a worker carries the worker-LOCAL tokenizer
+    dir plus the original model spec; a frontend on another host (dir
+    missing) must re-resolve the spec through models/hub.py instead of
+    silently failing the registration (round-4 review finding)."""
+    import shutil
+
+    from dynamo_tpu.llm.discovery import make_tokenizer
+
+    # Stage the checkpoint where resolve_model's offline cache looks.
+    cache = tmp_path / "cache"
+    staged = cache / "some-org--some-model"
+    shutil.copytree(checkpoint, staged)
+    monkeypatch.setenv("DYN_MODEL_CACHE", str(cache))
+
+    spec = {
+        "kind": "hf",
+        "dir": "/nonexistent/worker/path",  # the registering worker's fs
+        "source": "some-org/some-model",
+    }
+    tok = make_tokenizer(spec)
+    assert tok.chat_template == CHAT_TEMPLATE
+    assert tok.encode("hello world")  # functional tokenizer
+
+    # Without a source there is nothing to re-resolve: the error surfaces.
+    with pytest.raises((FileNotFoundError, OSError, Exception)):
+        make_tokenizer({"kind": "hf", "dir": "/nonexistent/worker/path"})
