@@ -522,3 +522,49 @@ def test_engine_burst_headroom_fallback():
         await engine.close()
 
     asyncio.run(main())
+
+
+def test_cancel_while_token_fetch_in_flight():
+    """A request cancelled while its sampled token is still in flight
+    device→host (parked on awaiting_fetch) must terminate cleanly: the
+    harvest skips the finished row, the flag clears, blocks free, and the
+    engine keeps serving others."""
+
+    async def main():
+        from dynamo_tpu.runtime.engine import Context, collect
+
+        cfg = dict(CFG)
+        cfg.update(max_batch=2, decode_steps=4, pipeline_depth=2)
+        engine = TpuEngine(EngineConfig(**cfg))
+
+        ctx = Context(_req([1, 2, 3], max_tokens=10_000))
+        stream = await engine.generate(ctx)
+        it = stream.__aiter__()
+        await it.__anext__()  # first tokens flowing
+        # Cancel at an arbitrary moment relative to in-flight fetches.
+        ctx.stop_generating()
+        async for _ in it:
+            pass
+
+        # Engine fully releases the sequence despite the in-flight fetch.
+        for _ in range(50):
+            if (
+                engine.scheduler.num_running == 0
+                and engine.kv.active_blocks == 0
+                and not engine._pending_fetches
+            ):
+                break
+            await asyncio.sleep(0.05)
+        assert engine.scheduler.num_running == 0
+        assert engine.kv.active_blocks == 0
+
+        # And a fresh request still serves normally afterwards.
+        toks, final = await _generate(engine, [5, 6, 7], max_tokens=5)
+        assert len(toks) == 5 and final["finish_reason"] == "length"
+        assert all(
+            not getattr(s, "awaiting_fetch", False)
+            for s in engine.scheduler.running + list(engine.scheduler.waiting)
+        )
+        await engine.close()
+
+    asyncio.run(main())
