@@ -51,6 +51,13 @@ class EngineConfig:
     # all round to 0).  Accuracy evidence: tests/test_quantized_kv.py.
     cache_dtype: Optional[str] = None
     kv_scale: Any = 1.0
+    # Weight quantization: "int8" = W8A8-dynamic (per-output-channel int8
+    # weights quantized at load, per-token dynamic int8 activations, native
+    # MXU int8 dots — models/quant.py, ops/quant_matmul.py).  Halves weight
+    # HBM (full-depth 8B fits one v5e chip) and runs ~1.7-1.9x bf16.  The
+    # TPU mapping of the reference baseline's FP8-dynamic checkpoint
+    # (examples/llm/benchmarks/README.md).  None = bf16 weights.
+    weight_quant: Optional[str] = None
     seed: int = 0
     # derived buckets
     batch_buckets: List[int] = field(default_factory=list)
@@ -103,6 +110,12 @@ class EngineConfig:
             )
         if self.cache_dtype is None:
             self.cache_dtype = self.dtype
+        if self.weight_quant not in (None, "int8"):
+            # One check covering every load path (checkpoint / random-init /
+            # externally supplied params).
+            raise ValueError(
+                f"unknown weight_quant {self.weight_quant!r} (supported: int8)"
+            )
 
     @property
     def max_blocks_per_seq(self) -> int:

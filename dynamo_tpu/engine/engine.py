@@ -173,9 +173,23 @@ class TpuEngine(AsyncEngine):
             if cfg.checkpoint_path:
                 from ..models.loader import load_params
 
-                params = load_params(self.model_config, cfg.checkpoint_path)
+                params = load_params(
+                    self.model_config, cfg.checkpoint_path, quant=cfg.weight_quant
+                )
+            elif cfg.weight_quant:
+                from ..models.quant import init_params_quantized
+
+                # Direct int8 init — full-depth random bf16 would OOM the
+                # chip before it could be quantized.
+                params = init_params_quantized(
+                    self.model_config, jax.random.PRNGKey(cfg.seed)
+                )
             else:
                 params = init_params(self.model_config, jax.random.PRNGKey(cfg.seed))
+        elif cfg.weight_quant:
+            from ..models.quant import quantize_params
+
+            params = quantize_params(params)  # no-op if already quantized
         cache = PagedKVCache.create(
             self.model_config,
             cfg.num_blocks,

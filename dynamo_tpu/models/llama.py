@@ -29,12 +29,50 @@ from typing import Any, Dict, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..ops.quant_matmul import qdot
 from ..ops.ragged_attention import ragged_attention, write_kv_ragged
 from ..ops.rope import apply_rope, rope_frequencies
 from .config import ModelConfig
 from .moe import init_moe_params, moe_mlp
 
 Params = Dict[str, Any]
+
+
+def linear(x: jnp.ndarray, lp: Params, name: str, out_dtype=None) -> jnp.ndarray:
+    """``x @ lp[name]``, dispatching on quantization: an int8 weight leaf is
+    recognised by its sibling ``name + "_scale"`` (models/quant.py) and runs
+    the native int8 MXU path (ops/quant_matmul.qdot)."""
+    w = lp[name]
+    s = lp.get(name + "_scale")
+    if s is None:
+        r = x @ w
+        return r.astype(out_dtype) if out_dtype is not None else r
+    return qdot(x, w, s, out_dtype=out_dtype)
+
+
+def embed_lookup(params: Params, token_ids: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Token embedding gather; int8 embeds dequantize the gathered rows by
+    their per-row scale (scale axis = vocab row, shared with the tied head)."""
+    e = params["embed"][token_ids]
+    s = params.get("embed_scale")
+    if s is None:
+        return e
+    return (e.astype(jnp.float32) * s[token_ids][:, None]).astype(dtype)
+
+
+def lm_logits(params: Params, h_last: jnp.ndarray) -> jnp.ndarray:
+    """Final-norm hidden rows → f32 logits, through lm_head or the tied
+    embedding, quantized or not."""
+    head = params.get("lm_head")
+    if head is not None:
+        s = params.get("lm_head_scale")
+        if s is None:
+            return (h_last @ head).astype(jnp.float32)
+        return qdot(h_last, head, s, out_dtype=jnp.float32)
+    s = params.get("embed_scale")
+    if s is None:
+        return (h_last @ params["embed"].T).astype(jnp.float32)
+    return qdot(h_last, params["embed"].T, s, out_dtype=jnp.float32)
 
 
 class PagedKVCache(NamedTuple):
@@ -236,7 +274,7 @@ def forward_ragged(
             )
             return mapped(q, k, v, s_l, pages, slots, kv_lens, tables, cu, num)
 
-    h = params["embed"][rb.token_ids]  # [T, D]
+    h = embed_lookup(params, rb.token_ids, _dtype(config))  # [T, D]
 
     # The page slab rides the layer scan as a CARRY over a flat
     # layer-merged view [L*P, ps, 2KV, hd]; each layer scatters its rows at
@@ -250,7 +288,7 @@ def forward_ragged(
         h, pages = carry
         lp, l = xs
         x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
-        q, k, v = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
+        q, k, v = linear(x, lp, "wq"), linear(x, lp, "wk"), linear(x, lp, "wv")
         if "bq" in lp:  # Qwen2-style attention biases
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = q.reshape(T, H, hd)
@@ -271,13 +309,13 @@ def forward_ragged(
             q, k, v, s_l, pages, slots_l, rb.kv_lens,
             tables_l, rb.cu_q_lens, rb.num_seqs,
         )
-        h = h + attn.reshape(T, H * hd) @ lp["wo"]
+        h = h + linear(attn.reshape(T, H * hd), lp, "wo")
         x = rms_norm(h, lp["mlp_norm"], config.rms_norm_eps)
         if config.is_moe:
             h = h + moe_mlp(x[None], lp, config)[0]
         else:
-            gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-            h = h + ((gate * (x @ lp["w_up"])) @ lp["w_down"])
+            gate = jax.nn.silu(linear(x, lp, "w_gate", jnp.float32)).astype(x.dtype)
+            h = h + linear(gate * linear(x, lp, "w_up"), lp, "w_down")
         return (h, pages), None
 
     flat = cache.pages.reshape((L * P_layer,) + cache.pages.shape[2:])
@@ -305,11 +343,7 @@ def forward_ragged(
 
     h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
     rows = jnp.clip(rb.cu_q_lens[1:] - 1, 0, T - 1)  # [S] last token per row
-    h_last = h[rows]
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    logits = (h_last @ head).astype(jnp.float32)  # [S, vocab]
+    logits = lm_logits(params, h[rows])  # [S, vocab] f32
     return logits, PagedKVCache(pages)
 
 
@@ -357,25 +391,26 @@ def forward_sp_prefill(
     )
 
     positions = jnp.arange(Tg, dtype=jnp.int32)
-    h = params["embed"][token_ids]  # [Tg, D] — sharded over sp by input spec
+    # [Tg, D] — sharded over sp by input spec
+    h = embed_lookup(params, token_ids, _dtype(config))
 
     def layer(carry, lp):
         h = carry
         x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
-        q, k, v = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
+        q, k, v = linear(x, lp, "wq"), linear(x, lp, "wk"), linear(x, lp, "wv")
         if "bq" in lp:  # Qwen2-style attention biases
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = apply_rope(q.reshape(Tg, H, hd), positions, inv_freq)
         k = apply_rope(k.reshape(Tg, KV, hd), positions, inv_freq)
         v = v.reshape(Tg, KV, hd)
         attn = ring(q, k, v, jnp.asarray([valid], jnp.int32))
-        h = h + attn.reshape(Tg, H * hd) @ lp["wo"]
+        h = h + linear(attn.reshape(Tg, H * hd), lp, "wo")
         x = rms_norm(h, lp["mlp_norm"], config.rms_norm_eps)
         if config.is_moe:
             h = h + moe_mlp(x[None], lp, config)[0]
         else:
-            gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-            h = h + (gate * (x @ lp["w_up"])) @ lp["w_down"]
+            gate = jax.nn.silu(linear(x, lp, "w_gate", jnp.float32)).astype(x.dtype)
+            h = h + linear(gate * linear(x, lp, "w_up"), lp, "w_down")
         # pages layout rows: K at even combined-head indices, V at odd
         comb = jnp.stack([k, v], axis=2).reshape(Tg, 2 * KV, hd)
         return h, comb
@@ -383,9 +418,5 @@ def forward_sp_prefill(
     h, kv = jax.lax.scan(layer, h, params["layers"])
 
     h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
-    h_last = h[jnp.clip(valid - 1, 0, Tg - 1)]
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    logits = (h_last @ head).astype(jnp.float32)
+    logits = lm_logits(params, h[jnp.clip(valid - 1, 0, Tg - 1)])
     return logits, kv  # kv: [L, Tg, 2KV, hd]

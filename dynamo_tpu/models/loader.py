@@ -60,34 +60,72 @@ def _iter_safetensors(path: str):
                 yield key, f.get_tensor(key)
 
 
-def load_params(config: ModelConfig, path: str, dtype: Any = None) -> Dict[str, Any]:
+def load_params(
+    config: ModelConfig, path: str, dtype: Any = None, quant: str | None = None
+) -> Dict[str, Any]:
     """Load a HF llama-family checkpoint directory into the params tree.
-    A ``.gguf`` path loads through the GGUF container instead."""
+    A ``.gguf`` path loads through the GGUF container instead.
+
+    ``quant="int8"`` quantizes weight tensors ONE AT A TIME on the host
+    (models/quant.py axes) before they reach the device — a full-depth 8B
+    checkpoint in bf16 (~16GB) would not fit single-chip HBM, which is the
+    point of quantizing.  Matches the reference baseline's quantized-weights
+    workload (examples/llm/benchmarks/README.md: ``...-FP8-dynamic``)."""
     import jax.numpy as jnp
 
+    if quant not in (None, "int8"):
+        raise ValueError(f"unknown weight quant {quant!r} (supported: int8)")
     if path.endswith(".gguf"):
         from .gguf import load_params_gguf
+        from .quant import quantize_params
 
-        return load_params_gguf(config, path, dtype)
+        if not quant:
+            return load_params_gguf(config, path, dtype)
+        # Quantizing: keep the full bf16 tree OFF the accelerator — load and
+        # quantize on the host CPU device, then move only the int8 tree over
+        # (the HF branch below gets the same guarantee tensor-at-a-time).
+        import jax
+
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            params = quantize_params(load_params_gguf(config, path, dtype))
+        return jax.tree_util.tree_map(jax.device_put, params)
+
+    from .quant import _LAYER_QUANT_AXES, _TOP_QUANT_AXES, quantize_array_np
 
     dt = jnp.dtype(dtype or config.dtype)
     L, E = config.num_layers, config.num_experts
     per_layer: Dict[str, List[Any]] = {}
+    # Per-layer quantization scales, same [L] slots as per_layer.
+    per_scale: Dict[str, List[Any]] = {}
     # MoE expert tensors: name → [L][E] grid, stacked to [L, E, ...] at the end.
     per_expert: Dict[str, List[List[Any]]] = {}
+    per_expert_scale: Dict[str, List[List[Any]]] = {}
     params: Dict[str, Any] = {"layers": {}}
 
     def put_layer(name: str, idx: int, value: np.ndarray) -> None:
-        slot = per_layer.setdefault(name, [None] * L)
-        slot[idx] = value
+        if quant and name in _LAYER_QUANT_AXES:
+            # Stacked axis is 0, so the per-tensor quant axis is one less.
+            q, s = quantize_array_np(value, _LAYER_QUANT_AXES[name] - 1)
+            per_scale.setdefault(name, [None] * L)[idx] = s
+            value = q
+        per_layer.setdefault(name, [None] * L)[idx] = value
+
+    def put_top(name: str, value: np.ndarray) -> None:
+        if quant and name in _TOP_QUANT_AXES:
+            q, s = quantize_array_np(value, _TOP_QUANT_AXES[name])
+            params[name] = jnp.asarray(q)
+            params[name + "_scale"] = jnp.asarray(s)
+        else:
+            params[name] = jnp.asarray(value, dt)
 
     for key, tensor in _iter_safetensors(path):
         if key == "model.embed_tokens.weight":
-            params["embed"] = jnp.asarray(tensor, dt)
+            put_top("embed", tensor)
         elif key == "model.norm.weight":
             params["final_norm"] = jnp.asarray(tensor, dt)
         elif key == "lm_head.weight":
-            params["lm_head"] = jnp.asarray(tensor.T, dt)
+            put_top("lm_head", tensor.T)
         elif key.startswith("model.layers."):
             rest = key[len("model.layers.") :]
             idx_str, sub = rest.split(".", 1)
@@ -102,8 +140,17 @@ def load_params(config: ModelConfig, path: str, dtype: Any = None) -> Dict[str, 
                 name = _EXPERT_MAP.get(w_key.removesuffix(".weight"))
                 if name is None:
                     continue
+                value = tensor.T
+                if quant and name in _LAYER_QUANT_AXES:
+                    # Stacked axes are [L, E], so quant axis is two less.
+                    q, s = quantize_array_np(value, _LAYER_QUANT_AXES[name] - 2)
+                    sgrid = per_expert_scale.setdefault(
+                        name, [[None] * E for _ in range(L)]
+                    )
+                    sgrid[int(idx_str)][int(e_str)] = s
+                    value = q
                 grid = per_expert.setdefault(name, [[None] * E for _ in range(L)])
-                grid[int(idx_str)][int(e_str)] = tensor.T
+                grid[int(idx_str)][int(e_str)] = value
                 continue
             mapped = _LAYER_MAP.get(sub)
             if mapped is None:
@@ -115,7 +162,14 @@ def load_params(config: ModelConfig, path: str, dtype: Any = None) -> Dict[str, 
         missing = [i for i, t in enumerate(tensors) if t is None]
         if missing:
             raise ValueError(f"checkpoint missing {name} for layers {missing}")
-        params["layers"][name] = jnp.asarray(np.stack(tensors), dt)
+        stacked = np.stack(tensors)
+        if name in per_scale:
+            params["layers"][name] = jnp.asarray(stacked)  # int8 as-is
+            params["layers"][name + "_scale"] = jnp.asarray(
+                np.stack(per_scale[name])
+            )
+        else:
+            params["layers"][name] = jnp.asarray(stacked, dt)
 
     for name, grid in per_expert.items():
         missing = [
@@ -123,9 +177,14 @@ def load_params(config: ModelConfig, path: str, dtype: Any = None) -> Dict[str, 
         ]
         if missing:
             raise ValueError(f"checkpoint missing {name} for (layer, expert) {missing[:8]}")
-        params["layers"][name] = jnp.asarray(
-            np.stack([np.stack(row) for row in grid]), dt
-        )
+        stacked = np.stack([np.stack(row) for row in grid])
+        if name in per_expert_scale:
+            params["layers"][name] = jnp.asarray(stacked)  # int8 as-is
+            params["layers"][name + "_scale"] = jnp.asarray(
+                np.stack([np.stack(row) for row in per_expert_scale[name]])
+            )
+        else:
+            params["layers"][name] = jnp.asarray(stacked, dt)
 
     if config.is_moe:
         # Fail at load, not at first forward's KeyError (a dense checkpoint
@@ -141,6 +200,7 @@ def load_params(config: ModelConfig, path: str, dtype: Any = None) -> Dict[str, 
         raise ValueError("checkpoint has no model.embed_tokens.weight")
     if config.tie_word_embeddings:
         params.pop("lm_head", None)
+        params.pop("lm_head_scale", None)
     return params
 
 

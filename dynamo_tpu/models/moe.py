@@ -76,13 +76,15 @@ def moe_mlp(
     gate_w = jnp.zeros((E, capacity), jnp.float32)
     gate_w = gate_w.at[flat_e, pos_safe].set(flat_w, mode="drop")
 
+    from ..ops.quant_matmul import expert_linear
+
     x_pad = jnp.concatenate([xt, jnp.zeros((1, D), xt.dtype)], axis=0)
     xe = x_pad[dispatch_idx]  # [E, C, D]
     gate = jax.nn.silu(
-        jnp.einsum("ecd,edf->ecf", xe, lp["moe_gate"]).astype(jnp.float32)
+        expert_linear(xe, lp, "moe_gate", jnp.float32)
     ).astype(x.dtype)
-    up = jnp.einsum("ecd,edf->ecf", xe, lp["moe_up"])
-    ye = jnp.einsum("ecf,efd->ecd", gate * up, lp["moe_down"])  # [E, C, D]
+    up = expert_linear(xe, lp, "moe_up")
+    ye = expert_linear(gate * up, lp, "moe_down")  # [E, C, D]
 
     # Combine: weighted scatter-add back to token rows.
     ye_w = ye.astype(jnp.float32) * gate_w[..., None]

@@ -94,12 +94,27 @@ def param_pspecs(config: ModelConfig) -> Any:
         "moe_gate": P(None, "ep", None, "tp"),
         "moe_up": P(None, "ep", None, "tp"),
         "moe_down": P(None, "ep", "tp", None),
+        # int8 weight-quant scales (models/quant.py): a scale lives on its
+        # weight's OUTPUT-channel axis and shards with it; row-parallel
+        # weights (wo/w_down/moe_down) have replicated outputs.
+        "wq_scale": P(None, "tp"),
+        "wk_scale": P(None, "tp"),
+        "wv_scale": P(None, "tp"),
+        "wo_scale": P(),
+        "w_gate_scale": P(None, "tp"),
+        "w_up_scale": P(None, "tp"),
+        "w_down_scale": P(),
+        "moe_gate_scale": P(None, "ep", "tp"),
+        "moe_up_scale": P(None, "ep", "tp"),
+        "moe_down_scale": P(None, "ep", None),
     }
     specs = {
         "embed": P("tp", None),
+        "embed_scale": P("tp"),  # per-vocab-row, shards with embed
         "layers": layers,
         "final_norm": P(),
         "lm_head": P(None, "tp"),
+        "lm_head_scale": P("tp"),
     }
     return specs
 

@@ -1,0 +1,250 @@
+"""Int8 weight quantization (W8A8-dynamic): op accuracy, load-path
+equivalence, engine serving, sharding, and the quality gate against a
+dequantized reference forward on the real-checkpoint stack.
+
+Reference workload being matched: the baseline benchmark serves a
+quantized-weights checkpoint (FP8-dynamic —
+/root/reference/examples/llm/benchmarks/README.md); v5e's native
+low-precision path is int8 (models/quant.py docstring has the measured
+numbers and the w8a16-rejected design note)."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine import EngineConfig
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.models.config import get_config
+from dynamo_tpu.models.llama import (
+    PagedKVCache,
+    RaggedBatch,
+    forward_ragged,
+    init_params,
+)
+from dynamo_tpu.models.quant import (
+    dequantize_params,
+    init_params_quantized,
+    is_quantized,
+    quantize_params,
+)
+from dynamo_tpu.ops.quant_matmul import qdot, qdot_batched
+
+from test_engine import _generate  # noqa: F401 (helper reuse)
+
+
+def test_qdot_matches_dequant_matmul():
+    """int8 x int8 qdot vs f32 matmul on dequantized weights: error bounded
+    by the dynamic activation quantization step (~0.4% relative)."""
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (16, 64), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (64, 48), jnp.float32) * 0.1
+    s = jnp.max(jnp.abs(w), axis=0) / 127.0
+    w_q = jnp.round(w / s).astype(jnp.int8)
+
+    got = qdot(x, w_q, s)
+    want = x @ (w_q.astype(jnp.float32) * s)
+    denom = jnp.maximum(jnp.max(jnp.abs(want)), 1e-6)
+    assert float(jnp.max(jnp.abs(got - want)) / denom) < 0.01
+
+    # Batched (MoE) variant.
+    xe = jax.random.normal(key, (4, 8, 64), jnp.float32)
+    we = jax.random.normal(jax.random.PRNGKey(2), (4, 64, 32), jnp.float32) * 0.1
+    se = jnp.max(jnp.abs(we), axis=1) / 127.0
+    we_q = jnp.round(we / se[:, None, :]).astype(jnp.int8)
+    got = qdot_batched(xe, we_q, se)
+    want = jnp.einsum("ecd,edf->ecf", xe, we_q.astype(jnp.float32) * se[:, None, :])
+    denom = jnp.maximum(jnp.max(jnp.abs(want)), 1e-6)
+    assert float(jnp.max(jnp.abs(got - want)) / denom) < 0.01
+
+    # Zero rows stay exactly zero (scale guard, no NaN).
+    z = qdot(jnp.zeros((2, 64), jnp.float32), w_q, s)
+    assert float(jnp.max(jnp.abs(z))) == 0.0
+
+
+@pytest.mark.parametrize("model", ["debug-tiny", "debug-tiny-moe"])
+def test_quantize_dequantize_roundtrip(model):
+    """Per-channel symmetric int8: |w - dequant(quant(w))| <= scale/2
+    elementwise, and norms/router/biases pass through untouched."""
+    cfg = get_config(model)
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    qp = quantize_params(params)
+    assert is_quantized(qp)
+    assert quantize_params(qp) is qp  # idempotent
+    deq = dequantize_params(qp)
+    for name in ("wq", "wo", "w_down" if not cfg.is_moe else "moe_down"):
+        w = np.asarray(params["layers"][name], np.float32)
+        d = np.asarray(deq["layers"][name], np.float32)
+        s = np.asarray(qp["layers"][name + "_scale"], np.float32)
+        bound = np.expand_dims(s, 1 if name.startswith("w") and s.ndim == 2 else -2) * 0.51
+        assert np.all(np.abs(w - d) <= bound + 1e-9)
+    # Unquantized leaves are identical objects/values.
+    np.testing.assert_array_equal(
+        np.asarray(qp["layers"]["attn_norm"]), np.asarray(params["layers"]["attn_norm"])
+    )
+    if cfg.is_moe:
+        np.testing.assert_array_equal(
+            np.asarray(qp["layers"]["router"]), np.asarray(params["layers"]["router"])
+        )
+
+
+def test_loader_quant_matches_tree_quant(tmp_path):
+    """Loading with quant="int8" (tensor-at-a-time numpy path) must produce
+    bit-identical int8 weights and scales to quantizing the loaded bf16
+    tree (jnp path) — same math, two implementations."""
+    from dynamo_tpu.models.loader import load_params, save_params_hf
+
+    cfg = get_config("debug-tiny")
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    save_params_hf(params, str(tmp_path))
+
+    loaded_q = load_params(cfg, str(tmp_path), quant="int8")
+    ref_q = quantize_params(load_params(cfg, str(tmp_path)))
+    assert is_quantized(loaded_q)
+    for name in ref_q["layers"]:
+        a, b = loaded_q["layers"][name], ref_q["layers"][name]
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+    np.testing.assert_array_equal(
+        np.asarray(loaded_q["embed"]), np.asarray(ref_q["embed"])
+    )
+    np.testing.assert_allclose(
+        np.asarray(loaded_q["embed_scale"]), np.asarray(ref_q["embed_scale"]),
+        rtol=1e-6,
+    )
+
+
+def test_init_params_quantized_structure():
+    """Direct int8 random init mirrors init_params' tree structure with
+    scale siblings (full-depth bench path — no bf16 materialization)."""
+    for model in ("debug-tiny", "debug-tiny-moe"):
+        cfg = get_config(model)
+        qp = init_params_quantized(cfg, jax.random.PRNGKey(0))
+        ref = init_params(cfg, jax.random.PRNGKey(0))
+        want_names = set(ref["layers"])
+        got_names = {k for k in qp["layers"] if not k.endswith("_scale")}
+        assert got_names == want_names
+        for name, leaf in qp["layers"].items():
+            if name.endswith("_scale"):
+                continue
+            assert leaf.shape == ref["layers"][name].shape, name
+            if name + "_scale" in qp["layers"]:
+                assert leaf.dtype == jnp.int8
+        assert qp["embed"].dtype == jnp.int8
+
+
+def _tiny_forward_logits(params, cfg, prompt, dtype="float32"):
+    """Single prefill step over a prompt; returns last-token logits f32."""
+    T = len(prompt)
+    bs = 4
+    nb = (T + bs - 1) // bs + 1
+    cache = PagedKVCache.create(cfg, nb, bs, dtype=jnp.dtype(dtype))
+    rb = RaggedBatch(
+        token_ids=jnp.asarray(prompt, jnp.int32),
+        positions=jnp.arange(T, dtype=jnp.int32),
+        slot_mapping=jnp.arange(T, dtype=jnp.int32),
+        kv_lens=jnp.asarray([T], jnp.int32),
+        page_indices=jnp.arange(nb, dtype=jnp.int32)[None],
+        cu_q_lens=jnp.asarray([0, T], jnp.int32),
+        num_seqs=jnp.asarray([1], jnp.int32),
+    )
+    logits, _ = forward_ragged(params, cfg, rb, cache, attn_impl="xla")
+    return np.asarray(logits[0], np.float32)
+
+
+def test_quant_quality_gate_kl_and_top1():
+    """Quality gate (VERDICT r4 next #1): the int8 engine execution vs an
+    exact dequantized forward of the SAME weights — KL small, and top-1
+    agrees wherever the reference margin clears the observed logit error."""
+    cfg = get_config("debug-tiny").with_overrides(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(11))
+    qp = quantize_params(params)
+    deq = dequantize_params(qp)  # exact f32 of the quantized weights
+
+    rng = np.random.default_rng(5)
+    kls, agree, decisive_total = [], 0, 0
+    for i in range(8):
+        prompt = rng.integers(0, cfg.vocab_size, size=12).tolist()
+        lq = _tiny_forward_logits(qp, cfg, prompt)
+        lr = _tiny_forward_logits(deq, cfg, prompt)
+        pq = np.exp(lq - lq.max());  pq /= pq.sum()
+        pr = np.exp(lr - lr.max());  pr /= pr.sum()
+        kls.append(float(np.sum(pr * (np.log(pr + 1e-12) - np.log(pq + 1e-12)))))
+        err = np.max(np.abs(lq - lr))
+        top2 = np.partition(lr, -2)[-2:]
+        if top2[1] - top2[0] > 3 * err:  # decisive under the observed error
+            decisive_total += 1
+            agree += int(np.argmax(lq) == np.argmax(lr))
+    assert np.mean(kls) < 0.05, kls
+    assert decisive_total == 0 or agree == decisive_total
+
+
+def test_engine_serves_with_weight_quant():
+    """End-to-end: engine built with weight_quant="int8" generates
+    deterministically and reports quantized params."""
+
+    async def main():
+        engine = TpuEngine(
+            EngineConfig(
+                model="debug-tiny",
+                block_size=4,
+                num_blocks=64,
+                max_batch=4,
+                max_model_len=128,
+                prefill_chunk=32,
+                dtype="float32",
+                weight_quant="int8",
+            )
+        )
+        assert is_quantized(engine.params)
+        toks1, final = await _generate(engine, [1, 2, 3, 4, 5], max_tokens=6)
+        assert len(toks1) == 6 and final["finish_reason"] == "length"
+        toks2, _ = await _generate(engine, [1, 2, 3, 4, 5], max_tokens=6)
+        assert toks1 == toks2
+        await engine.close()
+
+    asyncio.run(main())
+
+
+def test_quantized_params_shard_on_tp_mesh():
+    """Scale leaves carry pspecs (parallel/mesh.py): a quantized tree
+    shards over tp=2 and the forward runs under the mesh."""
+    from dynamo_tpu.parallel.mesh import (
+        MeshConfig,
+        make_mesh,
+        param_pspecs,
+        shard_tree,
+    )
+
+    cfg = get_config("debug-tiny").with_overrides(dtype="float32")
+    mesh = make_mesh(MeshConfig(tp=2))
+    qp = quantize_params(init_params(cfg, jax.random.PRNGKey(2)))
+    sharded = shard_tree(qp, param_pspecs(cfg), mesh)
+    # wq int8 [L, D, H*hd] shards its output axis; its scale shards with it.
+    assert sharded["layers"]["wq"].sharding.spec[-1] == "tp"
+    assert sharded["layers"]["wq_scale"].sharding.spec[-1] == "tp"
+
+    prompt = list(range(1, 9))
+    T = len(prompt)
+    cache = PagedKVCache.create(cfg, 4, 4, dtype=jnp.float32)
+    from dynamo_tpu.parallel.mesh import pages_pspec, sharding_tree
+
+    cache = shard_tree(cache, PagedKVCache(pages_pspec()), mesh)
+    rb = RaggedBatch(
+        token_ids=jnp.asarray(prompt, jnp.int32),
+        positions=jnp.arange(T, dtype=jnp.int32),
+        slot_mapping=jnp.arange(T, dtype=jnp.int32),
+        kv_lens=jnp.asarray([T], jnp.int32),
+        page_indices=jnp.arange(4, dtype=jnp.int32)[None],
+        cu_q_lens=jnp.asarray([0, T], jnp.int32),
+        num_seqs=jnp.asarray([1], jnp.int32),
+    )
+    logits, _ = jax.jit(
+        lambda p, c: forward_ragged(p, cfg, rb, c, attn_impl="xla", mesh=mesh)
+    )(sharded, cache)
+    # Matches the single-device quantized forward.
+    ref = _tiny_forward_logits(qp, cfg, prompt)
+    np.testing.assert_allclose(np.asarray(logits[0]), ref, rtol=2e-2, atol=2e-2)
