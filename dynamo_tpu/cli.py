@@ -135,9 +135,12 @@ async def _run(args) -> None:
             # already-connected follower exit permanently instead of
             # reconnecting to the rebound publisher.
             await first.abort()
+            # NB: with no DYN_STEP_TOKEN this wildcard rebind refuses to
+            # start (StepPublisher.start) — the fallback is only available
+            # to authenticated deployments.
             print(
                 f"step plane: cannot serve followers on {step_host}, "
-                "falling back to 0.0.0.0 (firewall the port / set "
+                "falling back to 0.0.0.0 (firewall the port; requires "
                 "DYN_STEP_TOKEN)",
                 flush=True,
             )
@@ -361,9 +364,21 @@ async def _run_api_store(args) -> None:
     if args.kube:
         from .deploy.controller import KubeApi, Reconciler
 
-        reconciler = Reconciler(KubeApi(namespace=args.namespace))
+        # Distinct manager identity: the operator's orphan sweep must never
+        # treat api-store children as its own (and vice versa).
+        reconciler = Reconciler(
+            KubeApi(namespace=args.namespace), manager="api-store"
+        )
+    token = args.token or os.environ.get("DYN_API_TOKEN") or None
+    if token is None and args.host not in ("127.0.0.1", "localhost", "::1"):
+        print(
+            "api-store WARNING: binding a non-loopback address with no "
+            "--token/DYN_API_TOKEN — any network peer can create/delete "
+            "deployments",
+            flush=True,
+        )
     store = await ApiStore(
-        hub, reconciler, host=args.host, port=args.port
+        hub, reconciler, host=args.host, port=args.port, token=token
     ).start()
     print(f"api-store on http://{args.host}:{store.port}", flush=True)
     try:
@@ -553,13 +568,21 @@ def main(argv: Optional[list] = None) -> None:
         help="deployment-management REST API over the hub store",
     )
     p_store.add_argument("--hub", required=True)
-    p_store.add_argument("--host", default="0.0.0.0")
+    # Loopback by default: the store can create/delete k8s objects (with
+    # --kube), so exposure beyond localhost is opt-in and should come with
+    # --token (r4 advisory).
+    p_store.add_argument("--host", default="127.0.0.1")
     p_store.add_argument("--port", type=int, default=7070)
     p_store.add_argument(
         "--kube", action="store_true",
         help="also reconcile created deployments against the k8s API",
     )
     p_store.add_argument("--namespace", default="default")
+    p_store.add_argument(
+        "--token", default=None,
+        help="bearer token required on every request (default: "
+        "DYN_API_TOKEN env; unset = unauthenticated)",
+    )
 
     args = parser.parse_args(argv)
     if args.cmd == "model" and args.verb in ("add", "remove") and not args.name:

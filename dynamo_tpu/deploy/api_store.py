@@ -54,12 +54,19 @@ class ApiStore:
     given, create/delete trigger an immediate reconcile pass.
     """
 
-    def __init__(self, hub, reconciler=None, host="0.0.0.0", port=7070):
+    def __init__(
+        self, hub, reconciler=None, host="127.0.0.1", port=7070, token=None
+    ):
         self.hub = hub
         self.reconciler = reconciler
         self.host, self.port = host, port
+        # Bearer-token gate (r4 advisory: with --kube this API can
+        # create/delete k8s objects, so default to loopback + optional
+        # token; None = unauthenticated, for loopback/dev use).
+        self.token = token
+        middlewares = [self._auth_middleware] if token else []
         self._runner: Optional[web.AppRunner] = None
-        self.app = web.Application()
+        self.app = web.Application(middlewares=middlewares)
         self.app.router.add_post("/api/v1/deployments", self._create)
         self.app.router.add_get("/api/v1/deployments", self._list)
         self.app.router.add_get("/api/v1/deployments/{name}", self._get)
@@ -67,6 +74,18 @@ class ApiStore:
         self.app.router.add_get(
             "/api/v1/deployments/{name}/manifests", self._manifests
         )
+
+    @web.middleware
+    async def _auth_middleware(self, request: web.Request, handler):
+        import hmac
+
+        # bytes compare: compare_digest raises TypeError on non-ASCII str
+        # (a 500 where a 401 belongs).
+        got = request.headers.get("Authorization", "").encode()
+        want = f"Bearer {self.token}".encode()
+        if not hmac.compare_digest(got, want):
+            return web.json_response({"error": "unauthorized"}, status=401)
+        return await handler(request)
 
     # ------------------------------------------------------------- handlers
     async def _create(self, request: web.Request) -> web.Response:

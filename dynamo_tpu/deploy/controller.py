@@ -31,6 +31,12 @@ logger = logging.getLogger(__name__)
 
 GROUP = "dynamo.tpu.io"  # matches deploy/k8s/crd.yaml
 OWNER_LABEL = f"{GROUP}/owner"
+# Which control plane created a child ("operator" = the k8s CR controller,
+# "api-store" = hub-CR REST store running with --kube).  The orphan sweep
+# and teardown only ever touch children carrying their OWN manager value —
+# without this, an operator sharing a namespace with an api-store would
+# sweep away every api-store deployment within one poll (r4 advisory).
+MANAGER_LABEL = f"{GROUP}/managed-by"
 CR_PLURAL = "dynamotpudeployments"
 
 
@@ -236,8 +242,15 @@ class Reconciler:
 
     CHILD_KINDS = ("Deployment", "StatefulSet", "Service")
 
-    def __init__(self, kube):
+    def __init__(self, kube, manager: str = "operator"):
         self.kube = kube
+        # Control-plane identity stamped on children (MANAGER_LABEL); sweep
+        # and teardown are scoped to it.
+        self.manager = manager
+
+    def _mine(self, m: Dict[str, Any]) -> bool:
+        labels = m["metadata"].get("labels") or {}
+        return labels.get(MANAGER_LABEL) == self.manager
 
     async def reconcile(self, cr: Dict[str, Any]) -> Dict[str, Any]:
         """One reconcile pass for ``cr``; returns the status written."""
@@ -245,14 +258,17 @@ class Reconciler:
         desired = []
         for m in render(cr):
             m = copy.deepcopy(m)
-            m["metadata"].setdefault("labels", {})[OWNER_LABEL] = name
+            labels = m["metadata"].setdefault("labels", {})
+            labels[OWNER_LABEL] = name
+            labels[MANAGER_LABEL] = self.manager
             desired.append(m)
         desired_keys = {_kind_name(m) for m in desired}
 
         observed: Dict[Tuple[str, str], Dict[str, Any]] = {}
         for kind in self.CHILD_KINDS:
             for m in await self.kube.list(kind, label=(OWNER_LABEL, name)):
-                observed[_kind_name(m)] = m
+                if self._mine(m):  # never adopt another plane's children
+                    observed[_kind_name(m)] = m
 
         # Create missing / update drifted (covers spec drift AND manual
         # deletion — the apply re-creates).
@@ -272,11 +288,21 @@ class Reconciler:
         return status
 
     async def teardown(self, name: str) -> int:
-        """Delete every child owned by CR ``name``; returns count deleted.
-        Shared by the orphan sweep and the api-store's delete handler."""
+        """Delete every child THIS control plane owns for CR ``name``;
+        returns count deleted.  Shared by the orphan sweep and the
+        api-store's delete handler.  Children stamped by a DIFFERENT
+        manager are left alone; unlabeled children (created before
+        MANAGER_LABEL existed) are included — an explicit delete of this
+        name must not leak pre-upgrade workloads.  (The background orphan
+        sweep stays conservative and never touches unlabeled children;
+        reconcile re-applies labels, so legacy children of live CRs adopt
+        on the first pass.)"""
         count = 0
         for kind in self.CHILD_KINDS:
             for m in await self.kube.list(kind, label=(OWNER_LABEL, name)):
+                mgr = (m["metadata"].get("labels") or {}).get(MANAGER_LABEL)
+                if mgr is not None and mgr != self.manager:
+                    continue
                 await self.kube.delete(*_kind_name(m))
                 count += 1
         return count
@@ -324,18 +350,27 @@ class Reconciler:
                             "reconcile failed for %s",
                             cr["metadata"]["name"],
                         )
-                # Orphan sweep: children whose owner CR is gone.
-                names = {c["metadata"]["name"] for c in crs}
-                orphaned = set()
-                for kind in self.CHILD_KINDS:
-                    for m in await self.kube.list(kind):
-                        owner = (m["metadata"].get("labels") or {}).get(
-                            OWNER_LABEL
-                        )
-                        if owner is not None and owner not in names:
-                            orphaned.add(owner)
-                for owner in orphaned:
-                    await self.teardown(owner)
+                await self.sweep_orphans(
+                    {c["metadata"]["name"] for c in crs}
+                )
             except Exception:
                 logger.exception("controller pass failed")
             await asyncio.sleep(poll_interval)
+
+    async def sweep_orphans(self, live_names) -> int:
+        """Tear down children whose owner CR is gone — scoped to children
+        THIS manager created (MANAGER_LABEL); an api-store's deployments in
+        the same namespace carry a different manager value and are never
+        swept (r4 advisory).  Returns the number of children deleted."""
+        orphaned = set()
+        for kind in self.CHILD_KINDS:
+            for m in await self.kube.list(
+                kind, label=(MANAGER_LABEL, self.manager)
+            ):
+                owner = (m["metadata"].get("labels") or {}).get(OWNER_LABEL)
+                if owner is not None and owner not in live_names:
+                    orphaned.add(owner)
+        count = 0
+        for owner in orphaned:
+            count += await self.teardown(owner)
+        return count

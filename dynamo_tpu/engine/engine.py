@@ -61,14 +61,17 @@ logger = logging.getLogger(__name__)
 _FINISHED = object()  # queue sentinel
 
 
-def _scales_close(a, b, rtol: float = 0.05) -> bool:
+def _scales_close(a, b, rtol: float = 1e-3) -> bool:
     """Stored-representation scale compatibility for KV transfers.
 
     Exact equality would silently disable disagg transfers between two
     workers that each ran kv_scale='auto' (independent calibration drifts
-    at the ULP level across device generations / compiler versions); a few
-    percent of relative drift is within the quantization noise floor.
-    """
+    at the ULP level across device generations / compiler versions).  The
+    tolerance covers exactly that ULP/compiler drift and NO more: beyond it
+    the quantized rows genuinely encode different values, and importing
+    them raw would carry a systematic dequantization error — such imports
+    are rejected and the caller prefills locally (r4 review: the earlier 5%
+    tolerance silently accepted up to ~5% of real scale error)."""
     if a is None or b is None:
         return a is None and b is None
     av = np.asarray(a, np.float32).reshape(-1)

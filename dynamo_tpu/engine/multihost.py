@@ -71,6 +71,25 @@ class StepPublisher:
         self._connected = asyncio.Event()
 
     async def start(self, timeout: float = 120.0) -> "StepPublisher":
+        if not os.environ.get("DYN_STEP_TOKEN"):
+            # Post-hello frames are unpickled (code execution); with no
+            # token the hello is the well-known sha256("") ANY peer can
+            # send.  Refuse the wildcard bind outright; on a specific
+            # interface warn loudly (r4 advisory).
+            if self.host in ("0.0.0.0", "::"):
+                raise RuntimeError(
+                    "step plane: refusing to bind a wildcard address with "
+                    "no DYN_STEP_TOKEN set — any peer reaching the port "
+                    "would get pickle-level code execution on the leader. "
+                    "Set DYN_STEP_TOKEN on every node (or bind a private "
+                    "interface)."
+                )
+            logger.warning(
+                "step plane: DYN_STEP_TOKEN is unset — any peer that can "
+                "reach %s:%d is trusted with pickled frames; set the token "
+                "on every node",
+                self.host, self.port,
+            )
         expect = _hello_frame()
 
         async def on_conn(reader, writer):

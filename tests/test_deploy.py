@@ -347,3 +347,90 @@ def test_controller_ignores_server_populated_defaults():
         assert ("Deployment", "app-frontend") in kube.applied
 
     asyncio.run(main())
+
+
+def test_orphan_sweep_scoped_to_manager():
+    """r4 advisory (medium): an operator sharing a namespace with an
+    api-store must never sweep the api-store's children — each control
+    plane stamps MANAGER_LABEL and sweeps only its own value."""
+    import asyncio
+
+    from dynamo_tpu.deploy.controller import (
+        FakeKube,
+        MANAGER_LABEL,
+        Reconciler,
+    )
+
+    async def main():
+        kube = FakeKube()
+        operator = Reconciler(kube)  # default manager="operator"
+        store = Reconciler(kube, manager="api-store")
+
+        # The api-store deploys "app" from a hub CR that has NO k8s CR.
+        cr = _mini_cr()
+        await store.reconcile(cr)
+        store_children = [
+            k for k, m in kube.objects.items()
+            if (m["metadata"].get("labels") or {}).get(MANAGER_LABEL)
+            == "api-store"
+        ]
+        assert store_children
+
+        # Operator pass with zero k8s CRs: previously deleted everything
+        # labeled with an unknown owner; now its sweep must not touch them.
+        deleted = await operator.sweep_orphans(live_names=set())
+        assert deleted == 0
+        for key in store_children:
+            assert key in kube.objects
+
+        # The api-store's own sweep still reclaims its orphans.
+        deleted = await store.sweep_orphans(live_names=set())
+        assert deleted == len(store_children)
+        for key in store_children:
+            assert key not in kube.objects
+
+        # And teardown is symmetric: the operator's teardown of the same
+        # name deletes nothing it doesn't manage.
+        await store.reconcile(cr)
+        assert await operator.teardown("app") == 0
+        assert await store.teardown("app") > 0
+
+    asyncio.run(main())
+
+
+def test_api_store_bearer_token_gate():
+    """r4 advisory: with a token configured every route requires
+    Authorization: Bearer; without credentials → 401."""
+    import asyncio
+
+    from aiohttp import ClientSession
+
+    from dynamo_tpu.deploy.api_store import ApiStore
+    from dynamo_tpu.runtime.transports.hub import InprocHub
+
+    async def main():
+        hub = InprocHub()
+        store = await ApiStore(
+            hub, None, host="127.0.0.1", port=0, token="s3cret"
+        ).start()
+        base = f"http://127.0.0.1:{store.port}/api/v1/deployments"
+        spec = {
+            "name": "d1",
+            "image": "dynamo-tpu:latest",
+            "services": {"hub": {"role": "hub"}},
+        }
+        async with ClientSession() as s:
+            r = await s.post(base, json=spec)
+            assert r.status == 401
+            r = await s.get(base, headers={"Authorization": "Bearer wrong"})
+            assert r.status == 401
+            ok = {"Authorization": "Bearer s3cret"}
+            r = await s.post(base, json=spec, headers=ok)
+            assert r.status == 201, await r.text()
+            r = await s.get(base, headers=ok)
+            assert r.status == 200
+            items = (await r.json())["items"]
+            assert [i["metadata"]["name"] for i in items] == ["d1"]
+        await store.close()
+
+    asyncio.run(main())

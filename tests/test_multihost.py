@@ -87,3 +87,34 @@ def test_two_process_host_offload_restores_after_eviction():
         follower.kill()
     assert proof["match"], "restored KV diverged from the original tokens"
     assert proof["restored"] >= 3, proof
+
+
+def test_step_plane_refuses_tokenless_wildcard_bind(monkeypatch):
+    """r4 advisory: with no DYN_STEP_TOKEN the hello is the well-known
+    sha256("") and post-hello frames are unpickled — a wildcard bind must
+    refuse to start; a specific interface still starts (with a warning)."""
+    import asyncio
+
+    import pytest
+
+    from dynamo_tpu.engine.multihost import StepPublisher
+
+    monkeypatch.delenv("DYN_STEP_TOKEN", raising=False)
+
+    async def main():
+        with pytest.raises(RuntimeError, match="DYN_STEP_TOKEN"):
+            await StepPublisher("0.0.0.0", 0, 1).start(timeout=1.0)
+        # Loopback + no token: allowed (warns), times out waiting for the
+        # follower quorum rather than refusing.
+        pub = StepPublisher("127.0.0.1", 0, 1)
+        with pytest.raises(asyncio.TimeoutError):
+            await pub.start(timeout=0.2)
+        await pub.abort()
+        # With a token the wildcard bind is permitted.
+        monkeypatch.setenv("DYN_STEP_TOKEN", "t0k3n")
+        pub = StepPublisher("0.0.0.0", 0, 1)
+        with pytest.raises(asyncio.TimeoutError):
+            await pub.start(timeout=0.2)
+        await pub.abort()
+
+    asyncio.run(main())

@@ -1,0 +1,124 @@
+"""Render benchmarks/RESULTS.md tables MECHANICALLY from committed JSON
+artifacts (VERDICT r4 weak #1: a hand-edited TTFT-p99 column diverged from
+its artifact on 8 of 9 rows — tables must be generated, never typed).
+
+Usage:
+    python tools/render_results.py benchmarks/results/r5_agg_ladder.json
+        -> prints the markdown table for a ladder artifact
+    python tools/render_results.py --inject
+        -> rewrites every  <!-- TABLE:<relpath> --> ... <!-- /TABLE -->
+           block in benchmarks/RESULTS.md from its named artifact
+    python tools/render_results.py --check
+        -> same scan, but only verifies; exit 1 on any drift (CI-able,
+           tests/test_driver_contracts.py runs this)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULTS_MD = os.path.join(REPO, "benchmarks", "RESULTS.md")
+
+_MARK = re.compile(
+    r"(<!-- TABLE:(?P<path>[^ ]+) -->\n)(?P<body>.*?)(<!-- /TABLE -->)",
+    re.DOTALL,
+)
+
+
+def _fmt_ms(v: float) -> str:
+    return f"{v:.0f}ms"
+
+
+def ladder_table(doc: dict) -> str:
+    """Markdown table for a loadgen sweep artifact ({isl, osl, rows})."""
+    lines = [
+        "| conc | reqs | ok | out tok/s | req/s | TTFT p50 | TTFT p99 | ITL p50 | ITL p99 |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in doc["rows"]:
+        lines.append(
+            "| {conc} | {reqs} | {ok} | {tps} | {rps} | {t50} | {t99} | {i50} | {i99} |".format(
+                conc=r["concurrency"],
+                reqs=r["requests"],
+                ok=r["ok"],
+                tps=r["output_tok_s"],
+                rps=r["req_s"],
+                t50=_fmt_ms(r["ttft_p50_ms"]),
+                t99=_fmt_ms(r["ttft_p99_ms"]),
+                i50=f"{r['itl_p50_ms']}ms",
+                i99=f"{r['itl_p99_ms']}ms",
+            )
+        )
+    return "\n".join(lines) + "\n"
+
+
+def scaling_table(doc: dict) -> str:
+    """Markdown table for a bench batch-scaling artifact ({rows: [{max_batch,
+    tok_s, mfu_pct}]})."""
+    lines = ["| max_batch | tok/s | decode MFU |", "|---|---|---|"]
+    for r in doc["rows"]:
+        lines.append(
+            f"| {r['max_batch']} | {r['tok_s']} | {r.get('mfu_pct', '—')}% |"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def render(path: str) -> str:
+    with open(path) as f:
+        doc = json.load(f)
+    if "rows" in doc and doc["rows"] and "concurrency" in doc["rows"][0]:
+        return ladder_table(doc)
+    if "rows" in doc and doc["rows"] and "max_batch" in doc["rows"][0]:
+        return scaling_table(doc)
+    raise SystemExit(f"unrecognized artifact shape: {path}")
+
+
+def inject(check_only: bool) -> int:
+    with open(RESULTS_MD) as f:
+        text = f.read()
+    drift = []
+
+    def repl(m: re.Match) -> str:
+        rel = m.group("path")
+        table = render(os.path.join(REPO, rel))
+        if m.group("body") != table:
+            drift.append(rel)
+        return m.group(1) + table + m.group(4)
+
+    new = _MARK.sub(repl, text)
+    if check_only:
+        if drift:
+            print(f"RESULTS.md tables drifted from artifacts: {drift}")
+            return 1
+        print("RESULTS.md tables match their artifacts")
+        return 0
+    if new != text:
+        with open(RESULTS_MD, "w") as f:
+            f.write(new)
+        print(f"rewrote {len(drift)} table(s): {drift}")
+    else:
+        print("RESULTS.md already up to date")
+    return 0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("artifact", nargs="?", help="print one artifact's table")
+    ap.add_argument("--inject", action="store_true")
+    ap.add_argument("--check", action="store_true")
+    args = ap.parse_args()
+    if args.artifact:
+        sys.stdout.write(render(args.artifact))
+        return
+    if args.inject or args.check:
+        raise SystemExit(inject(check_only=args.check))
+    ap.error("need an artifact path, --inject, or --check")
+
+
+if __name__ == "__main__":
+    main()
