@@ -5,6 +5,9 @@ etcd+NATS replacement).
 Usage:
   python -m dynamo_tpu.cli hub  [--host H] [--port P]
   python -m dynamo_tpu.cli run  in=http out=echocore [--port 8000] [--model echo]
+  python -m dynamo_tpu.cli run  in=text out=tpu --checkpoint DIR    # chat REPL
+  python -m dynamo_tpu.cli run  in=stdin out=tpu ...                # one prompt
+  python -m dynamo_tpu.cli run  in=batch:FILE.jsonl out=tpu ...     # batch eval
   python -m dynamo_tpu.cli run  in=dyn://ns.comp.ep out=echocore --hub HOST:PORT \
         [--model NAME]            # worker: serve engine at endpoint + register model
   python -m dynamo_tpu.cli http --hub HOST:PORT [--port 8000]   # discovery frontend
@@ -50,6 +53,10 @@ def _tokenizer_spec(args) -> dict:
     if tok:
         if tok.endswith(".gguf"):
             return {"kind": "gguf", "file": tok}
+        if tok.endswith(".model"):
+            # Explicit sentencepiece file (the pre-r5 error message pointed
+            # sp-only checkpoints at --tokenizer).
+            return {"kind": "sp", "file": tok}
         if os.path.isdir(tok):
             return {"kind": "hf", "dir": tok}
         return {"kind": "hf", "file": tok}
@@ -159,18 +166,40 @@ async def _run(args) -> None:
         engine = RecordingEngine(engine, recorder)
         print(f"recording streams to {args.record}", flush=True)
 
+    def _console_pipeline():
+        if level == "core":
+            return build_pipeline(
+                [OpenAIPreprocessor(tokenizer, args.model), Backend(tokenizer)],
+                engine,
+            )
+        return engine
+
     if inp == "http":
         service = HttpService(host=args.host, port=args.port)
-        if level == "core":
-            pipeline = build_pipeline(
-                [OpenAIPreprocessor(tokenizer, args.model), Backend(tokenizer)], engine
-            )
-        else:
-            pipeline = engine
+        pipeline = _console_pipeline()
         service.models.add_chat_model(args.model, pipeline)
         service.models.add_completion_model(args.model, pipeline)
         print(f"serving {args.model!r} on http://{args.host}:{args.port}", flush=True)
         await service.run()
+    elif inp in ("text", "stdin") or inp.startswith("batch:"):
+        # Console modes (reference: dynamo-run in=text|stdin|batch:FILE,
+        # launch/dynamo-run/src/opt.rs:23-38) — same pipeline as in=http.
+        from .llm.console import run_batch, run_stdin_prompt, run_text_chat
+
+        pipeline = _console_pipeline()
+        try:
+            if inp == "text":
+                await run_text_chat(pipeline, args.model, args)
+            elif inp == "stdin":
+                await run_stdin_prompt(pipeline, args.model, args)
+            else:
+                await run_batch(
+                    pipeline, args.model, inp[len("batch:"):], args
+                )
+        finally:
+            close = getattr(engine, "close", None)
+            if close is not None:
+                await close()
     elif inp.startswith("dyn://"):
         if not args.hub:
             raise SystemExit("worker mode requires --hub HOST:PORT")
@@ -434,6 +463,9 @@ def main(argv: Optional[list] = None) -> None:
     p_run.add_argument("--host", default="0.0.0.0")
     p_run.add_argument("--port", type=int, default=8000)
     p_run.add_argument("--model", default="echo")
+    # Console input modes (in=text/stdin/batch:FILE) sampling defaults.
+    p_run.add_argument("--max-tokens", type=int, default=None, dest="max_tokens")
+    p_run.add_argument("--temperature", type=float, default=None)
     p_run.add_argument("--tokenizer", default=None, help="path to tokenizer.json")
     p_run.add_argument("--model-config", default=None, help="model config json (out=tpu)")
     # out=tpu engine knobs (reference: launch/dynamo-run/src/flags.rs)

@@ -66,6 +66,17 @@ def make_tokenizer(spec: Dict[str, Any]) -> BaseTokenizer:
         from ..models.gguf import GGUFFile
 
         return GGUFFile(f).to_tokenizer()
+    if kind == "sp":
+        import os
+
+        from .tokenizer import SentencePieceTokenizer
+
+        f = spec["file"]
+        if not os.path.exists(f) and spec.get("source"):
+            from ..models.hub import resolve_model
+
+            f = os.path.join(resolve_model(spec["source"]), "tokenizer.model")
+        return SentencePieceTokenizer(f)
     raise ValueError(f"unknown tokenizer kind {kind!r}")
 
 

@@ -165,6 +165,68 @@ class HFTokenizer(BaseTokenizer):
         return self._chat_template
 
 
+class SentencePieceTokenizer(BaseTokenizer):
+    """``tokenizer.model``-only checkpoints (older Llama/Mistral) served
+    natively via the vendored sentencepiece runtime (llm/sp.py; reference:
+    lib/llm/src/tokenizers/sp.rs).  Chat template / special tokens come
+    from a sibling tokenizer_config.json when present."""
+
+    def __init__(self, model_file: str, config_file: Optional[str] = None):
+        from .sp import SentencePieceModel
+
+        self._sp = SentencePieceModel.from_file(model_file)
+        self._chat_template: Optional[str] = None
+        self.bos_token: Optional[str] = None
+        self.eos_token: Optional[str] = None
+        if config_file is None:
+            candidate = os.path.join(
+                os.path.dirname(model_file), "tokenizer_config.json"
+            )
+            config_file = candidate if os.path.exists(candidate) else None
+        if config_file is not None:
+            with open(config_file) as f:
+                cfg = json.load(f)
+            self._chat_template = cfg.get("chat_template")
+            self.bos_token = _token_str(cfg.get("bos_token"))
+            self.eos_token = _token_str(cfg.get("eos_token"))
+
+    def encode(self, text: str, add_special_tokens: bool = True) -> List[int]:
+        ids = self._sp.encode(text)
+        if add_special_tokens and self._sp.bos_id >= 0:
+            ids = [self._sp.bos_id] + ids
+        return ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        # sp.decode always drops CONTROL/UNKNOWN pieces (sentencepiece
+        # semantics); NORMAL pieces are never "special".
+        return self._sp.decode(list(ids))
+
+    def decode_window(
+        self, ids: Sequence[int], skip_special_tokens: bool = True,
+        *, sequence_start: bool = True,
+    ) -> str:
+        """Window decode for incremental detokenization: a window that does
+        not begin the sequence keeps its leading ▁-space so prefix-diff
+        deltas preserve inter-token spaces (DecodeStream)."""
+        return self._sp.decode(list(ids), sequence_start=sequence_start)
+
+    @property
+    def eos_token_id(self) -> Optional[int]:
+        return self._sp.eos_id if self._sp.eos_id >= 0 else None
+
+    @property
+    def bos_token_id(self) -> Optional[int]:
+        return self._sp.bos_id if self._sp.bos_id >= 0 else None
+
+    @property
+    def vocab_size(self) -> int:
+        return self._sp.vocab_size
+
+    @property
+    def chat_template(self) -> Optional[str]:
+        return self._chat_template
+
+
 def _token_str(value: Any) -> Optional[str]:
     """tokenizer_config tokens are either "..." or {"content": "..."}."""
     if isinstance(value, dict):
@@ -249,11 +311,24 @@ class DecodeStream:
         self._prefix_offset = 0  # start of the decode window (last boundary)
         self._read_offset = 0  # ids before this are already emitted
 
+    def _decode(self, ids: List[int]) -> str:
+        # Mid-stream windows must keep a leading ▁-space (sentencepiece
+        # dummy prefix) or the prefix-diff silently eats inter-token
+        # spaces; tokenizers exposing decode_window get told whether the
+        # window starts the sequence.
+        win = getattr(self._tok, "decode_window", None)
+        if win is not None:
+            return win(
+                ids, skip_special_tokens=self._skip,
+                sequence_start=self._prefix_offset == 0,
+            )
+        return self._tok.decode(ids, skip_special_tokens=self._skip)
+
     def step(self, token_id: int) -> str:
         """Feed one token id; return newly-stable text (may be empty)."""
         self._ids.append(token_id)
         tail = self._ids[self._prefix_offset :]
-        text = self._tok.decode(tail, skip_special_tokens=self._skip)
+        text = self._decode(tail)
         if text.endswith("�"):
             if len(self._ids) - self._read_offset < 4:
                 # Possibly an incomplete multi-byte sequence: hold the
@@ -265,17 +340,11 @@ class DecodeStream:
             # Force-emit the held window and COMMIT past it (both offsets
             # to the end): re-decoding these ids later could resolve
             # differently than what we just emitted and garble the diff.
-            prev = self._tok.decode(
-                self._ids[self._prefix_offset : self._read_offset],
-                skip_special_tokens=self._skip,
-            )
+            prev = self._decode(self._ids[self._prefix_offset : self._read_offset])
             self._prefix_offset = len(self._ids)
             self._read_offset = len(self._ids)
             return text[len(prev) :]
-        prev = self._tok.decode(
-            self._ids[self._prefix_offset : self._read_offset],
-            skip_special_tokens=self._skip,
-        )
+        prev = self._decode(self._ids[self._prefix_offset : self._read_offset])
         delta = text[len(prev) :]
         self._prefix_offset = self._read_offset
         self._read_offset = len(self._ids)
@@ -285,13 +354,8 @@ class DecodeStream:
         """Emit any held-back text at end of stream (replacement chars kept)."""
         if self._read_offset >= len(self._ids):
             return ""
-        text = self._tok.decode(
-            self._ids[self._prefix_offset :], skip_special_tokens=self._skip
-        )
-        prev = self._tok.decode(
-            self._ids[self._prefix_offset : self._read_offset],
-            skip_special_tokens=self._skip,
-        )
+        text = self._decode(self._ids[self._prefix_offset :])
+        prev = self._decode(self._ids[self._prefix_offset : self._read_offset])
         self._read_offset = len(self._ids)
         self._prefix_offset = len(self._ids)
         return text[len(prev) :]

@@ -109,13 +109,7 @@ def tokenizer_spec(path: str) -> Optional[dict]:
     if os.path.exists(os.path.join(path, "tokenizer.json")):
         return {"kind": "hf", "dir": path}
     if os.path.exists(os.path.join(path, "tokenizer.model")):
-        # sentencepiece-only checkpoint: the fast-tokenizer runtime needs
-        # tokenizer.json — serving real weights through the byte-fallback
-        # tokenizer would silently produce garbage text, so refuse loudly.
-        raise ValueError(
-            f"{path} ships only a sentencepiece tokenizer.model; convert it "
-            "to tokenizer.json (transformers: "
-            "AutoTokenizer.from_pretrained(...).save_pretrained) or pass "
-            "--tokenizer explicitly"
-        )
+        # sentencepiece-only checkpoint (older Llama/Mistral): served via
+        # the vendored sp runtime (llm/sp.py; reference sp.rs).
+        return {"kind": "sp", "file": os.path.join(path, "tokenizer.model")}
     return None

@@ -1,0 +1,84 @@
+"""Console input modes (in=text / in=stdin / in=batch:FILE) — reference
+parity with dynamo-run's opt.rs:23-38 input modes, driven as real CLI
+subprocesses against the native debug-tiny engine."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env() -> dict:
+    from conftest import hermetic_child_env
+
+    return hermetic_child_env(REPO) | {"DYN_LOG": "warning"}
+
+
+def _run_cli(*args, stdin="", timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.cli", *args],
+        env=_env(),
+        cwd=REPO,
+        input=stdin,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+ENGINE_ARGS = (
+    "out=tpu", "--arch", "debug-tiny", "--max-tokens", "8",
+    "--block-size", "4", "--num-blocks", "64", "--max-batch", "4",
+    "--max-model-len", "128", "--prefill-chunk", "32", "--dtype", "float32",
+)
+
+
+def test_stdin_mode_single_prompt():
+    """in=stdin: whole stdin = one prompt, completion on stdout, exit 0."""
+    p = _run_cli("run", "in=stdin", *ENGINE_ARGS, stdin="hello world\n")
+    assert p.returncode == 0, p.stdout + p.stderr
+    # The byte tokenizer round-trips whatever tokens the tiny model samples;
+    # the contract is: process exits cleanly after ONE streamed completion.
+    assert p.stdout.endswith("\n")
+
+
+def test_text_mode_interactive_chat():
+    """in=text: REPL consumes prompts line by line until EOF; history kept
+    in-session (two turns served, two answers emitted)."""
+    p = _run_cli("run", "in=text", *ENGINE_ARGS, stdin="hi there\nand again\n")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert p.stdout.count("> ") >= 2  # two prompts consumed + exit on EOF
+
+
+def test_batch_mode_writes_output_jsonl(tmp_path):
+    """in=batch:FILE evaluates every {"text"} line and writes output.jsonl
+    beside it with response/tokens/elapsed/finish_reason (input order)."""
+    batch = tmp_path / "prompts.jsonl"
+    batch.write_text(
+        "\n".join(json.dumps({"text": f"prompt number {i}"}) for i in range(3))
+        + "\n"
+    )
+    p = _run_cli("run", f"in=batch:{batch}", *ENGINE_ARGS)
+    assert p.returncode == 0, p.stdout + p.stderr
+    out = tmp_path / "output.jsonl"
+    assert out.exists()
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert [r["text"] for r in rows] == [f"prompt number {i}" for i in range(3)]
+    for r in rows:
+        assert r.get("error") is None
+        assert r["finish_reason"] == "length"
+        assert r["tokens_out"] == 8
+        assert r["tokens_in"] > 0
+        assert isinstance(r["response"], str)
+        assert r["elapsed_ms"] >= 0
+    assert "batch: 3 prompts" in p.stderr
+
+
+def test_batch_mode_rejects_malformed_file(tmp_path):
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"no_text_key": 1}\n')
+    p = _run_cli("run", f"in=batch:{bad}", *ENGINE_ARGS)
+    assert p.returncode != 0
+    assert "need" in p.stderr
