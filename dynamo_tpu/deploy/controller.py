@@ -228,8 +228,28 @@ class KubeApi:
             data=json.dumps(body),
             headers=self._headers("application/apply-patch+yaml"),
         ) as r:
-            if r.status >= 300:  # CRD without status subresource: best effort
-                logger.debug("status write failed: HTTP %s", r.status)
+            if r.status < 300:
+                return
+            sub_status = r.status
+        attempted = "status subresource"
+        if sub_status in (404, 405):
+            # CRD registered without the status subresource: fall back to
+            # patching status on the main resource (merge-patch).
+            attempted = "subresource (HTTP %s) and merge-patch fallback" % sub_status
+            async with s.patch(
+                self._path("DynamoTpuDeployment", name),
+                data=json.dumps({"status": status}),
+                headers=self._headers("application/merge-patch+json"),
+            ) as r2:
+                if r2.status < 300:
+                    return
+                sub_status = r2.status
+        # A silently-dropped status write hides reconcile results from
+        # kubectl — surface it (r4 weak #6: this was debug-logged).
+        logger.warning(
+            "status write failed for %s: HTTP %s via %s",
+            name, sub_status, attempted,
+        )
 
     async def close(self):
         if self._session is not None:

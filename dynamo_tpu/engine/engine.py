@@ -883,6 +883,12 @@ class TpuEngine(AsyncEngine):
         comb_p[:, :n] = comb
 
         async with self._device_lock:
+            # Lock-HOLD wall only (t0 inside the lock — queueing behind a
+            # decode chunk is the scheduler working as intended, not import
+            # cost): the decode/transfer-overlap contract is that an import
+            # never blocks decode longer than ONE chunk's scatter
+            # (tests/test_disagg.py overlap test reads this).
+            t0 = time.perf_counter()
             # Publish under the device lock (broadcast order == enqueue
             # order; see _run_unified).
             if self._publisher is not None:
@@ -891,6 +897,8 @@ class TpuEngine(AsyncEngine):
             self.cache = await asyncio.to_thread(
                 self._inject_fn, self.cache, *self._prep((page_ids, comb_p))
             )
+            hold = time.perf_counter() - t0
+        self.step_trace.append(("inject", hold, n, 0))
         for bid, tb in zip(ids, blocks):
             self.kv.seal_block(bid, tb)
         self.kv.free_sequence(ids)
@@ -920,9 +928,12 @@ class TpuEngine(AsyncEngine):
         page_ids = np.full((pad,), self.cfg.num_blocks, np.int32)  # OOB pad
         page_ids[:n] = ids
         async with self._device_lock:
+            t0 = time.perf_counter()  # lock HOLD, not wait (see inject_blocks)
             self.cache = await asyncio.to_thread(
                 self._inject_fn, self.cache, page_ids, pages_dev
             )
+            hold = time.perf_counter() - t0
+        self.step_trace.append(("inject", hold, n, 0))
         for bid, tb in zip(ids, blocks[:n]):
             self.kv.seal_block(bid, tb)
         self.kv.free_sequence(ids)
