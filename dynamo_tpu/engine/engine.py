@@ -58,30 +58,17 @@ from .scheduler import Scheduler, SequenceState, StepPlan
 
 logger = logging.getLogger(__name__)
 
-_FINISHED = object()  # queue sentinel
 
 
-def _scales_close(a, b, rtol: float = 1e-3) -> bool:
-    """Stored-representation scale compatibility for KV transfers.
 
-    Exact equality would silently disable disagg transfers between two
-    workers that each ran kv_scale='auto' (independent calibration drifts
-    at the ULP level across device generations / compiler versions).  The
-    tolerance covers exactly that ULP/compiler drift and NO more: beyond it
-    the quantized rows genuinely encode different values, and importing
-    them raw would carry a systematic dequantization error — such imports
-    are rejected and the caller prefills locally (r4 review: the earlier 5%
-    tolerance silently accepted up to ~5% of real scale error)."""
-    if a is None or b is None:
-        return a is None and b is None
-    av = np.asarray(a, np.float32).reshape(-1)
-    bv = np.asarray(b, np.float32).reshape(-1)
-    if av.shape != bv.shape and av.size != 1 and bv.size != 1:
-        return False
-    return bool(np.allclose(av, bv, rtol=rtol))
+from .offload import HostOffloadMixin
+from .pipeline import _FINISHED, DecodePipelineMixin
+from .transfer import KvTransferMixin, _scales_close, transfer_blocks_device  # noqa: F401 — compat re-export
 
 
-class TpuEngine(AsyncEngine):
+class TpuEngine(
+    KvTransferMixin, HostOffloadMixin, DecodePipelineMixin, AsyncEngine
+):
     """Token-in/token-out engine (ExecutionContext equivalent)."""
 
     def __init__(
@@ -143,6 +130,9 @@ class TpuEngine(AsyncEngine):
         # pipeline records dispatch and fetch separately since they
         # overlap.  Bounded: a long-lived server must not grow it forever.
         self.step_trace: deque = deque(maxlen=65536)
+        # Largest observed gap between engine-loop iterations (stall
+        # attribution; reset by clearing alongside step_trace readers).
+        self.loop_gap_max = 0.0
         # Mixed-phase cadence: prefill chunks run since the last decode
         # burst (see _run_loop).
         self._chunks_since_burst = 0
@@ -764,189 +754,9 @@ class TpuEngine(AsyncEngine):
     # Imported pages are sealed under their chained hashes, so the decode
     # scheduler sees remote-prefilled prompts as ordinary prefix-cache hits.
 
-    async def export_prompt_blocks(
-        self, token_ids: List[int], start_block: int = 0, max_blocks: int = 0
-    ) -> Optional[Dict[str, Any]]:
-        """Gather cached KV for ``token_ids``'s complete blocks to host.
 
-        Exports the longest RESIDENT run starting at ``start_block`` (not
-        all-or-nothing — a prompt that lost tail blocks to eviction still
-        transfers its resident prefix; round-2 returned None in that case
-        and recomputed everything).  ``max_blocks`` bounds the run (chunked
-        transfer).  Returns None when nothing is resident at start_block.
-        """
-        from ..tokens import hash_token_blocks
 
-        if jax.process_count() > 1:
-            # Sharded global pages can't be gathered from one host (same
-            # restriction as host_cache_bytes); refuse cleanly at request
-            # time so the caller falls back to local prefill instead of
-            # hanging on a non-addressable array (ADVICE r3).
-            return None
-        blocks = hash_token_blocks(token_ids, self.cfg.block_size)
-        ids: List[int] = []
-        for tb in blocks[start_block:]:
-            bid = self.kv._by_hash.get(tb.sequence_hash)
-            if bid is None:
-                break
-            ids.append(bid)
-            if max_blocks and len(ids) >= max_blocks:
-                break
-        if not ids:
-            return None
-        async with self._device_lock:
-            pages = np.asarray(self.cache.pages[:, np.asarray(ids, np.int32)])
-        k = pages[:, :, :, 0::2]  # [L, n, page_size, KV, hd]
-        v = pages[:, :, :, 1::2]
-        return {
-            "n_blocks": len(ids),
-            "start_block": start_block,
-            "block_size": self.cfg.block_size,
-            "dtype": str(k.dtype),
-            # Stored representation metadata: the importer must match (a
-            # different quantization scale/dtype would seal wrongly-scaled
-            # KV under valid hashes).
-            "kv_scale": self._kv_scale_repr(),
-            "shape": list(k.shape),
-            "k": np.ascontiguousarray(k).tobytes(),
-            "v": np.ascontiguousarray(v).tobytes(),
-        }
 
-    async def inject_blocks(self, token_ids: List[int], payload: Dict[str, Any]) -> int:
-        """Write transferred KV into this engine's cache as sealed blocks.
-
-        ``payload["start_block"]`` supports chunked transfers: chunk k's
-        blocks seal under their chained hashes as they arrive, so decode can
-        overlap with the remaining chunks' transfer (match_prefix walks from
-        block 0, so chunks are useful as soon as their predecessors landed —
-        the sender streams them in order).
-
-        Returns the number of tokens covered by this injection.  The blocks
-        are immediately released to the reuse pool (contents intact), so the
-        very next generate() for these tokens admits with a prefix hit — no
-        special remote-prefill state in the scheduler.
-        """
-        from ..tokens import hash_token_blocks
-
-        start = int(payload.get("start_block", 0))
-        blocks = hash_token_blocks(token_ids, self.cfg.block_size)[start:]
-        n = min(int(payload["n_blocks"]), len(blocks))
-        if n == 0:
-            return 0
-        blocks = blocks[:n]
-        alloc = self.kv.allocate_sequence(blocks, n)
-        if alloc is None:
-            return 0  # no capacity; caller falls back to local prefill
-        if int(payload.get("block_size", self.cfg.block_size)) != self.cfg.block_size:
-            # Mismatched layouts would seal misaligned KV under valid hashes
-            # — refuse and let the caller prefill locally.
-            logger.warning(
-                "rejecting KV import: block_size %s != local %s",
-                payload.get("block_size"),
-                self.cfg.block_size,
-            )
-            self.kv.free_sequence(alloc[0])
-            return 0
-        local_scale = self._kv_scale_repr()
-        if (
-            payload.get("dtype", str(jnp.dtype(self.cfg.cache_dtype)))
-            != str(jnp.dtype(self.cfg.cache_dtype))
-            or not _scales_close(
-                payload.get("kv_scale", local_scale), local_scale
-            )
-        ):
-            # Stored-representation mismatch (quantization dtype/scale):
-            # importing raw rows would mis-scale the prefix silently.
-            logger.warning(
-                "rejecting KV import: stored repr %s/scale %s != local %s/%s",
-                payload.get("dtype"), payload.get("kv_scale"),
-                jnp.dtype(self.cfg.cache_dtype), local_scale,
-            )
-            self.kv.free_sequence(alloc[0])
-            return 0
-        ids, cached = alloc
-        shape = tuple(payload["shape"])
-        name = payload["dtype"]
-        dt = jnp.dtype(name)  # ml_dtypes registers bf16/fp8 names
-        k = np.frombuffer(payload["k"], dtype=dt).reshape(shape)[:, :n]
-        v = np.frombuffer(payload["v"], dtype=dt).reshape(shape)[:, :n]
-        # Interleave back to combined pages [L, n, ps, 2KV, hd] (K even).
-        comb = np.stack([k, v], axis=4).reshape(
-            k.shape[0], n, k.shape[2], 2 * k.shape[3], k.shape[4]
-        )
-        # Pad the page count to a power-of-two bucket so _inject_fn compiles
-        # once per bucket, not once per distinct imported prompt length.
-        pad = 1 << max(0, (n - 1).bit_length())
-        page_ids = np.full((pad,), self.cfg.num_blocks, np.int32)  # OOB pad
-        page_ids[:n] = ids
-        comb_p = np.zeros(comb.shape[:1] + (pad,) + comb.shape[2:], comb.dtype)
-        comb_p[:, :n] = comb
-
-        async with self._device_lock:
-            # Lock-HOLD wall only (t0 inside the lock — queueing behind a
-            # decode chunk is the scheduler working as intended, not import
-            # cost): the decode/transfer-overlap contract is that an import
-            # never blocks decode longer than ONE chunk's scatter
-            # (tests/test_disagg.py overlap test reads this).
-            t0 = time.perf_counter()
-            # Publish under the device lock (broadcast order == enqueue
-            # order; see _run_unified).
-            if self._publisher is not None:
-                await self._publisher.publish("inject", (page_ids, comb_p))
-            # to_thread: compile/execute must not stall the engine loop.
-            self.cache = await asyncio.to_thread(
-                self._inject_fn, self.cache, *self._prep((page_ids, comb_p))
-            )
-            hold = time.perf_counter() - t0
-        self.step_trace.append(("inject", hold, n, 0))
-        for bid, tb in zip(ids, blocks):
-            self.kv.seal_block(bid, tb)
-        self.kv.free_sequence(ids)
-        return n * self.cfg.block_size
-
-    async def inject_blocks_from_device(
-        self, token_ids: List[int], pages_dev, n: int, start_block: int = 0
-    ) -> int:
-        """Seal ``n`` transferred blocks whose pages are ALREADY on device
-        (the ICI/device_put fast path — no host staging).  ``pages_dev`` is
-        [L, pad, ps, 2KV, hd] with the first n slots valid."""
-        from ..tokens import hash_token_blocks
-
-        if jax.process_count() > 1:
-            # Device handles can't cross the leader/follower broadcast; the
-            # host-staged inject_blocks path handles multi-host transfers.
-            return 0
-        blocks = hash_token_blocks(token_ids, self.cfg.block_size)[start_block:]
-        n = min(n, len(blocks))
-        if n == 0:
-            return 0
-        alloc = self.kv.allocate_sequence(blocks[:n], n)
-        if alloc is None:
-            return 0
-        ids, _ = alloc
-        pad = pages_dev.shape[1]
-        page_ids = np.full((pad,), self.cfg.num_blocks, np.int32)  # OOB pad
-        page_ids[:n] = ids
-        async with self._device_lock:
-            t0 = time.perf_counter()  # lock HOLD, not wait (see inject_blocks)
-            self.cache = await asyncio.to_thread(
-                self._inject_fn, self.cache, page_ids, pages_dev
-            )
-            hold = time.perf_counter() - t0
-        self.step_trace.append(("inject", hold, n, 0))
-        for bid, tb in zip(ids, blocks[:n]):
-            self.kv.seal_block(bid, tb)
-        self.kv.free_sequence(ids)
-        return n * self.cfg.block_size
-
-    def _pin_prefix(self, token_ids: List[int]):
-        """Take references on the resident prefix blocks of ``token_ids``
-        (see generate(): keeps pre-admission sp/restore work alive)."""
-        from ..tokens import hash_token_blocks
-
-        return self.kv.acquire_prefix(
-            hash_token_blocks(token_ids, self.cfg.block_size)
-        )
 
     def estimate_prefix_hit(self, token_ids: List[int]) -> int:
         """Tokens of ``token_ids`` already resident locally (router input)."""
@@ -967,7 +777,27 @@ class TpuEngine(AsyncEngine):
             )
 
     async def _run_loop(self) -> None:
+        last_beat = time.perf_counter()
         while not self._closed:
+            # Heartbeat: one iteration = one scheduling decision.  A
+            # multi-second gap here localizes tail-latency stalls to the
+            # ENGINE side (device dispatch, harvest, GC) vs the network /
+            # client — the r4 ladder artifacts carried ~8s TTFT outliers
+            # with no compile and no attribution (VERDICT r4 weak #1).
+            now = time.perf_counter()
+            gap = now - last_beat
+            last_beat = now
+            if gap > self.loop_gap_max:
+                self.loop_gap_max = gap
+            if gap > 5.0:
+                # One iteration can legitimately span a whole fused
+                # pure-decode session (seconds at saturation); beyond that
+                # it smells like a genuine stall (device hiccup, GC, host
+                # pause) — surface it.
+                logger.warning(
+                    "engine loop iteration spanned %.2fs "
+                    "(long fused-decode session or stall)", gap
+                )
             self._cancel_stopped()
             try:
                 while (
@@ -1002,9 +832,13 @@ class TpuEngine(AsyncEngine):
                     await asyncio.sleep(0)
                     continue
                 # Idle: running is empty (running sequences always yield
-                # work), so sleep until a new request arrives.
+                # work), so sleep until a new request arrives.  Idle time
+                # is NOT a stall: re-arm the heartbeat or the first
+                # request after a lull reads the whole idle period as an
+                # engine-side gap.
                 self._wake.clear()
                 await self._wake.wait()
+                last_beat = time.perf_counter()
                 continue
             try:
                 did_work = False
@@ -1088,939 +922,29 @@ class TpuEngine(AsyncEngine):
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
-    def _sampling_arrays(self, seqs: List[SequenceState]) -> SamplingParams:
-        """Build the per-row device sampling state for this step.
 
-        The counts matrix ([S, V], penalties) is the engine's cached
-        all-zeros DEVICE buffer unless some row actually uses a penalty —
-        the common path never pays the [S, V] host→device transfer."""
-        S = self.cfg.max_batch
-        V = self.model_config.vocab_size
-        seeds = np.zeros((S,), np.uint32)
-        steps = np.zeros((S,), np.int32)
-        temp = np.zeros((S,), np.float32)
-        topk = np.zeros((S,), np.int32)
-        topp = np.ones((S,), np.float32)
-        fpen = np.zeros((S,), np.float32)
-        ppen = np.zeros((S,), np.float32)
-        need_lp = False
-        any_pen = False
-        for i, seq in enumerate(seqs):
-            seeds[i] = seq.sampling_seed
-            steps[i] = seq.num_output_tokens
-            temp[i] = seq.sampling_temperature
-            topk[i] = seq.sampling_top_k
-            topp[i] = seq.sampling_top_p
-            fpen[i] = seq.freq_penalty
-            ppen[i] = seq.pres_penalty
-            need_lp = need_lp or seq.logprobs is not None
-            any_pen = any_pen or seq.freq_penalty != 0 or seq.pres_penalty != 0
-        if any_pen:
-            counts_np = np.zeros((S, V), np.int16)
-            for i, seq in enumerate(seqs):
-                out = np.asarray(seq.output, np.int64)
-                if out.size:
-                    np.add.at(counts_np[i], out % V, 1)
-            if self._rep_sharding is not None:
-                counts = self._prep(counts_np)
-            else:
-                counts = jnp.asarray(counts_np)  # committed, key matches cache
-        else:
-            counts = self._zero_counts
-        return SamplingParams(
-            seeds=seeds,
-            steps=steps,
-            temperature=temp,
-            top_k=topk,
-            top_p=topp,
-            freq_penalty=fpen,
-            pres_penalty=ppen,
-            counts=counts,
-            need_logprobs=np.asarray(need_lp),
-        )
 
-    def _tables_row(self, out: np.ndarray, i: int, seq: SequenceState) -> None:
-        ids = seq.block_ids[: out.shape[1]]
-        out[i, : len(ids)] = ids
-
-    def _build_ragged(self, items) -> RaggedBatch:
-        bs = self.cfg.block_size
-        S = self.cfg.max_batch
-        PP = self.cfg.max_blocks_per_seq
-        total = sum(n for _, _, n in items)
-        T = self.cfg.bucket_tokens(total)
-
-        tok = np.zeros((T,), np.int32)
-        pos = np.zeros((T,), np.int32)
-        slots = np.full((T,), -1, np.int32)
-        kv_lens = np.zeros((S,), np.int32)
-        tables = np.zeros((S, PP), np.int32)
-        cu = np.zeros((S + 1,), np.int32)
-        at = 0
-        for i, (seq, start, n) in enumerate(items):
-            all_toks = seq.prompt + seq.output
-            tok[at : at + n] = all_toks[start : start + n]
-            p = np.arange(start, start + n, dtype=np.int32)
-            pos[at : at + n] = p
-            blk = np.asarray(seq.block_ids, np.int32)
-            slots[at : at + n] = blk[p // bs] * bs + p % bs
-            self._tables_row(tables, i, seq)
-            kv_lens[i] = start + n
-            at += n
-            cu[i + 1] = at
-        cu[len(items) + 1 :] = at
-        return RaggedBatch(
-            token_ids=tok,
-            positions=pos,
-            slot_mapping=slots,
-            kv_lens=kv_lens,
-            page_indices=tables,
-            cu_q_lens=cu,
-            num_seqs=np.asarray([len(items)], np.int32),
-        )
 
     # ------------------------------------------------------ unified step path
-    async def _run_unified(self, plan: StepPlan) -> None:
-        rb = self._build_ragged(plan.items)
-        samp = self._sampling_arrays([s for s, _, _ in plan.items])
-        need_lp = bool(samp.need_logprobs)
-        # A step whose every row stays mid-prefill produces sampled tokens
-        # nobody consumes — skip the device→host fetch entirely and let the
-        # next chunk's dispatch queue behind this one.  Over the tunneled
-        # chip a blocking fetch costs ~100ms/chunk, which made chunked
-        # prefill RTT-bound (r3: TTFT 1343ms for ISL 3000 vs ~200ms of
-        # device compute); co-located it still saves a sync per chunk.
-        need_tokens = any(
-            start + n >= len(seq.prompt) for seq, start, n in plan.items
-        )
-        if self._rep_sharding is not None:
-            rb_d, samp_d = self._prep((rb, samp))
-        else:
-            rb_d, samp_d = rb, samp
-        step = self._step_fn
-        while self._pending_fetches and self._pending_fetches[0][1].done():
-            await self._harvest_pending()  # free: task already complete
 
-        def run():
-            out, self.cache = step(self.params, self.cache, rb_d, samp_d)
-            if need_tokens:
-                # Start the D2H now; the accept is deferred to a harvest
-                # point so the round trip overlaps later dispatches.
-                try:
-                    out.tokens.copy_to_host_async()
-                    if need_lp:
-                        out.logprob.copy_to_host_async()
-                        out.top_ids.copy_to_host_async()
-                        out.top_logprobs.copy_to_host_async()
-                except AttributeError:
-                    pass
-            return out
 
-        t0 = time.perf_counter()
-        async with self._device_lock:
-            # Publish INSIDE the device lock: broadcast order must equal
-            # device enqueue order or followers replay a different program
-            # sequence than the leader ran (SPMD divergence).
-            if self._publisher is not None:
-                await self._publisher.publish(
-                    "unified",
-                    (rb, jax.tree_util.tree_map(np.asarray, samp)),
-                )
-            out = await asyncio.to_thread(run)
-        self.step_trace.append(
-            (
-                "unified_fetch" if need_tokens else "unified",
-                time.perf_counter() - t0,
-                len(plan.items),
-                len(rb.token_ids),
-            )
-        )
-
-        pending_rows: List[Tuple[SequenceState, int]] = []
-        for i, (seq, start, n) in enumerate(plan.items):
-            if seq.finished:
-                continue
-            if start >= len(seq.prompt):
-                # Decode row: the fed token joins the hash stream.
-                seq.block_seq.append((seq.prompt + seq.output)[start])
-            seq.num_computed = start + n
-            self._seal_completed_blocks(seq)
-            if not seq.in_prefill:
-                # This row's sampled token is in flight; park the row until
-                # a harvest point applies it.
-                seq.awaiting_fetch = True
-                pending_rows.append((seq, i))
-        if pending_rows:
-            self._stash_fetch("first", out, need_lp, pending_rows)
-
-    def _stash_fetch(self, kind: str, out, need_lp: bool, *meta) -> None:
-        """Park a dispatched step's token fetch: the np.asarray runs on a
-        worker thread STARTING NOW (the D2H was already initiated with
-        copy_to_host_async), and the loop applies the result at a harvest
-        point once the task completes — the device round trip never blocks
-        dispatching."""
-
-        def fetch():
-            if need_lp:
-                return (
-                    np.asarray(out.tokens),
-                    np.asarray(out.logprob),
-                    np.asarray(out.top_ids),
-                    np.asarray(out.top_logprobs),
-                )
-            return np.asarray(out.tokens), None, None, None
-
-        task = asyncio.get_running_loop().create_task(asyncio.to_thread(fetch))
-        self._pending_fetches.append((kind, task, *meta))
-
-    async def _harvest_pending(self, all_pending: bool = False) -> None:
-        """Apply deferred fetches in dispatch order.  Harvests the oldest
-        entry (awaiting its background task), or everything outstanding."""
-        while self._pending_fetches:
-            entry = self._pending_fetches.pop(0)
-            kind, task = entry[0], entry[1]
-
-            t0 = time.perf_counter()
-            sampled, logp, top_ids, top_lp = await task
-            self.step_trace.append(
-                (
-                    f"{kind}_harvest",
-                    time.perf_counter() - t0,
-                    len(entry[2]),
-                    0,
-                )
-            )
-            if kind == "first":
-                for seq, i in entry[2]:
-                    seq.awaiting_fetch = False
-                    if seq.finished:
-                        continue  # cancelled while the token was in flight
-                    self._accept_token(
-                        seq,
-                        int(sampled[i]),
-                        logprobs=self._lp_info(seq, i, logp, top_ids, top_lp),
-                    )
-            else:  # burst
-                members, pos0 = entry[2], entry[3]
-                bs = self.cfg.block_size
-                finished: List[SequenceState] = []
-                for t in range(sampled.shape[0]):
-                    for i, seq in enumerate(members):
-                        seq.awaiting_fetch = False
-                        if seq.finished or pos0[i] < 0:
-                            continue
-                        if seq.num_computed != pos0[i] + t:
-                            continue  # stopped earlier in this burst
-                        if seq.num_computed >= len(seq.block_ids) * bs:
-                            continue  # beyond allocation: never KV-backed
-                        fed = (seq.prompt + seq.output)[seq.num_computed]
-                        if seq.num_computed >= len(seq.prompt):
-                            seq.block_seq.append(fed)
-                        seq.num_computed += 1
-                        self._seal_completed_blocks(seq)
-                        self._accept_token(
-                            seq,
-                            int(sampled[t, i]),
-                            defer_removal=True,
-                            logprobs=self._lp_info(
-                                seq,
-                                i,
-                                None if logp is None else logp[t],
-                                None if top_ids is None else top_ids[t],
-                                None if top_lp is None else top_lp[t],
-                            ),
-                        )
-                        if seq.finished:
-                            finished.append(seq)
-                for seq in finished:
-                    self.scheduler.remove(seq)
-            if not all_pending:
-                break
 
     # -------------------------------------------------- fused decode pipeline
-    async def _decode_pipeline(self, members: List[SequenceState]) -> bool:
-        """Steady-state decode: fused multi-step dispatches with the token
-        carry on device, up to cfg.pipeline_depth dispatches in flight, host
-        readback overlapped.  Runs until membership must change (a sequence
-        finished/cancelled, a new request arrived, or blocks ran out), then
-        drains in-flight work before returning so the scheduler can rebuild.
 
-        Invariant: no member's KV blocks are freed while any dispatch that
-        writes them is in flight — finishes are deferred to the drain point.
-        """
-        cfg = self.cfg
-        bs = cfg.block_size
-        S, T = cfg.max_batch, cfg.decode_steps
-        n = len(members)
 
-        tok0 = np.zeros((S,), np.int32)
-        pos_disp = np.full((S,), -1, np.int32)  # dispatch frontier (-1 = pad)
-        for i, seq in enumerate(members):
-            all_toks = seq.prompt + seq.output
-            tok0[i] = all_toks[seq.num_computed]
-            pos_disp[i] = seq.num_computed
-        tables = np.zeros((S, cfg.max_blocks_per_seq), np.int32)
-        for i, seq in enumerate(members):
-            self._tables_row(tables, i, seq)
-        samp = self._sampling_arrays(members)
-        # Host copy only needed for the follower broadcast — np.asarray on
-        # samp.counts would otherwise drag the [S, V] device buffer to host
-        # on every pipeline build.
-        samp_np = (
-            jax.tree_util.tree_map(np.asarray, samp)
-            if self._publisher is not None
-            else None
-        )
-        need_lp = bool(samp.need_logprobs)
-        # (token, rng-step, penalty-counts) carry: numpy seeds for the first
-        # dispatch, then the previous dispatch's on-device outputs.
-        carry: Optional[Tuple[Any, Any, Any]] = None
-        multi = self._multi_fn
-
-        inflight: deque = deque()
-        finished_members: List[SequenceState] = []
-        rebuild = False
-        dispatched_any = False
-
-        def want_rebuild() -> bool:
-            # Waiting requests only force a rebuild when one could actually
-            # be ADMITTED (free slot + blocks).  At oversubscription the
-            # queue is never empty; gating on num_waiting alone would keep
-            # the fused pipeline permanently disabled (round-3 saturation
-            # collapse: conc 32 throughput below conc 16).
-            return (
-                self._closed
-                or self.scheduler.admission_ready()
-                or any(s.finished for s in members)
-                or any(
-                    (c := self._contexts.get(s.request_id)) is not None
-                    and c.is_stopped
-                    for s in members
-                )
-            )
-
-        while True:
-            # Top up the dispatch window.  With requests queued, cap the
-            # in-flight depth at 2 (enough to overlap fetch with compute) so
-            # the drain a newcomer's admission must wait for stays bounded.
-            depth = (
-                min(cfg.pipeline_depth, 2)
-                if self.scheduler.num_waiting
-                else cfg.pipeline_depth
-            )
-            while not rebuild and len(inflight) < depth:
-                # Don't dispatch chunks no row can still use: once every
-                # member's in-flight frontier covers its remaining token
-                # budget, further chunks are pure waste (their tokens would
-                # all be discarded host-side).  Checked BEFORE allocating
-                # lookahead blocks below — a never-dispatched chunk must not
-                # take KV capacity from other sequences.
-                if not self._any_useful_rows(members, pos_disp):
-                    rebuild = True
-                    break
-                # Ensure every active member has KV room for this chunk.
-                limits = np.zeros((S,), np.int32)
-                ok = True
-                for i, seq in enumerate(members):
-                    if seq.finished:
-                        pos_disp[i] = -1
-                        continue
-                    need = int(pos_disp[i]) + T - seq.num_computed
-                    if not self.scheduler._ensure_slot(seq, lookahead=need):
-                        ok = False
-                    self._tables_row(tables, i, seq)
-                    limits[i] = min(
-                        len(seq.block_ids) * bs,
-                        cfg.max_blocks_per_seq * bs,
-                    )
-                if not ok:
-                    # Out of KV headroom: drain any in-flight work, then
-                    # return so schedule() can preempt with nothing pending.
-                    rebuild = True
-                    break
-                pos0 = pos_disp.copy()
-                first = carry is None
-                pub_payload = (
-                    tok0 if first else None,  # None → follower's own carry
-                    pos0,
-                    tables.copy(),
-                    limits,
-                    samp_np,
-                )
-                if first:
-                    c_tok, c_steps, c_counts = tok0, samp.steps, samp.counts
-                    if self._rep_sharding is not None:
-                        c_tok, c_steps = self._prep((c_tok, c_steps))
-                else:
-                    c_tok, c_steps, c_counts = carry
-                if self._rep_sharding is not None:
-                    d_args = self._prep((pos0, tables.copy(), limits, samp))
-                else:
-                    d_args = (pos0, tables, limits, samp)
-
-                def dispatch(args=d_args, tok_in=c_tok, st=c_steps, ct=c_counts):
-                    outs, last, steps_f, counts_f, self.cache = multi(
-                        self.params, self.cache, tok_in, st, ct, *args
-                    )
-                    return outs, (last, steps_f, counts_f)
-
-                t0 = time.perf_counter()
-                async with self._device_lock:
-                    # Broadcast order must equal enqueue order (see
-                    # _run_unified) — publish under the device lock.
-                    if self._publisher is not None:
-                        await self._publisher.publish("multi", pub_payload)
-                    outs, carry = await asyncio.to_thread(dispatch)
-                self.step_trace.append(
-                    ("decode_dispatch", time.perf_counter() - t0, n, n * T)
-                )
-                # Start the D2H copy NOW: it proceeds in the background while
-                # later chunks compute, so the drain fetch below pays ~zero
-                # round-trip instead of compute + full link latency (round-2
-                # measured 323ms per serial fetch over the tunneled chip).
-                try:
-                    outs.tokens.copy_to_host_async()
-                    if need_lp:
-                        outs.logprob.copy_to_host_async()
-                        outs.top_ids.copy_to_host_async()
-                        outs.top_logprobs.copy_to_host_async()
-                except AttributeError:
-                    pass
-                inflight.append((outs, pos0))
-                dispatched_any = True
-                pos_disp = np.where(pos_disp >= 0, pos_disp + T, pos_disp)
-                if want_rebuild():
-                    rebuild = True
-
-            if not inflight:
-                break
-
-            # Await the oldest chunk's tokens and apply them.
-            outs, pos0 = inflight.popleft()
-            t0 = time.perf_counter()
-
-            def fetch(o=outs):
-                if need_lp:
-                    return (
-                        np.asarray(o.tokens),
-                        np.asarray(o.logprob),
-                        np.asarray(o.top_ids),
-                        np.asarray(o.top_logprobs),
-                    )
-                return np.asarray(o.tokens), None, None, None
-
-            sampled, logp, top_ids, top_lp = await asyncio.to_thread(fetch)
-            self.step_trace.append(
-                # "wait" not "fetch": the D2H copy was started at dispatch,
-                # so this wall is dominated by the chunk's device compute.
-                ("decode_wait", time.perf_counter() - t0, n, n * T)
-            )
-            for t in range(T):
-                for i, seq in enumerate(members):
-                    if seq.finished or pos0[i] < 0:
-                        continue
-                    if seq.num_computed != pos0[i] + t:
-                        continue  # stopped earlier in this chunk
-                    limit = len(seq.block_ids) * bs
-                    if seq.num_computed >= limit:
-                        continue  # beyond allocation: token was never KV-backed
-                    fed = (seq.prompt + seq.output)[seq.num_computed]
-                    if seq.num_computed >= len(seq.prompt):
-                        seq.block_seq.append(fed)
-                    seq.num_computed += 1
-                    self._seal_completed_blocks(seq)
-                    self._accept_token(
-                        seq,
-                        int(sampled[t, i]),
-                        defer_removal=True,
-                        logprobs=self._lp_info(
-                            seq,
-                            i,
-                            None if logp is None else logp[t],
-                            None if top_ids is None else top_ids[t],
-                            None if top_lp is None else top_lp[t],
-                        ),
-                    )
-                    if seq.finished:
-                        finished_members.append(seq)
-            if want_rebuild():
-                rebuild = True
-            if rebuild and not inflight:
-                break
-            await asyncio.sleep(0)  # let ingress/egress run between chunks
-
-        # Drained: now it is safe to release finished members' blocks.
-        for seq in finished_members:
-            self.scheduler.remove(seq)
-        return dispatched_any
-
-    async def _decode_burst(self, members: List[SequenceState]) -> bool:
-        """ONE fused multi-step dispatch for ``members`` (all decoding):
-        decode_steps tokens per row for a single device round trip, used in
-        mixed phases where prefill rows keep the full pipeline from
-        engaging.  Same discard semantics as the pipeline: tokens past a
-        row's stop/limit are dropped host-side.  Returns False (dispatching
-        nothing) when KV headroom for a full burst is missing."""
-        cfg = self.cfg
-        bs = cfg.block_size
-        S, T = cfg.max_batch, cfg.decode_steps
-        n = len(members)
-        tok0 = np.zeros((S,), np.int32)
-        pos0 = np.full((S,), -1, np.int32)
-        tables = np.zeros((S, cfg.max_blocks_per_seq), np.int32)
-        limits = np.zeros((S,), np.int32)
-        for i, seq in enumerate(members):
-            if seq.finished:
-                return False  # membership changed under us: replan
-            if not self.scheduler._ensure_slot(seq, lookahead=T):
-                return False
-            all_toks = seq.prompt + seq.output
-            tok0[i] = all_toks[seq.num_computed]
-            pos0[i] = seq.num_computed
-            self._tables_row(tables, i, seq)
-            limits[i] = min(
-                len(seq.block_ids) * bs, cfg.max_blocks_per_seq * bs
-            )
-        while self._pending_fetches and self._pending_fetches[0][1].done():
-            await self._harvest_pending()  # free: task already complete
-        samp = self._sampling_arrays(members)
-        need_lp = bool(samp.need_logprobs)
-        c_tok, c_steps = tok0, samp.steps
-        if self._rep_sharding is not None:
-            c_tok, c_steps = self._prep((c_tok, c_steps))
-            d_args = self._prep((pos0, tables, limits, samp))
-        else:
-            d_args = (pos0, tables, limits, samp)
-        multi = self._multi_fn
-
-        def run():
-            outs, _last, _steps, _counts, self.cache = multi(
-                self.params, self.cache, c_tok, c_steps, samp.counts, *d_args
-            )
-            # Async D2H + deferred accept: the burst's tokens are only
-            # needed at the next harvest point (its rows are parked), so
-            # the round trip overlaps the following prefill chunks instead
-            # of stalling behind the device queue.
-            try:
-                outs.tokens.copy_to_host_async()
-                if need_lp:
-                    outs.logprob.copy_to_host_async()
-                    outs.top_ids.copy_to_host_async()
-                    outs.top_logprobs.copy_to_host_async()
-            except AttributeError:
-                pass
-            return outs
-
-        t0 = time.perf_counter()
-        async with self._device_lock:
-            if self._publisher is not None:
-                await self._publisher.publish(
-                    "multi",
-                    (
-                        tok0,
-                        pos0,
-                        tables.copy(),
-                        limits,
-                        jax.tree_util.tree_map(np.asarray, samp),
-                    ),
-                )
-            outs = await asyncio.to_thread(run)
-        self.step_trace.append(
-            ("decode_burst", time.perf_counter() - t0, n, n * T)
-        )
-        for seq in members:
-            seq.awaiting_fetch = True
-        self._stash_fetch("burst", outs, need_lp, members, pos0)
-        return True
-
-    def _any_useful_rows(
-        self, members: List[SequenceState], pos_disp: np.ndarray
-    ) -> bool:
-        """True if any active member could still accept a token from one more
-        fused chunk, given how far its dispatch frontier already overshoots
-        its accepted position (in-flight tokens count against the budget)."""
-        for i, seq in enumerate(members):
-            if seq.finished or pos_disp[i] < 0:
-                continue
-            overshoot = int(pos_disp[i]) - seq.num_computed
-            budget = self.cfg.max_model_len - seq.total_tokens
-            if seq.max_new_tokens is not None:
-                budget = min(budget, seq.max_new_tokens - seq.num_output_tokens)
-            if budget - overshoot > 0:
-                return True
-        return False
 
     # ------------------------------------------------------------ per-token
-    def _seal_completed_blocks(self, seq: SequenceState) -> None:
-        complete = seq.num_computed // self.cfg.block_size
-        hashed = len(seq.block_seq.blocks)
-        while seq.num_sealed_blocks < min(complete, hashed):
-            idx = seq.num_sealed_blocks
-            tb = seq.block_seq.blocks[idx]
-            self.kv.seal_block(seq.block_ids[idx], tb)
-            seq.num_sealed_blocks += 1
-            if self.host_kv is not None and not self.host_kv.contains(
-                tb.sequence_hash
-            ):
-                self._offload_queue.append((seq.block_ids[idx], tb))
 
     # ------------------------------------------------------- host KV offload
-    async def _offload_pump(self) -> None:
-        """Write-behind: batch-gather queued sealed blocks to the host tier
-        (one device gather + one D2H per cycle, not per block)."""
-        while not self._closed:
-            await asyncio.sleep(self.cfg.host_offload_interval)
-            if self._offload_queue:
-                try:
-                    await self.drain_offload()
-                except Exception:
-                    # Offload is an optimization; never let it kill serving.
-                    logger.exception("host KV offload cycle failed")
 
-    async def drain_offload(self, max_blocks: int = 64) -> int:
-        """Copy up to ``max_blocks`` queued sealed blocks to host RAM.
-        Returns how many were stored (public so tests can force a cycle)."""
-        if self.host_kv is None or not self._offload_queue:
-            return 0
-        batch, self._offload_queue = (
-            self._offload_queue[:max_blocks],
-            self._offload_queue[max_blocks:],
-        )
-        async with self._device_lock:
-            # A block may have been recycled since sealing; only blocks
-            # still holding their hash are snapshotted.
-            live = [
-                (bid, tb)
-                for bid, tb in batch
-                if self.kv._blocks[bid].sequence_hash == tb.sequence_hash
-            ]
-            if not live:
-                return 0
-            pad = 1 << max(0, (len(live) - 1).bit_length())
-            ids = np.zeros((pad,), np.int32)
-            ids[: len(live)] = [bid for bid, _ in live]
-            hashes = [tb.sequence_hash for _, tb in live]
-            # Leader stores FIRST, publish only on success — still under
-            # the device lock, so no other dispatch can interleave and the
-            # followers' execution position matches the leader's.  A
-            # leader-side failure then leaves every tier unchanged instead
-            # of followers holding blocks the leader lacks (tier skew would
-            # surface later as a fatal restore divergence).
-            await asyncio.to_thread(self._offload_store, ids, hashes)
-            if self._publisher is not None:
-                await self._publisher.publish("offload", (ids, hashes))
-        return len(live)
 
-    def _offload_store(self, ids: np.ndarray, hashes: List[int]) -> None:
-        """Gather ``ids``'s pages and store THIS PROCESS's portion in the
-        host tier.  Single-process: the whole block (contiguous, one
-        array).  Multi-process: one slice per locally-held shard, keyed by
-        the shard's heads-axis offset (combined-head axis 3)."""
-        # _prep: in multi-process runs the gather's index operand must be a
-        # replicated GLOBAL array like every other mirrored dispatch.
-        pages_g = self._gather_fn(self.cache, self._prep(ids))
-        if jax.process_count() == 1:
-            pages = np.asarray(pages_g)
-            for i, h in enumerate(hashes):
-                self.host_kv.put(h, np.ascontiguousarray(pages[:, i]))
-            return
-        shards: Dict[int, np.ndarray] = {}
-        for s in pages_g.addressable_shards:
-            start = s.index[3].start or 0
-            if start not in shards:
-                shards[start] = np.asarray(s.data)
-        for i, h in enumerate(hashes):
-            self.host_kv.put(
-                h,
-                {
-                    start: np.ascontiguousarray(arr[:, i])
-                    for start, arr in shards.items()
-                },
-            )
 
-    async def _sp_prefill(self, token_ids: List[int]) -> int:
-        """Whole-prompt sequence-parallel prefill: compute the prompt's KV in
-        one ring-attention pass over the "sp" mesh axis and seal its complete
-        blocks into the paged cache (released to the reuse pool), so
-        admission sees a full prefix hit.  The trailing partial block plus
-        the last token recompute through the normal unified step (which also
-        produces the first sampled token's logits).  Returns sealed tokens.
-        """
-        from ..tokens import hash_token_blocks
 
-        cfg = self.cfg
-        bs = cfg.block_size
-        n_complete = len(token_ids) // bs
-        blocks = hash_token_blocks(token_ids, bs)
-        resident = len(self.kv.match_prefix(blocks))
-        if resident >= n_complete or n_complete == 0:
-            return 0
-        # Token bucket: power of two, multiple of sp (bounds recompiles).
-        Tg = max(cfg.sp, 1 << (len(token_ids) - 1).bit_length())
-        Tg += (-Tg) % cfg.sp
-        toks = np.zeros((Tg,), np.int32)
-        toks[: len(token_ids)] = token_ids
-        valid = np.asarray(len(token_ids), np.int32)
-        # No _device_lock here: the forward is a pure function of
-        # params+tokens (touches no donated cache), so decode dispatches
-        # interleave in the device queue instead of stalling behind the
-        # whole-prompt pass.  (Dedicated disagg prefill workers remain the
-        # intended fit for sp — config.py.)
-        _, kv_rows = await asyncio.to_thread(
-            self._sp_fn, self.params, toks, valid
-        )
-        # [L, Tg, 2KV, hd] → complete-block pages [L, n, bs, 2KV, hd]
-        L = kv_rows.shape[0]
-        if self.kv_scale is not None:
-            # Quantized cache stores value/scale (write_kv_ragged contract);
-            # per-layer calibration vectors broadcast over [L, Tg, 2KV, hd].
-            sc = np.asarray(self.kv_scale, np.float32).reshape(-1, 1, 1, 1)
-            kv_rows = kv_rows.astype(jnp.float32) / sc
-        pages = kv_rows[:, : n_complete * bs].reshape(
-            L, n_complete, bs, kv_rows.shape[2], kv_rows.shape[3]
-        )[:, resident:]
-        n_new = n_complete - resident
-        pad = 1 << max(0, (n_new - 1).bit_length())
-        if pad != n_new:
-            pages = jnp.pad(pages, ((0, 0), (0, pad - n_new), (0, 0), (0, 0), (0, 0)))
-        covered = await self.inject_blocks_from_device(
-            token_ids, pages, n_new, start_block=resident
-        )
-        if covered:
-            logger.info(
-                "sp prefill sealed %d tokens of %d (sp=%d, bucket %d)",
-                covered, len(token_ids), cfg.sp, Tg,
-            )
-        return covered
 
-    async def _restore_from_host(self, token_ids: List[int]) -> int:
-        """Scatter host-tier blocks beyond the HBM-resident prefix back into
-        the device cache (sealed + released to the reuse pool), so admission
-        sees them as ordinary prefix-cache hits.  Returns restored blocks."""
-        if self.host_kv is None:
-            return 0
-        from ..tokens import hash_token_blocks
 
-        blocks = hash_token_blocks(token_ids, self.cfg.block_size)
-        resident = len(self.kv.match_prefix(blocks))
-        run: List[Tuple[Any, np.ndarray]] = []
-        for tb in blocks[resident:]:
-            # peek, not get: this is candidate selection (possibly
-            # truncated below); touching the LRU here would diverge the
-            # leader's eviction order from the followers'.
-            host = self.host_kv.peek(tb.sequence_hash)
-            if host is None:
-                break
-            run.append((tb, host))
-        run = run[: max(0, self.kv.free_blocks - 1)]
-        if not run:
-            return 0
-        # PIN the resident prefix (take references) while allocating the
-        # tail: the prefix blocks sit in the reuse pool and are otherwise
-        # legitimate LRU eviction victims for our own allocations — which
-        # would replace recompute-the-tail with recompute-everything.
-        prefix_ids: List[int] = (
-            self.kv.acquire_prefix(blocks[:resident]) or [] if resident else []
-        )
-        try:
-            ids: List[int] = []
-            for _ in run:
-                bid = self.kv.allocate_block()
-                if bid is None:
-                    break
-                ids.append(bid)
-            run = run[: len(ids)]
-            if not run:
-                self.kv.free_sequence(ids)
-                return 0
-            n = len(run)
-            pad = 1 << max(0, (n - 1).bit_length())
-            page_ids = np.full((pad,), self.cfg.num_blocks, np.int32)  # OOB pad
-            page_ids[:n] = ids
-            if jax.process_count() > 1:
-                # Per-host sharded tier: every process reassembles ITS
-                # devices' slice of each block from its own store — the
-                # broadcast carries only ids + hashes, never page data.
-                hashes = [tb.sequence_hash for tb, _ in run]
-                async with self._device_lock:
-                    # Revalidate UNDER the lock: the offload pump may have
-                    # LRU-evicted a candidate while we awaited it.  Tiers
-                    # mutate only under this lock and in broadcast order,
-                    # so leader-present-here implies follower-present-there;
-                    # a miss now means recompute-prefill, not a crash.
-                    if any(
-                        not isinstance(self.host_kv.peek(h), dict)
-                        for h in hashes
-                    ):
-                        self.kv.free_sequence(ids)
-                        return 0
-                    # Inject locally first; publish only on success (same
-                    # ordering argument as drain_offload).
-                    await asyncio.to_thread(
-                        self._restore_inject, page_ids, hashes
-                    )
-                    if self._publisher is not None:
-                        await self._publisher.publish(
-                            "restore_host", (page_ids, hashes)
-                        )
-            else:
-                comb = np.stack([h for _, h in run], axis=1)  # [L,n,ps,2KV,hd]
-                comb_p = np.zeros(
-                    comb.shape[:1] + (pad,) + comb.shape[2:], comb.dtype
-                )
-                comb_p[:, :n] = comb
-                async with self._device_lock:
-                    if self._publisher is not None:
-                        await self._publisher.publish(
-                            "inject", (page_ids, comb_p)
-                        )
-                    self.cache = await asyncio.to_thread(
-                        self._inject_fn,
-                        self.cache,
-                        *self._prep((page_ids, comb_p)),
-                    )
-                # Candidate selection peeked; refresh recency for the
-                # blocks actually restored (single-process has no
-                # cross-process lockstep to preserve).
-                for tb, _ in run:
-                    self.host_kv.get(tb.sequence_hash)
-            for bid, (tb, _) in zip(ids, run):
-                self.kv.seal_block(bid, tb)
-            self.kv.free_sequence(ids)
-            self.host_kv.restored_blocks += n
-            return n
-        finally:
-            if prefix_ids:
-                self.kv.free_sequence(prefix_ids)
 
-    def _restore_inject(self, page_ids: np.ndarray, hashes: List[int]) -> None:
-        """Multi-process host restore: build this process's devices' slices
-        of the [L, pad, ps, 2KV, hd] block stack from the per-host sharded
-        tier and scatter them into the cache (every process runs this — the
-        leader inline, followers via the 'restore_host' mirror step)."""
-        from jax.sharding import NamedSharding
 
-        from ..parallel.mesh import pages_pspec
 
-        L, _, ps, KV2, hd = self.cache.pages.shape
-        pad = int(page_ids.shape[0])
-        shape = (L, pad, ps, KV2, hd)
-        sharding = NamedSharding(self.mesh, pages_pspec())
-        # Touch each hash exactly once (same broadcast order on every
-        # process → identical LRU order), then build ONE local stack per
-        # distinct head-shard offset — local devices sharing an offset
-        # (dp/ep replicas) reuse the same array.
-        fetched = []
-        for h in hashes:
-            blk = self.host_kv.get(h)
-            if not isinstance(blk, dict):
-                # Tiers mutate only in broadcast order, so after the
-                # leader's under-lock revalidation this cannot happen on a
-                # healthy deployment — fail LOUDLY rather than inject
-                # zeros under a valid hash.
-                raise RuntimeError(f"host tier missing block {h:#x}")
-            fetched.append(blk)
-        idx_map = sharding.addressable_devices_indices_map(shape)
-        locals_by_start: Dict[int, np.ndarray] = {}
-        for index in idx_map.values():
-            start = index[3].start or 0
-            if start in locals_by_start:
-                continue
-            parts = []
-            for h, blk in zip(hashes, fetched):
-                if start not in blk:
-                    raise RuntimeError(
-                        f"host tier missing shard {start} of block {h:#x}"
-                    )
-                parts.append(blk[start])  # [L, ps, local_heads, hd]
-            local = np.stack(parts, axis=1)  # [L, n, ps, lh, hd]
-            if pad != len(hashes):
-                z = np.zeros(
-                    local.shape[:1] + (pad,) + local.shape[2:], local.dtype
-                )
-                z[:, : len(hashes)] = local
-                local = z
-            locals_by_start[start] = local
-        arrays = [
-            jax.device_put(locals_by_start[index[3].start or 0], dev)
-            for dev, index in idx_map.items()
-        ]
-        comb = jax.make_array_from_single_device_arrays(
-            shape, sharding, arrays
-        )
-        self.cache = self._inject_fn(
-            self.cache, self._prep(page_ids), comb
-        )
-
-    def _lp_info(
-        self, seq: SequenceState, i: int, logp, top_ids, top_lp
-    ) -> Optional[Dict[str, Any]]:
-        """Per-token logprob payload for row ``i`` (None unless requested)."""
-        if seq.logprobs is None or logp is None:
-            return None
-        k = min(int(seq.logprobs), top_ids.shape[-1])
-        return {
-            "logprob": float(logp[i]),
-            "top": [
-                (int(top_ids[i, j]), float(top_lp[i, j])) for j in range(k)
-            ],
-        }
-
-    def _accept_token(
-        self,
-        seq: SequenceState,
-        token: int,
-        defer_removal: bool = False,
-        logprobs: Optional[Dict[str, Any]] = None,
-    ) -> None:
-        seq.output.append(token)
-        reason = self._check_stop(seq, token)
-        queue = self._queues.get(seq.request_id)
-        # Stop-triggering tokens (eos / stop_token_ids) are not emitted,
-        # matching the reference Backend's stop handling (backend.rs:234-423).
-        if queue is not None and reason is not FinishReason.STOP:
-            item = LLMEngineOutput.token(token)
-            if logprobs is not None:
-                item["logprobs"] = logprobs
-            queue.put_nowait(item)
-        if reason is not None:
-            seq.finished = True
-            if not defer_removal:
-                self.scheduler.remove(seq)
-            self._finish(seq, reason)
-
-    def _check_stop(self, seq: SequenceState, token: int) -> Optional[FinishReason]:
-        n_out = seq.num_output_tokens  # survives preemption's prompt-folding
-        min_ok = seq.min_new_tokens is None or n_out >= seq.min_new_tokens
-        if min_ok and token in seq.stop_token_ids:
-            return FinishReason.STOP
-        if (
-            min_ok
-            and not seq.ignore_eos
-            and token in self.model_config.eos_token_ids
-        ):
-            return FinishReason.STOP
-        if seq.max_new_tokens is not None and n_out >= seq.max_new_tokens:
-            return FinishReason.LENGTH
-        if seq.total_tokens >= self.cfg.max_model_len:
-            return FinishReason.LENGTH
-        return None
-
-    def _finish(self, seq: SequenceState, reason: FinishReason) -> None:
-        queue = self._queues.get(seq.request_id)
-        if queue is None:
-            return
-        queue.put_nowait(
-            LLMEngineOutput.finished(
-                reason,
-                usage={
-                    "prompt_tokens": seq.orig_prompt_len,
-                    "completion_tokens": seq.num_output_tokens,
-                    "total_tokens": seq.total_tokens,
-                },
-            )
-        )
-        queue.put_nowait(_FINISHED)
 
     def step_summary(self) -> Dict[str, Any]:
         """Aggregate the dispatch trace: counts, wall time, and latency
@@ -2040,44 +964,3 @@ class TpuEngine(AsyncEngine):
         return out
 
 
-async def transfer_blocks_device(src: TpuEngine, dst: TpuEngine, token_ids) -> int:
-    """Co-located prefill→decode KV transfer that never stages in host RAM:
-    device gather from the source cache → ``jax.device_put`` onto the
-    destination's sharding → in-place scatter.  On one chip this is an HBM
-    copy; across chips of a shared slice the put rides ICI — the reference's
-    NIXL/GPUDirect block path (SURVEY §2.6) for same-slice deployments.
-    Returns tokens covered (the longest resident prefix run)."""
-    from ..tokens import hash_token_blocks
-
-    if jax.process_count() > 1:
-        return 0  # same single-process restriction as export_prompt_blocks
-    if src.cfg.block_size != dst.cfg.block_size:
-        return 0
-    if src.cache.pages.shape[0] != dst.cache.pages.shape[0]:
-        return 0  # different layer counts: not the same model
-    if src.cache.pages.dtype != dst.cache.pages.dtype or not _scales_close(
-        src._kv_scale_repr(), dst._kv_scale_repr()
-    ):
-        return 0  # stored representation differs: host path will also refuse
-    blocks = hash_token_blocks(token_ids, src.cfg.block_size)
-    src_ids: List[int] = []
-    for tb in blocks:
-        bid = src.kv._by_hash.get(tb.sequence_hash)
-        if bid is None:
-            break
-        src_ids.append(bid)
-    if not src_ids:
-        return 0
-    n = len(src_ids)
-    pad = 1 << max(0, (n - 1).bit_length())
-    gather_ids = np.zeros((pad,), np.int32)
-    gather_ids[:n] = src_ids
-    async with src._device_lock:
-        pages = await asyncio.to_thread(src._gather_fn, src.cache, gather_ids)
-    if dst.mesh is not None:
-        pages = jax.device_put(
-            pages, jax.tree_util.tree_leaves(dst.cache)[0].sharding
-        )
-    elif pages.devices() != dst.cache.pages.devices():
-        pages = jax.device_put(pages, next(iter(dst.cache.pages.devices())))
-    return await dst.inject_blocks_from_device(token_ids, pages, n)

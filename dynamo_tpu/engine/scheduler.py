@@ -16,6 +16,7 @@ matching the reference engines' recompute-style preemption).
 
 from __future__ import annotations
 
+import time
 import zlib
 from collections import deque
 from dataclasses import dataclass, field
@@ -67,6 +68,11 @@ class SequenceState:
     finished: bool = False
     # blocks sealed (hash-published) so far — index into block_seq.blocks
     num_sealed_blocks: int = 0
+    # Queue-entry timestamp (time.perf_counter): admission latency =
+    # admit time - this.  The dominant TTFT-tail term at saturation is a
+    # newcomer waiting out a fused pure-decode session (r5 stall
+    # diagnosis); admission_waits records it per request.
+    enqueue_t: float = 0.0
 
     def __post_init__(self) -> None:
         if self.orig_prompt_len == 0:
@@ -141,6 +147,8 @@ class Scheduler:
         self.running: List[SequenceState] = []
         self.rejected: List[SequenceState] = []  # can never fit; engine fails them
         self.preempted = 0  # cumulative, for metrics
+        # Queue->admission latencies (s), bounded; loadgen reads per level.
+        self.admission_waits: Deque[float] = deque(maxlen=16384)
 
     # ------------------------------------------------------------------ entry
     def add(self, seq: SequenceState) -> None:
@@ -149,6 +157,7 @@ class Scheduler:
         room = self.cfg.max_model_len - len(seq.prompt)
         if seq.max_new_tokens is None or seq.max_new_tokens > room:
             seq.max_new_tokens = room
+        seq.enqueue_t = time.perf_counter()
         self.waiting.append(seq)
 
     def remove(self, seq: SequenceState) -> None:
@@ -255,6 +264,8 @@ class Scheduler:
                 break
             self.waiting.popleft()
             self.running.append(seq)
+            if seq.enqueue_t:
+                self.admission_waits.append(time.perf_counter() - seq.enqueue_t)
             # Admission always leaves >= 1 prompt token to compute (a fully
             # cached prompt still recomputes its last token for logits).
             chunk = min(budget, len(seq.prompt) - seq.num_computed)
@@ -347,6 +358,11 @@ class Scheduler:
         seq.num_computed = 0
         seq.num_sealed_blocks = 0
         seq.block_seq = TokenBlockSequence(block_size=self.cfg.block_size)
+        # Wait-since-preemption: without this reset, re-admission would
+        # record the span since the ORIGINAL enqueue — including time the
+        # request spent RUNNING — inflating admission_waits exactly in the
+        # KV-pressure regime the metric exists to attribute.
+        seq.enqueue_t = time.perf_counter()
         self.waiting.appendleft(seq)
         self.preempted += 1
 

@@ -68,6 +68,20 @@ def scaling_table(doc: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def quality_table(doc: dict) -> str:
+    """Markdown table for a tools/quant_quality.py artifact."""
+    lines = [
+        "| config | mean KL | top-1 | decisive top-1 |",
+        "|---|---|---|---|",
+    ]
+    for r in doc["rows"]:
+        lines.append(
+            f"| {r['config']} | {r['mean_kl']} | {r['top1_agree']} "
+            f"| {r['decisive_agree']} |"
+        )
+    return "\n".join(lines) + "\n"
+
+
 def render(path: str) -> str:
     with open(path) as f:
         doc = json.load(f)
@@ -75,6 +89,8 @@ def render(path: str) -> str:
         return ladder_table(doc)
     if "rows" in doc and doc["rows"] and "max_batch" in doc["rows"][0]:
         return scaling_table(doc)
+    if "rows" in doc and doc["rows"] and "mean_kl" in doc["rows"][0]:
+        return quality_table(doc)
     raise SystemExit(f"unrecognized artifact shape: {path}")
 
 
