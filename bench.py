@@ -272,6 +272,15 @@ def main() -> None:
         "8040.16" if jax.default_backend() != "cpu" and default_workload else "0"
     )
     prior = float(os.environ.get("BENCH_PRIOR_TPS", default_prior))
+    if prior > 0 and default_workload and model_cfg.num_layers != 18:
+        # Only for the DEFAULT workload, where the prior is known to be
+        # r4's 18-layer number (a BENCH_PRIOR_TPS override may be measured
+        # at any depth — normalizing it by 18 would fabricate a trend).
+        norm = (tps * model_cfg.num_layers) / (prior * 18)
+        print(
+            f"bench: per-layer-normalized vs r4 prior (18L): {norm:.2f}x",
+            file=sys.stderr,
+        )
     print(
         json.dumps(
             {

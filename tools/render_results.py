@@ -82,9 +82,26 @@ def quality_table(doc: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def sweep_table(doc: dict) -> str:
+    """Markdown table for a config-sweep artifact (prefill_chunk rows)."""
+    lines = [
+        "| prefill_chunk | decode slots | conc | out tok/s | TTFT p50 | TTFT p99 |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in doc["rows"]:
+        lines.append(
+            f"| {r['prefill_chunk']} | {r['max_batch']} | {r['concurrency']} "
+            f"| {r['output_tok_s']} | {_fmt_ms(r['ttft_p50_ms'])} "
+            f"| {_fmt_ms(r['ttft_p99_ms'])} |"
+        )
+    return "\n".join(lines) + "\n"
+
+
 def render(path: str) -> str:
     with open(path) as f:
         doc = json.load(f)
+    if "rows" in doc and doc["rows"] and "prefill_chunk" in doc["rows"][0]:
+        return sweep_table(doc)
     if "rows" in doc and doc["rows"] and "concurrency" in doc["rows"][0]:
         return ladder_table(doc)
     if "rows" in doc and doc["rows"] and "max_batch" in doc["rows"][0]:
