@@ -181,6 +181,17 @@ async def _run(args) -> None:
         service.models.add_completion_model(args.model, pipeline)
         print(f"serving {args.model!r} on http://{args.host}:{args.port}", flush=True)
         await service.run()
+    elif inp == "none":
+        # Start the engine with no input surface (reference Input::None,
+        # opt.rs:40-43: externally-coordinated deployments — here, e.g., a
+        # warm spare or a follower-style process someone attaches to later).
+        print(f"engine up (in=none), model {args.model!r}; ctrl-C to exit", flush=True)
+        try:
+            await _wait_forever()
+        finally:
+            close = getattr(engine, "close", None)
+            if close is not None:
+                await close()
     elif inp in ("text", "stdin") or inp.startswith("batch:"):
         # Console modes (reference: dynamo-run in=text|stdin|batch:FILE,
         # launch/dynamo-run/src/opt.rs:23-38) — same pipeline as in=http.

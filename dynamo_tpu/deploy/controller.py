@@ -62,15 +62,19 @@ def _subset(want: Any, have: Any) -> bool:
 
 
 def _spec_equal(desired: Dict[str, Any], observed: Dict[str, Any]) -> bool:
-    """Drift check over the fields the controller owns (spec + labels)."""
+    """Drift check over the fields the controller owns (spec + labels +
+    annotations — Ingress behavior is CONFIGURED via annotations, so a CR
+    annotation edit must count as drift)."""
     return _subset(
         {
             "spec": desired.get("spec"),
             "labels": (desired.get("metadata") or {}).get("labels"),
+            "annotations": (desired.get("metadata") or {}).get("annotations"),
         },
         {
             "spec": observed.get("spec"),
             "labels": (observed.get("metadata") or {}).get("labels"),
+            "annotations": (observed.get("metadata") or {}).get("annotations"),
         },
     )
 
@@ -133,6 +137,7 @@ class KubeApi:
         "Deployment": "/apis/apps/v1/namespaces/{ns}/deployments",
         "StatefulSet": "/apis/apps/v1/namespaces/{ns}/statefulsets",
         "Service": "/api/v1/namespaces/{ns}/services",
+        "Ingress": "/apis/networking.k8s.io/v1/namespaces/{ns}/ingresses",
         "DynamoTpuDeployment": (
             f"/apis/{GROUP}/v1alpha1/namespaces/{{ns}}/{CR_PLURAL}"
         ),
@@ -260,7 +265,7 @@ class KubeApi:
 class Reconciler:
     """Drives one CR (or all CRs) to its rendered desired state."""
 
-    CHILD_KINDS = ("Deployment", "StatefulSet", "Service")
+    CHILD_KINDS = ("Deployment", "StatefulSet", "Service", "Ingress")
 
     def __init__(self, kube, manager: str = "operator"):
         self.kube = kube

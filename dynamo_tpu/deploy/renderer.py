@@ -92,6 +92,12 @@ def render(cr: Dict[str, Any]) -> List[Dict[str, Any]]:
             out.append(_deployment(full, namespace, image, cmd, replicas,
                                    envs, port=HTTP_PORT))
             out.append(_service(full, namespace, HTTP_PORT))
+            ing = svc.get("ingress")
+            if ing is not None:  # {} is an error (host required), not "off"
+                # External exposure for the OpenAI edge (reference operator
+                # renders ingress/virtual-service objects for its frontend;
+                # dynamocomponent_controller.go ingress half).
+                out.append(_ingress(full, namespace, HTTP_PORT, ing))
             continue
         if role == "metrics":
             cmd = ["python", "-m", "dynamo_tpu.cli", "metrics", "--hub",
@@ -206,6 +212,50 @@ def _service(name, namespace, port, headless=False):
     if headless:
         svc["spec"]["clusterIP"] = "None"
     return svc
+
+
+def _ingress(name, namespace, port, ing: Dict[str, Any]):
+    """networking.k8s.io/v1 Ingress for a frontend Service.
+
+    ``ing``: {host: str (required), className: str?, path: str?,
+    tlsSecret: str?, annotations: {...}?}."""
+    host = ing.get("host")
+    if not host:
+        raise ValueError("frontend ingress needs a 'host'")
+    meta = {**_meta(name, name), "namespace": namespace}
+    if ing.get("annotations"):
+        meta["annotations"] = dict(ing["annotations"])
+    spec: Dict[str, Any] = {
+        "rules": [
+            {
+                "host": host,
+                "http": {
+                    "paths": [
+                        {
+                            "path": ing.get("path", "/"),
+                            "pathType": "Prefix",
+                            "backend": {
+                                "service": {
+                                    "name": name,
+                                    "port": {"number": port},
+                                }
+                            },
+                        }
+                    ]
+                },
+            }
+        ]
+    }
+    if ing.get("className"):
+        spec["ingressClassName"] = ing["className"]
+    if ing.get("tlsSecret"):
+        spec["tls"] = [{"hosts": [host], "secretName": ing["tlsSecret"]}]
+    return {
+        "apiVersion": "networking.k8s.io/v1",
+        "kind": "Ingress",
+        "metadata": meta,
+        "spec": spec,
+    }
 
 
 def render_to_yaml(cr: Dict[str, Any]) -> str:

@@ -434,3 +434,119 @@ def test_api_store_bearer_token_gate():
         await store.close()
 
     asyncio.run(main())
+
+
+def test_frontend_ingress_renders_and_reconciles():
+    """A frontend service with an ingress spec renders a
+    networking.k8s.io/v1 Ingress (reference operator's ingress half) and
+    the reconcile loop manages it like any child."""
+    import asyncio
+
+    from dynamo_tpu.deploy.controller import FakeKube, Reconciler
+    from dynamo_tpu.deploy.renderer import render
+
+    cr = {
+        "apiVersion": "dynamo.tpu.io/v1alpha1",
+        "kind": "DynamoTpuDeployment",
+        "metadata": {"name": "app"},
+        "spec": {
+            "image": "img:1",
+            "services": {
+                "hub": {"role": "hub"},
+                "frontend": {
+                    "role": "frontend",
+                    "ingress": {
+                        "host": "llm.example.com",
+                        "className": "nginx",
+                        "tlsSecret": "llm-tls",
+                        "annotations": {"a": "b"},
+                    },
+                },
+            },
+        },
+    }
+    docs = render(cr)
+    ing = next(d for d in docs if d["kind"] == "Ingress")
+    assert ing["apiVersion"] == "networking.k8s.io/v1"
+    rule = ing["spec"]["rules"][0]
+    assert rule["host"] == "llm.example.com"
+    backend = rule["http"]["paths"][0]["backend"]["service"]
+    assert backend == {"name": "app-frontend", "port": {"number": 8000}}
+    assert ing["spec"]["ingressClassName"] == "nginx"
+    assert ing["spec"]["tls"] == [
+        {"hosts": ["llm.example.com"], "secretName": "llm-tls"}
+    ]
+    assert ing["metadata"]["annotations"] == {"a": "b"}
+
+    async def main():
+        kube = FakeKube()
+        rec = Reconciler(kube)
+        kube.objects[("DynamoTpuDeployment", "app")] = cr
+        await rec.reconcile(cr)
+        assert ("Ingress", "app-frontend") in kube.objects
+        # Removing the ingress from the CR deletes the child.
+        del cr["spec"]["services"]["frontend"]["ingress"]
+        await rec.reconcile(cr)
+        assert ("Ingress", "app-frontend") not in kube.objects
+        # Full teardown sweeps ingresses too.
+        await rec.teardown("app")
+        assert not any(k == "Ingress" for k, _ in kube.objects)
+
+    asyncio.run(main())
+
+
+def test_frontend_ingress_requires_host():
+    import pytest
+
+    from dynamo_tpu.deploy.renderer import render
+
+    cr = {
+        "metadata": {"name": "x"},
+        "spec": {
+            "image": "i",
+            "services": {"frontend": {"role": "frontend", "ingress": {}}},
+        },
+    }
+    with pytest.raises(ValueError, match="host"):
+        render(cr)
+
+
+def test_ingress_annotation_edit_counts_as_drift():
+    """Ingress behavior is configured via annotations — a CR annotation
+    edit must reconcile to the live object (review finding)."""
+    import asyncio
+
+    from dynamo_tpu.deploy.controller import FakeKube, Reconciler
+
+    cr = {
+        "metadata": {"name": "app"},
+        "spec": {
+            "image": "img:1",
+            "services": {
+                "frontend": {
+                    "role": "frontend",
+                    "ingress": {"host": "h.example", "annotations": {"k": "1m"}},
+                },
+            },
+        },
+    }
+
+    async def main():
+        kube = FakeKube()
+        rec = Reconciler(kube)
+        kube.objects[("DynamoTpuDeployment", "app")] = cr
+        await rec.reconcile(cr)
+        assert (
+            kube.objects[("Ingress", "app-frontend")]["metadata"]["annotations"]["k"]
+            == "1m"
+        )
+        cr["spec"]["services"]["frontend"]["ingress"]["annotations"]["k"] = "8m"
+        kube.applied.clear()
+        await rec.reconcile(cr)
+        assert ("Ingress", "app-frontend") in kube.applied
+        assert (
+            kube.objects[("Ingress", "app-frontend")]["metadata"]["annotations"]["k"]
+            == "8m"
+        )
+
+    asyncio.run(main())
