@@ -379,19 +379,62 @@ async def _run_mock_worker(args) -> None:
 
 
 async def _run_operator(args) -> None:
-    """In-cluster reconcile loop (reference: the Go operator binary)."""
+    """In-cluster reconcile loop (reference: the Go operator binary) —
+    drives BOTH CRDs: deployments and model caches (the reference's
+    dynamonimdeployment + dynamonimrequest controller pair)."""
     from .deploy.controller import KubeApi, Reconciler
+    from .deploy.model_cache import ModelCacheReconciler
 
     kube = KubeApi(namespace=args.namespace, base=args.api_server)
+    caches = ModelCacheReconciler(kube)
     print(
         f"operator reconciling {args.namespace}/dynamotpudeployments "
-        f"every {args.poll_interval}s",
+        f"+ dynamotpumodelcaches every {args.poll_interval}s",
         flush=True,
     )
+
+    async def cache_loop():
+        while True:
+            try:
+                await caches.run_pass()
+            except Exception:
+                logging.getLogger(__name__).exception("model-cache pass failed")
+            await asyncio.sleep(args.poll_interval)
+
+    task = asyncio.ensure_future(cache_loop())
     try:
         await Reconciler(kube).run(poll_interval=args.poll_interval)
     finally:
+        task.cancel()
         await kube.close()
+
+
+def _run_prepare(args) -> None:
+    """Pre-stage a checkpoint into the model cache (the model-cache Job's
+    entrypoint; also useful interactively for offline deployments)."""
+    import shutil
+
+    if args.cache:
+        os.environ["DYN_MODEL_CACHE"] = args.cache
+    from .models.hub import ALIASES, cache_dir, resolve_model
+
+    path = resolve_model(args.model, revision=args.revision)
+    # A remote spec resolves into huggingface_hub's OWN cache (ephemeral in
+    # a fetch pod) — copy the serving artifacts into DYN_MODEL_CACHE so the
+    # PVC actually holds them (the entire point of the fetch Job).
+    spec_local = os.path.isdir(args.model) or args.model.endswith(".gguf")
+    cd = os.path.abspath(cache_dir())
+    if not spec_local and not os.path.abspath(path).startswith(cd + os.sep):
+        repo = ALIASES.get(args.model.lower(), args.model)
+        staged = os.path.join(cd, repo.replace("/", "--"))
+        os.makedirs(staged, exist_ok=True)
+        for f in sorted(os.listdir(path)):
+            src = os.path.join(path, f)  # may symlink into the blob store
+            dst = os.path.join(staged, f)
+            if os.path.isfile(src) and not os.path.exists(dst):
+                shutil.copyfile(src, dst)  # copyfile resolves symlinks
+        path = staged
+    print(path, flush=True)
 
 
 async def _run_api_store(args) -> None:
@@ -596,9 +639,20 @@ def main(argv: Optional[list] = None) -> None:
     p_mock.add_argument("--component", default="TpuWorker")
     p_mock.add_argument("--interval", type=float, default=0.5)
 
+    p_prep = sub.add_parser(
+        "prepare",
+        help="pre-stage a model checkpoint into the cache "
+             "(model-cache Job entrypoint)",
+    )
+    p_prep.add_argument("model")
+    p_prep.add_argument("--cache", default=None,
+                        help="destination dir (overrides DYN_MODEL_CACHE)")
+    p_prep.add_argument("--revision", default=None)
+
     p_op = sub.add_parser(
         "operator",
-        help="k8s controller: reconcile DynamoTpuDeployment CRs in-cluster",
+        help="k8s controller: reconcile DynamoTpuDeployment + "
+             "DynamoTpuModelCache CRs in-cluster",
     )
     p_op.add_argument("--namespace", default="default")
     p_op.add_argument("--poll-interval", type=float, default=10.0,
@@ -669,6 +723,8 @@ def main(argv: Optional[list] = None) -> None:
             asyncio.run(_run_http_frontend(args))
         elif args.cmd == "model":
             asyncio.run(_run_model_cmd(args))
+        elif args.cmd == "prepare":
+            _run_prepare(args)
         elif args.cmd == "metrics":
             asyncio.run(_run_metrics(args))
         elif args.cmd == "mock-worker":

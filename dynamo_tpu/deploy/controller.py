@@ -121,7 +121,8 @@ class FakeKube:
         return self.objects.pop((kind, name), None) is not None
 
     async def update_status(self, cr: Dict[str, Any], status: Dict[str, Any]) -> None:
-        key = ("DynamoTpuDeployment", cr["metadata"]["name"])
+        kind = cr.get("kind") or "DynamoTpuDeployment"
+        key = (kind, cr["metadata"]["name"])
         if key in self.objects:
             self.objects[key]["status"] = copy.deepcopy(status)
 
@@ -138,6 +139,10 @@ class KubeApi:
         "StatefulSet": "/apis/apps/v1/namespaces/{ns}/statefulsets",
         "Service": "/api/v1/namespaces/{ns}/services",
         "Ingress": "/apis/networking.k8s.io/v1/namespaces/{ns}/ingresses",
+        "Job": "/apis/batch/v1/namespaces/{ns}/jobs",
+        "DynamoTpuModelCache": (
+            f"/apis/{GROUP}/v1alpha1/namespaces/{{ns}}/dynamotpumodelcaches"
+        ),
         "DynamoTpuDeployment": (
             f"/apis/{GROUP}/v1alpha1/namespaces/{{ns}}/{CR_PLURAL}"
         ),
@@ -214,21 +219,27 @@ class KubeApi:
     async def delete(self, kind, name) -> bool:
         s = await self._http()
         async with s.delete(
-            self._path(kind, name), headers=self._headers()
+            self._path(kind, name),
+            # Background propagation: a bare API delete of a Job ORPHANS
+            # its pods (they keep running and writing); cascade everywhere
+            # — it is the kubectl default for the other kinds anyway.
+            params={"propagationPolicy": "Background"},
+            headers=self._headers(),
         ) as r:
             return r.status < 300
 
     async def update_status(self, cr, status):
         s = await self._http()
         name = cr["metadata"]["name"]
+        kind = cr.get("kind") or "DynamoTpuDeployment"
         body = {
             "apiVersion": f"{GROUP}/v1alpha1",
-            "kind": "DynamoTpuDeployment",
+            "kind": kind,
             "metadata": {"name": name},
             "status": status,
         }
         async with s.patch(
-            self._path("DynamoTpuDeployment", name) + "/status",
+            self._path(kind, name) + "/status",
             params={"fieldManager": "dynamo-tpu-operator", "force": "true"},
             data=json.dumps(body),
             headers=self._headers("application/apply-patch+yaml"),
@@ -242,7 +253,7 @@ class KubeApi:
             # patching status on the main resource (merge-patch).
             attempted = "subresource (HTTP %s) and merge-patch fallback" % sub_status
             async with s.patch(
-                self._path("DynamoTpuDeployment", name),
+                self._path(kind, name),
                 data=json.dumps({"status": status}),
                 headers=self._headers("application/merge-patch+json"),
             ) as r2:

@@ -223,8 +223,15 @@ def _ingress(name, namespace, port, ing: Dict[str, Any]):
     if not host:
         raise ValueError("frontend ingress needs a 'host'")
     meta = {**_meta(name, name), "namespace": namespace}
-    if ing.get("annotations"):
-        meta["annotations"] = dict(ing["annotations"])
+    user_ann = dict(ing.get("annotations") or {})
+    # Owned-keys marker: the drift check (_spec_equal) compares desired vs
+    # observed by SUBSET, so REMOVING an annotation from the CR would
+    # otherwise never re-apply (the smaller set still subsets the live
+    # object).  Encoding the owned key list in an annotation makes a
+    # removal change the marker value → drift → server-side apply, which
+    # then drops the removed key (this fieldManager owns it).
+    user_ann["dynamo.tpu.io/owned-annotations"] = ",".join(sorted(user_ann))
+    meta["annotations"] = user_ann
     spec: Dict[str, Any] = {
         "rules": [
             {

@@ -128,9 +128,14 @@ def ragged_attention(
         # mixed shapes run the kernel's tuned table (59-83% MFU measured)
         # under the raised vmem limit.
         if decode:
-            nkv = max(1, (4 << 20) // max(1, 2 * ps * KV2 * hd * 2))
+            import os
+
+            # Tunable for hardware sweeps (defaults are the measured-best):
+            # DYN_DECODE_NQ query block, DYN_DECODE_NKV_MB KV block budget.
+            budget = int(os.environ.get("DYN_DECODE_NKV_MB", "4")) << 20
+            nkv = max(1, budget // max(1, 2 * ps * KV2 * hd * 2))
             nkv = min(page_indices.shape[1], nkv)
-            nq = 16
+            nq = int(os.environ.get("DYN_DECODE_NQ", "16"))
         else:
             nkv = nq = None
         # Quantized (1-byte) pages: real scaling is folded around this call

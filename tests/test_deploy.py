@@ -476,7 +476,10 @@ def test_frontend_ingress_renders_and_reconciles():
     assert ing["spec"]["tls"] == [
         {"hosts": ["llm.example.com"], "secretName": "llm-tls"}
     ]
-    assert ing["metadata"]["annotations"] == {"a": "b"}
+    assert ing["metadata"]["annotations"] == {
+        "a": "b",
+        "dynamo.tpu.io/owned-annotations": "a",
+    }
 
     async def main():
         kube = FakeKube()
@@ -548,5 +551,43 @@ def test_ingress_annotation_edit_counts_as_drift():
             kube.objects[("Ingress", "app-frontend")]["metadata"]["annotations"]["k"]
             == "8m"
         )
+
+    asyncio.run(main())
+
+
+def test_ingress_annotation_removal_counts_as_drift():
+    """Removing an annotation from the CR must re-apply (subset comparison
+    alone would miss it — the owned-keys marker forces the drift)."""
+    import asyncio
+
+    from dynamo_tpu.deploy.controller import FakeKube, Reconciler
+
+    cr = {
+        "metadata": {"name": "app"},
+        "spec": {
+            "image": "img:1",
+            "services": {
+                "frontend": {
+                    "role": "frontend",
+                    "ingress": {
+                        "host": "h.example",
+                        "annotations": {"keep": "1", "drop": "2"},
+                    },
+                },
+            },
+        },
+    }
+
+    async def main():
+        kube = FakeKube()
+        rec = Reconciler(kube)
+        kube.objects[("DynamoTpuDeployment", "app")] = cr
+        await rec.reconcile(cr)
+        del cr["spec"]["services"]["frontend"]["ingress"]["annotations"]["drop"]
+        kube.applied.clear()
+        await rec.reconcile(cr)
+        assert ("Ingress", "app-frontend") in kube.applied
+        live = kube.objects[("Ingress", "app-frontend")]["metadata"]["annotations"]
+        assert "drop" not in live and live["keep"] == "1"
 
     asyncio.run(main())

@@ -1,0 +1,133 @@
+"""DynamoTpuModelCache controller (the reference operator's
+artifact-building half, dynamonimrequest_controller.go, translated to
+checkpoint pre-staging): Job rendering, reconcile lifecycle, status from
+Job state, spec-change replacement, orphan sweep scoping, and the
+`cli prepare` Job entrypoint."""
+
+import asyncio
+import json
+import subprocess
+import sys
+
+import pytest
+
+from dynamo_tpu.deploy.controller import (
+    MANAGER_LABEL,
+    OWNER_LABEL,
+    FakeKube,
+)
+from dynamo_tpu.deploy.model_cache import (
+    ModelCacheReconciler,
+    render_fetch_job,
+)
+
+
+def _cr(model="org/m", pvc="model-cache", **kw):
+    spec = {"model": model, "image": "dynamo-tpu:latest", "pvc": pvc, **kw}
+    return {
+        "apiVersion": "dynamo.tpu.io/v1alpha1",
+        "kind": "DynamoTpuModelCache",
+        "metadata": {"name": "r1"},
+        "spec": spec,
+    }
+
+
+def test_render_fetch_job_shape():
+    job = render_fetch_job(_cr(revision="v2", path="/cache"))
+    assert job["kind"] == "Job" and job["apiVersion"] == "batch/v1"
+    c = job["spec"]["template"]["spec"]["containers"][0]
+    assert c["command"][:5] == ["python", "-m", "dynamo_tpu.cli", "prepare", "org/m"]
+    assert "--cache" in c["command"] and "/cache" in c["command"]
+    assert "--revision" in c["command"] and "v2" in c["command"]
+    assert c["volumeMounts"][0]["mountPath"] == "/cache"
+    vol = job["spec"]["template"]["spec"]["volumes"][0]
+    assert vol["persistentVolumeClaim"]["claimName"] == "model-cache"
+    assert job["metadata"]["labels"][OWNER_LABEL] == "r1"
+    # Missing required fields fail loudly.
+    with pytest.raises(ValueError, match="spec.pvc"):
+        render_fetch_job(_cr(pvc=""))
+
+
+def test_reconcile_lifecycle_and_status():
+    async def main():
+        kube = FakeKube(auto_ready=False)
+        rec = ModelCacheReconciler(kube)
+        cr = _cr()
+        kube.objects[("DynamoTpuModelCache", "r1")] = cr
+
+        status = await rec.reconcile(cr)
+        assert status == {"phase": "Pending"}  # job just created
+        jobs = await kube.list("Job", label=(OWNER_LABEL, "r1"))
+        assert len(jobs) == 1
+        jname = jobs[0]["metadata"]["name"]
+        assert jobs[0]["metadata"]["labels"][MANAGER_LABEL] == "operator"
+
+        # Job running → Running; succeeded → Ready (status lands on the CR).
+        kube.objects[("Job", jname)]["status"] = {"active": 1}
+        assert (await rec.reconcile(cr))["phase"] == "Running"
+        kube.objects[("Job", jname)]["status"] = {"succeeded": 1}
+        assert (await rec.reconcile(cr))["phase"] == "Ready"
+        assert (
+            kube.objects[("DynamoTpuModelCache", "r1")]["status"]["phase"]
+            == "Ready"
+        )
+
+        # Spec edit (new model) replaces the Job: new name, old deleted.
+        cr["spec"]["model"] = "org/m2"
+        await rec.reconcile(cr)
+        jobs = await kube.list("Job", label=(OWNER_LABEL, "r1"))
+        assert len(jobs) == 1 and jobs[0]["metadata"]["name"] != jname
+
+        # CR deleted → run_pass sweeps the orphaned Job.
+        del kube.objects[("DynamoTpuModelCache", "r1")]
+        await rec.run_pass()
+        assert not await kube.list("Job", label=(OWNER_LABEL, "r1"))
+
+    asyncio.run(main())
+
+
+def test_sweep_scoped_to_manager():
+    async def main():
+        kube = FakeKube(auto_ready=False)
+        theirs = ModelCacheReconciler(kube, manager="api-store")
+        await theirs.reconcile(_cr())
+        # An operator-managed pass must not sweep the api-store's Job.
+        ours = ModelCacheReconciler(kube)  # operator
+        await ours.run_pass()
+        assert await kube.list("Job", label=(OWNER_LABEL, "r1"))
+
+    asyncio.run(main())
+
+
+def test_cli_prepare_stages_into_cache(tmp_path):
+    """`cli prepare` resolves a local checkpoint (exit 0, prints path) and
+    fails loudly for an unresolvable remote spec with --cache set."""
+    import os
+
+    from conftest import hermetic_child_env
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = hermetic_child_env(REPO)
+    ckpt = tmp_path / "m"
+    ckpt.mkdir()
+    (ckpt / "config.json").write_text("{}")
+    p = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.cli", "prepare", str(ckpt)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert p.returncode == 0, p.stderr
+    assert p.stdout.strip().endswith(str(ckpt))
+
+    # Pre-staged copy in --cache dir resolves offline.
+    cache = tmp_path / "cache"
+    staged = cache / "org--name"
+    staged.mkdir(parents=True)
+    (staged / "config.json").write_text("{}")
+    p = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.cli", "prepare", "org/name",
+         "--cache", str(cache)],
+        env=env | {"HF_HUB_OFFLINE": "1"},
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert p.returncode == 0, p.stderr
+    assert p.stdout.strip() == str(staged)
