@@ -58,6 +58,11 @@ class EngineConfig:
     # TPU mapping of the reference baseline's FP8-dynamic checkpoint
     # (examples/llm/benchmarks/README.md).  None = bf16 weights.
     weight_quant: Optional[str] = None
+    # Fuse q|k|v and gate|up projection weights at engine init (7 matmuls
+    # per dense layer -> 5; fused dots share one activation quantization).
+    # Applied on single-shard meshes only — a tp-sharded fused axis would
+    # split across segment boundaries (models/quant.py fuse_projections).
+    fuse_projections: bool = True
     seed: int = 0
     # derived buckets
     batch_buckets: List[int] = field(default_factory=list)

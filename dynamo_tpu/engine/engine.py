@@ -183,6 +183,14 @@ class TpuEngine(
             from ..models.quant import quantize_params
 
             params = quantize_params(params)  # no-op if already quantized
+        if (
+            cfg.fuse_projections
+            and not self.model_config.is_moe
+            and self.mesh is None  # single-shard only (see fuse_projections)
+        ):
+            from ..models.quant import fuse_projections
+
+            params = fuse_projections(params)
         cache = PagedKVCache.create(
             self.model_config,
             cfg.num_blocks,

@@ -50,6 +50,32 @@ def linear(x: jnp.ndarray, lp: Params, name: str, out_dtype=None) -> jnp.ndarray
     return qdot(x, w, s, out_dtype=out_dtype)
 
 
+def qkv_proj(x: jnp.ndarray, lp: Params, q_size: int, kv_size: int):
+    """q/k/v projections, using the fused wqkv leaf when present
+    (models/quant.py fuse_projections — single dot + static splits)."""
+    if "wqkv" in lp:
+        qkv = linear(x, lp, "wqkv")
+        if "bqkv" in lp:
+            qkv = qkv + lp["bqkv"]
+        return jnp.split(qkv, [q_size, q_size + kv_size], axis=-1)
+    q, k, v = linear(x, lp, "wq"), linear(x, lp, "wk"), linear(x, lp, "wv")
+    if "bq" in lp:  # Qwen2-style attention biases
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    return q, k, v
+
+
+def mlp(x: jnp.ndarray, lp: Params) -> jnp.ndarray:
+    """SwiGLU FFN, using the fused w_gateup leaf when present."""
+    if "w_gateup" in lp:
+        gu = linear(x, lp, "w_gateup", jnp.float32)
+        F = gu.shape[-1] // 2
+        gate = jax.nn.silu(gu[..., :F]).astype(x.dtype)
+        up = gu[..., F:].astype(x.dtype)
+        return linear(gate * up, lp, "w_down")
+    gate = jax.nn.silu(linear(x, lp, "w_gate", jnp.float32)).astype(x.dtype)
+    return linear(gate * linear(x, lp, "w_up"), lp, "w_down")
+
+
 def embed_lookup(params: Params, token_ids: jnp.ndarray, dtype) -> jnp.ndarray:
     """Token embedding gather; int8 embeds dequantize the gathered rows by
     their per-row scale (scale axis = vocab row, shared with the tied head)."""
@@ -288,9 +314,7 @@ def forward_ragged(
         h, pages = carry
         lp, l = xs
         x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
-        q, k, v = linear(x, lp, "wq"), linear(x, lp, "wk"), linear(x, lp, "wv")
-        if "bq" in lp:  # Qwen2-style attention biases
-            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q, k, v = qkv_proj(x, lp, H * hd, KV * hd)
         q = q.reshape(T, H, hd)
         k = k.reshape(T, KV, hd)
         v = v.reshape(T, KV, hd)
@@ -314,8 +338,7 @@ def forward_ragged(
         if config.is_moe:
             h = h + moe_mlp(x[None], lp, config)[0]
         else:
-            gate = jax.nn.silu(linear(x, lp, "w_gate", jnp.float32)).astype(x.dtype)
-            h = h + linear(gate * linear(x, lp, "w_up"), lp, "w_down")
+            h = h + mlp(x, lp)
         return (h, pages), None
 
     flat = cache.pages.reshape((L * P_layer,) + cache.pages.shape[2:])
@@ -397,9 +420,7 @@ def forward_sp_prefill(
     def layer(carry, lp):
         h = carry
         x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
-        q, k, v = linear(x, lp, "wq"), linear(x, lp, "wk"), linear(x, lp, "wv")
-        if "bq" in lp:  # Qwen2-style attention biases
-            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q, k, v = qkv_proj(x, lp, H * hd, KV * hd)
         q = apply_rope(q.reshape(Tg, H, hd), positions, inv_freq)
         k = apply_rope(k.reshape(Tg, KV, hd), positions, inv_freq)
         v = v.reshape(Tg, KV, hd)
@@ -409,8 +430,7 @@ def forward_sp_prefill(
         if config.is_moe:
             h = h + moe_mlp(x[None], lp, config)[0]
         else:
-            gate = jax.nn.silu(linear(x, lp, "w_gate", jnp.float32)).astype(x.dtype)
-            h = h + linear(gate * linear(x, lp, "w_up"), lp, "w_down")
+            h = h + mlp(x, lp)
         # pages layout rows: K at even combined-head indices, V at odd
         comb = jnp.stack([k, v], axis=2).reshape(Tg, 2 * KV, hd)
         return h, comb

@@ -51,6 +51,11 @@ _LAYER_QUANT_AXES = {
     "moe_gate": 2,  # [L, E, D, F] -> scale [L, E, F]
     "moe_up": 2,  # [L, E, D, F]
     "moe_down": 2,  # [L, E, F, D] -> scale [L, E, D]
+    # Fused leaves (fuse_projections): same [L, in, out] layout, scales on
+    # the concatenated output axis — quantize/dequantize must handle trees
+    # in EITHER layout (engine params are fused by default single-shard).
+    "wqkv": 1,  # [L, D, (H+2KV)*hd]
+    "w_gateup": 1,  # [L, D, 2F]
 }
 
 # Top-level leaves.  embed [V, D] scales per vocab row (axis 1) — the same
@@ -137,6 +142,46 @@ def dequantize_params(params: Dict[str, Any], dtype="float32") -> Dict[str, Any]
     out = deq(params, _TOP_QUANT_AXES)
     out["layers"] = deq(params["layers"], _LAYER_QUANT_AXES)
     return out
+
+
+def fuse_projections(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Concatenate q|k|v and gate|up along their output axes: 7 matmuls per
+    dense layer become 5, and the fused dots share one activation
+    quantization (decode launches fewer kernels per layer — measured on the
+    per-layer overhead the r5 cost breakdown attributes).
+
+    SINGLE-SHARD ONLY (engine applies it when mesh is None): a tp-sharded
+    fused output axis would split across q/k/v segment boundaries and force
+    resharding at the static split.  Works for quantized and bf16 trees;
+    MoE experts keep their layout.  The forward dispatches on the fused
+    leaf names (models/llama.py)."""
+    import jax.numpy as jnp
+
+    layers = dict(params["layers"])
+    if "wq" in layers and "wqkv" not in layers:
+        layers["wqkv"] = jnp.concatenate(
+            [layers.pop("wq"), layers.pop("wk"), layers.pop("wv")], axis=-1
+        )
+        if "wq_scale" in layers:
+            layers["wqkv_scale"] = jnp.concatenate(
+                [layers.pop("wq_scale"), layers.pop("wk_scale"),
+                 layers.pop("wv_scale")], axis=-1,
+            )
+        if "bq" in layers:
+            layers["bqkv"] = jnp.concatenate(
+                [layers.pop("bq"), layers.pop("bk"), layers.pop("bv")],
+                axis=-1,
+            )
+    if "w_gate" in layers and "w_gateup" not in layers:
+        layers["w_gateup"] = jnp.concatenate(
+            [layers.pop("w_gate"), layers.pop("w_up")], axis=-1
+        )
+        if "w_gate_scale" in layers:
+            layers["w_gateup_scale"] = jnp.concatenate(
+                [layers.pop("w_gate_scale"), layers.pop("w_up_scale")],
+                axis=-1,
+            )
+    return dict(params, layers=layers)
 
 
 def init_params_quantized(config, key) -> Dict[str, Any]:
