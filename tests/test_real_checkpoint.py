@@ -326,7 +326,11 @@ def test_qwen2_family_serves_golden_tokens(tmp_path):
             )
         )
         assert engine.model_config.qkv_bias  # detected from model_type
-        assert "bq" in engine.params["layers"]
+        # Single-shard engines fuse q|k|v (models/quant.py): biases live in
+        # bqkv; unfused layouts keep bq/bk/bv.
+        assert (
+            "bqkv" in engine.params["layers"] or "bq" in engine.params["layers"]
+        )
 
         tokenizer = HFTokenizer.from_pretrained_dir(path)
         pipeline = build_pipeline(
