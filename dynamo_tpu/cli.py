@@ -386,26 +386,20 @@ async def _run_operator(args) -> None:
     from .deploy.model_cache import ModelCacheReconciler
 
     kube = KubeApi(namespace=args.namespace, base=args.api_server)
-    caches = ModelCacheReconciler(kube)
     print(
         f"operator reconciling {args.namespace}/dynamotpudeployments "
-        f"+ dynamotpumodelcaches every {args.poll_interval}s",
+        f"+ dynamotpumodelcaches (watch-triggered, {args.poll_interval}s "
+        f"resync)",
         flush=True,
     )
-
-    async def cache_loop():
-        while True:
-            try:
-                await caches.run_pass()
-            except Exception:
-                logging.getLogger(__name__).exception("model-cache pass failed")
-            await asyncio.sleep(args.poll_interval)
-
-    task = asyncio.ensure_future(cache_loop())
     try:
-        await Reconciler(kube).run(poll_interval=args.poll_interval)
+        # Both controllers run watch-triggered with periodic resync; a
+        # failing watch degrades each to pure polling independently.
+        await asyncio.gather(
+            Reconciler(kube).run(poll_interval=args.poll_interval),
+            ModelCacheReconciler(kube).run(poll_interval=args.poll_interval),
+        )
     finally:
-        task.cancel()
         await kube.close()
 
 

@@ -228,6 +228,49 @@ class KubeApi:
         ) as r:
             return r.status < 300
 
+    async def watch(self, kind: str):
+        """Yield watch events for ``kind`` (k8s chunked-JSON watch stream).
+
+        One LIST first captures resourceVersion so the watch starts from a
+        consistent point; the stream then yields each event dict.  Server
+        timeouts / 410 Gone end the generator — the caller's pump restarts
+        it (Reconciler.run), and the periodic resync covers anything a
+        restart gap missed."""
+        s = await self._http()
+        # rv-capture list: limit=1 — only metadata.resourceVersion matters
+        # (k8s ends watches server-side every few minutes by design, so
+        # this runs on every restart; never download the full collection).
+        async with s.get(
+            self._path(kind), params={"limit": "1"}, headers=self._headers()
+        ) as r:
+            r.raise_for_status()
+            rv = ((await r.json()).get("metadata") or {}).get(
+                "resourceVersion", ""
+            )
+        params = {"watch": "1", "allowWatchBookmarks": "true"}
+        if rv:
+            params["resourceVersion"] = rv
+        async with s.get(
+            self._path(kind), params=params, headers=self._headers(),
+            timeout=None,
+        ) as r:
+            r.raise_for_status()
+            # Chunk-based line splitting: aiohttp's line iterator caps at
+            # 64 KiB and k8s objects (managedFields!) routinely exceed it
+            # — a too-long line would kill the watch with ValueError.
+            buf = b""
+            async for chunk in r.content.iter_any():
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    line = line.strip()
+                    if not line:
+                        continue
+                    event = json.loads(line)
+                    if event.get("type") == "BOOKMARK":
+                        continue
+                    yield event
+
     async def update_status(self, cr, status):
         s = await self._http()
         name = cr["metadata"]["name"]
@@ -371,27 +414,69 @@ class Reconciler:
             "services": services,
         }
 
-    async def run(self, poll_interval: float = 10.0) -> None:
-        """Level-triggered loop: every interval, list CRs and reconcile
-        each (the reference's watch is an optimization over the same
-        level-triggered semantics; polling keeps this client-minimal)."""
-        while True:
+    CR_KIND = "DynamoTpuDeployment"
+
+    async def run_pass(self) -> None:
+        """One level-triggered pass: list CRs, reconcile each, sweep."""
+        crs = await self.kube.list(self.CR_KIND)
+        for cr in crs:
             try:
-                crs = await self.kube.list("DynamoTpuDeployment")
-                for cr in crs:
-                    try:
-                        await self.reconcile(cr)
-                    except Exception:
-                        logger.exception(
-                            "reconcile failed for %s",
-                            cr["metadata"]["name"],
-                        )
-                await self.sweep_orphans(
-                    {c["metadata"]["name"] for c in crs}
-                )
+                await self.reconcile(cr)
             except Exception:
-                logger.exception("controller pass failed")
-            await asyncio.sleep(poll_interval)
+                logger.exception(
+                    "reconcile failed for %s", cr["metadata"]["name"]
+                )
+        await self.sweep_orphans({c["metadata"]["name"] for c in crs})
+
+    async def run(self, poll_interval: float = 10.0) -> None:
+        """Watch-triggered, level-driven loop (the controller-runtime
+        shape): a pass runs immediately after any CR event, with
+        ``poll_interval`` as the periodic resync (watches can silently go
+        stale; the resync also drives child-drift repair, which CR events
+        alone cannot see).  Clients without a watch (or when the watch
+        errors — RBAC, old API server) degrade to pure polling."""
+        watch = getattr(self.kube, "watch", None)
+        wake = asyncio.Event()
+        watcher: Optional[asyncio.Task] = None
+        if watch is not None:
+
+            async def pump() -> None:
+                while True:
+                    try:
+                        async for _event in watch(self.CR_KIND):
+                            wake.set()
+                        # Clean end-of-stream (server-side watch timeout, or
+                        # an intermediary that closes long responses): treat
+                        # it as a resync point, NEVER a tight restart loop.
+                        wake.set()
+                        await asyncio.sleep(1.0)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:  # noqa: BLE001 — degrade to poll
+                        logger.warning(
+                            "%s watch unavailable (%s); relying on the "
+                            "%.0fs resync", self.CR_KIND, e, poll_interval,
+                        )
+                        await asyncio.sleep(poll_interval)
+
+            watcher = asyncio.ensure_future(pump())
+        try:
+            while True:
+                # Clear BEFORE the pass: an event arriving mid-pass (which
+                # the pass's own LIST may have missed) must trigger the
+                # next pass, not wait out a full resync interval.
+                wake.clear()
+                try:
+                    await self.run_pass()
+                except Exception:
+                    logger.exception("controller pass failed")
+                try:
+                    await asyncio.wait_for(wake.wait(), poll_interval)
+                except asyncio.TimeoutError:
+                    pass  # periodic resync
+        finally:
+            if watcher is not None:
+                watcher.cancel()
 
     async def sweep_orphans(self, live_names) -> int:
         """Tear down children whose owner CR is gone — scoped to children

@@ -160,20 +160,8 @@ class ModelCacheReconciler(Reconciler):
             return {"phase": "Running"}
         return {"phase": "Pending"}
 
-    # teardown() and sweep_orphans() are INHERITED from Reconciler with
-    # CHILD_KINDS=("Job",) — one implementation of the manager-scoping
-    # rules.
-
-    async def run_pass(self) -> None:
-        """One level-triggered pass over every model-cache CR + orphan
-        sweep (called from the operator loop alongside deployments)."""
-        crs = await self.kube.list("DynamoTpuModelCache")
-        for cr in crs:
-            try:
-                await self.reconcile(cr)
-            except Exception:
-                logger.exception(
-                    "model-cache reconcile failed for %s",
-                    cr["metadata"]["name"],
-                )
-        await self.sweep_orphans({c["metadata"]["name"] for c in crs})
+    # teardown(), sweep_orphans(), run_pass() and the watch-driven run()
+    # are INHERITED from Reconciler with CHILD_KINDS=("Job",) and
+    # CR_KIND="DynamoTpuModelCache" — one implementation of the
+    # manager-scoping and watch/resync machinery.
+    CR_KIND = "DynamoTpuModelCache"

@@ -24,6 +24,8 @@ class MockApiServer:
         self.tokens_seen = []
         self.expected_token = "tok-1"
         self.status_subresource = status_subresource
+        self.watch_events = asyncio.Queue()  # dicts pushed by the test
+        self.watch_streams = 0
         self.app = web.Application()
         self.app.router.add_route("*", "/{tail:.*}", self._handle)
         self.runner = None
@@ -52,6 +54,19 @@ class MockApiServer:
         name = parts[ns_i + 3] if len(parts) > ns_i + 3 else None
         is_status = len(parts) > ns_i + 4 and parts[ns_i + 4] == "status"
 
+        if request.method == "GET" and name is None and request.query.get("watch"):
+            # k8s watch: newline-delimited JSON events, connection held open.
+            self.watch_streams += 1
+            resp = web.StreamResponse()
+            await resp.prepare(request)
+            try:
+                while True:
+                    ev = await self.watch_events.get()
+                    await resp.write((json.dumps(ev) + "\n").encode())
+            except (asyncio.CancelledError, ConnectionResetError):
+                raise
+            return resp
+
         if request.method == "GET" and name is None:
             sel = request.query.get("labelSelector")
             items = []
@@ -63,7 +78,9 @@ class MockApiServer:
                     if (m["metadata"].get("labels") or {}).get(k) != v:
                         continue
                 items.append(m)
-            return web.json_response({"items": items})
+            return web.json_response(
+                {"items": items, "metadata": {"resourceVersion": "7"}}
+            )
 
         if request.method == "PATCH" and is_status:
             if not self.status_subresource:
@@ -246,5 +263,48 @@ def test_reconciler_drives_real_http_surface(tmp_path, monkeypatch):
 
         await kube.close()
         await server.close()
+
+    asyncio.run(main())
+
+
+def test_watch_triggers_reconcile_before_resync(tmp_path, monkeypatch):
+    """Reconciler.run is watch-triggered: a CR event causes a pass well
+    before the resync interval; a server without working watch degrades
+    to polling (covered implicitly by FakeKube-based tests, which have no
+    watch at all)."""
+
+    async def main():
+        server = await MockApiServer().start()
+        monkeypatch.setattr(KubeApi, "SA", _sa_dir(tmp_path, "tok-1"))
+        kube = KubeApi(namespace="ns1", base=f"http://127.0.0.1:{server.port}")
+
+        # Long resync: only the watch can trigger passes in test time.
+        task = asyncio.create_task(Reconciler(kube).run(poll_interval=60.0))
+        try:
+            # First (startup) pass happens immediately: nothing to do.
+            for _ in range(100):
+                if server.watch_streams:
+                    break
+                await asyncio.sleep(0.05)
+            assert server.watch_streams >= 1
+
+            # Create the CR server-side and push the watch event.
+            cr = _cr()
+            server.objects[("dynamotpudeployments", "app")] = cr
+            await server.watch_events.put({"type": "ADDED", "object": cr})
+            for _ in range(100):
+                if any(pl == "deployments" for pl, _ in server.objects):
+                    break
+                await asyncio.sleep(0.05)
+            names = {n for (pl, n) in server.objects if pl == "deployments"}
+            assert "app-hub" in names  # reconciled LONG before the 60s resync
+        finally:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            await kube.close()
+            await server.close()
 
     asyncio.run(main())
