@@ -29,6 +29,7 @@ from .llm.engines import EchoEngineCore, EchoEngineFull
 from .llm.http_service import HttpService
 from .llm.preprocessor import OpenAIPreprocessor
 from .runtime.component import DistributedRuntime, parse_endpoint_path
+from .runtime.config import RuntimeConfig
 from .runtime.pipeline import build_pipeline
 from .runtime.transports.hub import HubServer
 
@@ -89,7 +90,34 @@ async def _run_http_frontend(args) -> None:
     from .runtime.client import RouterMode
 
     runtime = await DistributedRuntime.connect(args.hub)
-    service = HttpService(host=args.host, port=args.port)
+    # CLI flags win over the layered config's `resilience` section
+    # (DYN_RESILIENCE__HTTP_MAX_INFLIGHT=64 etc.), which wins over defaults.
+    res = RuntimeConfig.from_layers().resilience
+    raw_inflight = res.get("http_max_inflight")
+    service = HttpService(
+        host=args.host,
+        port=args.port,
+        max_inflight=(
+            args.max_inflight
+            if args.max_inflight is not None
+            else int(raw_inflight) if raw_inflight else None
+        ),
+        admission_queue=(
+            args.admission_queue
+            if args.admission_queue
+            else int(res.get("http_admission_queue", 0))
+        ),
+        admission_timeout_s=(
+            args.admission_timeout_s
+            if args.admission_timeout_s != 1.0
+            else float(res.get("http_admission_timeout_s", 1.0))
+        ),
+        default_deadline_s=(
+            args.deadline_s
+            if args.deadline_s is not None
+            else res.get("request_deadline_s")
+        ),
+    )
     mode = RouterMode(getattr(args, "router", "round_robin"))
     watcher = await ModelWatcher(runtime, service.models, router_mode=mode).start()
     await service.start()
@@ -503,6 +531,25 @@ def main(argv: Optional[list] = None) -> None:
         default="round_robin",
         choices=["random", "round_robin", "kv"],
         help="worker selection policy (kv = cache-aware)",
+    )
+    # Admission control / deadlines (runtime/resilience.py); defaults keep
+    # both disabled, matching the previous behaviour.
+    p_http.add_argument(
+        "--max-inflight", type=int, default=None, dest="max_inflight",
+        help="in-flight request cap (unset = unlimited)",
+    )
+    p_http.add_argument(
+        "--admission-queue", type=int, default=0, dest="admission_queue",
+        help="bounded wait queue beyond the cap; overflow sheds 429",
+    )
+    p_http.add_argument(
+        "--admission-timeout-s", type=float, default=1.0,
+        dest="admission_timeout_s",
+        help="max queue wait before shedding 503",
+    )
+    p_http.add_argument(
+        "--deadline-s", type=float, default=None, dest="deadline_s",
+        help="default per-request deadline (504 on exhaustion)",
     )
 
     p_run = sub.add_parser("run", help="in=… out=… launcher")

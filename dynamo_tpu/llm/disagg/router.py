@@ -52,6 +52,7 @@ class DisaggregatedRouter:
         self.config = config or DisaggConfig()
         self._watch_task: Optional[asyncio.Task] = None
         self._watcher = None
+        self._hub = None
 
     def prefill_remote(
         self, prefill_length: int, prefix_hit_length: int, queue_size: int
@@ -68,6 +69,7 @@ class DisaggregatedRouter:
 
     async def watch_config(self, hub) -> "DisaggregatedRouter":
         """Start live-updating thresholds from the hub KV."""
+        self._hub = hub
         current = await hub.kv_get(self.config_key)
         if current:
             self.config = DisaggConfig.from_dict(current)
@@ -76,15 +78,42 @@ class DisaggregatedRouter:
         return self
 
     async def _watch(self) -> None:
-        try:
-            async for event in self._watcher:
-                if event.type == "put" and event.value:
-                    self.config = DisaggConfig.from_dict(event.value)
-                    logger.info(
-                        "disagg config updated for %s: %s", self.model, self.config
-                    )
-        except asyncio.CancelledError:
-            pass
+        """Apply config deltas; a crashed watch re-establishes with backoff
+        (same shape as runtime/client.py — a raised watcher must not freeze
+        the thresholds stale forever).  The router keeps serving its current
+        config throughout; only liveness of UPDATES degrades."""
+        backoff = 0.1
+        while True:
+            try:
+                async for event in self._watcher:
+                    backoff = 0.1
+                    if event.type == "put" and event.value:
+                        self.config = DisaggConfig.from_dict(event.value)
+                        logger.info(
+                            "disagg config updated for %s: %s", self.model, self.config
+                        )
+                return  # closed cleanly (stop())
+            except asyncio.CancelledError:
+                return
+            except Exception:  # noqa: BLE001 — hub hiccup
+                logger.exception("disagg config watch died; re-establishing")
+            try:
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+                old, self._watcher = self._watcher, None
+                if old is not None:
+                    try:
+                        await old.aclose()  # free the hub-side registration
+                    except Exception:  # noqa: BLE001 — dead watcher
+                        pass
+                self._watcher = await self._hub.watch_prefix(self.config_key)
+                current = await self._hub.kv_get(self.config_key)
+                if current:
+                    self.config = DisaggConfig.from_dict(current)
+            except asyncio.CancelledError:
+                return
+            except Exception:  # noqa: BLE001 — still down; retry
+                pass
 
     async def stop(self) -> None:
         if self._watch_task is not None:

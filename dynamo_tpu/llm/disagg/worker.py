@@ -60,6 +60,9 @@ class DisaggDecodeWorker(AsyncEngine):
         self._covered: Dict[str, int] = {}  # per-transfer chunk accumulation
         self.remote_prefills = 0
         self.local_prefills = 0
+        # Degraded-mode fallbacks: remote prefill abandoned (timeout, queue
+        # unreachable, deadline pressure) and served by local prefill instead.
+        self.degraded_fallbacks = 0
         from collections import deque as _deque
 
         # rolling remote-prefill wait wall (TTFT input), bounded
@@ -74,6 +77,7 @@ class DisaggDecodeWorker(AsyncEngine):
         return {
             "remote_prefills": self.remote_prefills,
             "local_prefills": self.local_prefills,
+            "degraded_fallbacks": self.degraded_fallbacks,
             "pending_transfers": len(self._pending),
             "transfer_ms_p50": (
                 sorted(ms)[len(ms) // 2] if ms else None
@@ -126,28 +130,56 @@ class DisaggDecodeWorker(AsyncEngine):
             len(tokens) - prefix_hit > self.router.config.max_local_prefill_length
         )
         if remote:
-            qsize = await self.queue.size()
-            remote = self.router.prefill_remote(len(tokens), prefix_hit, qsize)
+            try:
+                qsize = await self.queue.size()
+            except Exception:  # noqa: BLE001 — hub/queue unreachable
+                # Degraded mode: can't even ask the queue — serve locally
+                # rather than failing the request.
+                logger.warning("prefill queue unreachable; degrading to local")
+                self._degrade()
+                remote = False
+            else:
+                remote = self.router.prefill_remote(len(tokens), prefix_hit, qsize)
         if remote:
-            await self._remote_prefill(tokens)
+            await self._remote_prefill(
+                tokens, deadline=getattr(request.ctx, "deadline", None)
+            )
         else:
             self.local_prefills += 1
         return await self.engine.generate(request)
 
-    async def _remote_prefill(self, tokens) -> None:
+    def _degrade(self) -> None:
+        self.local_prefills += 1
+        self.degraded_fallbacks += 1
+        from ...runtime.resilience import metrics as _metrics
+
+        _metrics.degraded_prefills_total += 1
+
+    async def _remote_prefill(self, tokens, deadline=None) -> None:
         transfer_id = uuid.uuid4().hex
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[transfer_id] = fut
-        await self.queue.enqueue(
-            {
-                "transfer_id": transfer_id,
-                "token_ids": list(tokens),
-                "reply": {"address": self.import_address, "path": self.import_path},
-            }
-        )
+        try:
+            await self.queue.enqueue(
+                {
+                    "transfer_id": transfer_id,
+                    "token_ids": list(tokens),
+                    "reply": {"address": self.import_address, "path": self.import_path},
+                }
+            )
+        except Exception:  # noqa: BLE001 — hub/queue unreachable
+            self._pending.pop(transfer_id, None)
+            logger.warning("prefill enqueue failed; degrading to local prefill")
+            self._degrade()
+            return
+        # The transfer wait never outlives the request's deadline: leave a
+        # margin so local prefill still has budget to run after fallback.
+        timeout = self.transfer_timeout
+        if deadline is not None:
+            timeout = min(timeout, max(deadline.remaining() * 0.5, 0.05))
         t0 = time.perf_counter()
         try:
-            covered = await asyncio.wait_for(fut, self.transfer_timeout)
+            covered = await asyncio.wait_for(fut, timeout)
             self.remote_prefills += 1
             self.transfer_ms.append((time.perf_counter() - t0) * 1e3)
             logger.info("remote prefill covered %d tokens", covered)
@@ -156,8 +188,8 @@ class DisaggDecodeWorker(AsyncEngine):
             # harmless prefix-cache fill.
             self._pending.pop(transfer_id, None)
             self._covered.pop(transfer_id, None)  # orphaned chunk counts
-            self.local_prefills += 1
             logger.warning("remote prefill timed out; prefilling locally")
+            self._degrade()
 
 
 class PrefillWorkerLoop:

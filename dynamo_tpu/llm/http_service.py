@@ -22,7 +22,15 @@ from typing import Any, Dict, Optional
 
 from aiohttp import web
 
+from ..runtime.client import NoInstancesError
 from ..runtime.engine import AsyncEngine, Context
+from ..runtime.resilience import (
+    AdmissionController,
+    AdmissionRejected,
+    Deadline,
+    DeadlineExceededError,
+)
+from ..runtime.resilience import metrics as resilience_metrics
 from .metrics import Metrics, Status
 from .openai import SSE_DONE, aggregate_chunks, sse_encode
 
@@ -75,11 +83,28 @@ class HttpService:
         port: int = 8000,
         metrics_prefix: str = "dynamo_tpu",
         model_manager: Optional[ModelManager] = None,
+        max_inflight: Optional[int] = None,
+        admission_queue: int = 0,
+        admission_timeout_s: float = 1.0,
+        default_deadline_s: Optional[float] = None,
     ):
         self.host = host
         self.port = port
         self.models = model_manager or ModelManager()
         self.metrics = Metrics(metrics_prefix)
+        self._metrics_prefix = metrics_prefix
+        # Admission control (disabled unless max_inflight is set): beyond
+        # the in-flight cap requests wait in a bounded FIFO; overflow sheds
+        # 429, wait-timeout sheds 503 — latency stays bounded instead of
+        # collapsing under burst.
+        self.admission = AdmissionController(
+            max_inflight=max_inflight,
+            max_queue=admission_queue,
+            queue_timeout_s=admission_timeout_s,
+        )
+        # Per-request wall-clock budget (None = unbounded, the previous
+        # behaviour); exhaustion maps to 504 below.
+        self.default_deadline_s = default_deadline_s
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self._chat_completions)
         self.app.router.add_post("/v1/completions", self._completions)
@@ -123,7 +148,10 @@ class HttpService:
         return web.json_response({"status": "ok", "models": self.models.model_names()})
 
     async def _metrics(self, request: web.Request) -> web.Response:
-        return web.Response(body=self.metrics.render(), content_type="text/plain")
+        body = self.metrics.render() + resilience_metrics.render(
+            self._metrics_prefix
+        ).encode()
+        return web.Response(body=body, content_type="text/plain")
 
     async def _list_models(self, request: web.Request) -> web.Response:
         now = int(time.time())
@@ -159,6 +187,30 @@ class HttpService:
             self.metrics.requests_total.labels(model, endpoint, "stream", Status.REJECTED).inc()
             return _error_response(404, f"model {model!r} not found")
 
+        # Admission control guards everything that costs engine work; cheap
+        # 400/404s above never consume a slot.
+        try:
+            await self.admission.acquire()
+        except AdmissionRejected as e:
+            self.metrics.requests_total.labels(
+                model, endpoint, "stream", Status.REJECTED
+            ).inc()
+            return _error_response(
+                e.status, e.message, retry_after_s=e.retry_after_s
+            )
+        try:
+            return await self._admitted_openai(request, body, engine, model, endpoint)
+        finally:
+            self.admission.release()
+
+    async def _admitted_openai(
+        self,
+        request: web.Request,
+        body: Dict[str, Any],
+        engine: AsyncEngine,
+        model: str,
+        endpoint: str,
+    ) -> web.StreamResponse:
         stream_mode = bool(body.get("stream", False))
         guard = self.metrics.guard(model, endpoint, "stream" if stream_mode else "unary")
         # Request-id correlation (reference: context id propagated in
@@ -175,6 +227,11 @@ class HttpService:
             ctx = Context.with_id(body, f"{rid}-{_uuid.uuid4().hex[:8]}")
         else:
             ctx = Context(body)
+        # Per-request deadline: caller's x-deadline-s header (or body
+        # "deadline_s") wins, else the service default; None = unbounded.
+        deadline_s = _requested_deadline(request, body, self.default_deadline_s)
+        if deadline_s is not None:
+            ctx.ctx.deadline = Deadline.after(deadline_s)
         try:
             stream = await engine.generate(ctx)
         except ValueError as e:
@@ -185,6 +242,16 @@ class HttpService:
             guard.finish(Status.REJECTED)
             logger.warning("request rejected: %s", e, exc_info=True)
             return _error_response(400, str(e), rid=ctx.id)
+        except (DeadlineExceededError, asyncio.TimeoutError) as e:
+            guard.finish(Status.ERROR)
+            logger.warning("request %s deadline exceeded at dispatch", ctx.id)
+            return _error_response(504, str(e) or "deadline exceeded", rid=ctx.id)
+        except NoInstancesError as e:
+            # No live worker right now — transient capacity problem, not an
+            # internal fault: 503 so clients retry, never 500.
+            guard.finish(Status.REJECTED)
+            logger.warning("no instances for %s: %s", model, e)
+            return _error_response(503, str(e), rid=ctx.id, retry_after_s=1.0)
         except Exception as e:  # noqa: BLE001 — edge boundary
             guard.finish(Status.ERROR)
             logger.exception("engine rejected request")
@@ -195,9 +262,21 @@ class HttpService:
         return await self._unary_response(stream, ctx, guard)
 
     async def _unary_response(self, stream, ctx: Context, guard) -> web.Response:
+        # The edge is the enforcement point of last resort for deadlines:
+        # engines behind a routed Client already honour them, but a local
+        # pipeline streams unbounded — bound every chunk wait here.
+        deadline = getattr(ctx.ctx, "deadline", None)
         chunks = []
         try:
-            async for chunk in stream:
+            it = stream.__aiter__()
+            while True:
+                try:
+                    if deadline is not None:
+                        chunk = await deadline.bound(it.__anext__(), "response")
+                    else:
+                        chunk = await it.__anext__()
+                except StopAsyncIteration:
+                    break
                 if "__annotations__" in chunk:
                     continue
                 if chunk.get("choices") or chunk.get("usage"):
@@ -208,6 +287,13 @@ class HttpService:
             ctx.stop_generating()
             guard.finish(Status.CLIENT_DROP)
             raise
+        except DeadlineExceededError as e:
+            guard.finish(Status.ERROR)
+            logger.warning("request %s deadline exceeded mid-generation", ctx.id)
+            return _error_response(504, str(e) or "deadline exceeded", rid=ctx.id)
+        except NoInstancesError as e:
+            guard.finish(Status.REJECTED)
+            return _error_response(503, str(e), rid=ctx.id, retry_after_s=1.0)
         except Exception as e:  # noqa: BLE001
             guard.finish(Status.ERROR)
             logger.exception("stream failed")
@@ -228,9 +314,18 @@ class HttpService:
             },
         )
         await resp.prepare(request)
+        deadline = getattr(ctx.ctx, "deadline", None)
         status = Status.SUCCESS
         try:
-            async for chunk in stream:
+            it = stream.__aiter__()
+            while True:
+                try:
+                    if deadline is not None:
+                        chunk = await deadline.bound(it.__anext__(), "stream")
+                    else:
+                        chunk = await it.__anext__()
+                except StopAsyncIteration:
+                    break
                 if "__annotations__" in chunk:
                     await resp.write(
                         b"event: annotation\n" + sse_encode(chunk["__annotations__"])
@@ -243,6 +338,18 @@ class HttpService:
             # client went away: stop upstream generation
             ctx.stop_generating()
             status = Status.CLIENT_DROP
+        except DeadlineExceededError:
+            # headers are already on the wire (200); all we can do is stop
+            # generation and end the SSE stream with a typed error event
+            ctx.stop_generating()
+            status = Status.ERROR
+            try:
+                await resp.write(
+                    b"event: error\n"
+                    + sse_encode({"error": "deadline exceeded", "code": 504})
+                )
+            except (ConnectionResetError, RuntimeError):
+                pass
         except Exception:  # noqa: BLE001
             status = Status.ERROR
             logger.exception("stream failed")
@@ -262,11 +369,46 @@ class HttpService:
         return resp
 
 
+def _requested_deadline(
+    request: web.Request, body: Dict[str, Any], default_s: Optional[float]
+) -> Optional[float]:
+    raw = request.headers.get("x-deadline-s") or body.get("deadline_s")
+    if raw is not None:
+        try:
+            value = float(raw)
+            if value > 0:
+                return value
+        except (TypeError, ValueError):
+            pass
+    return default_s
+
+
+_ERROR_TYPES = {
+    429: "overloaded_error",
+    503: "overloaded_error",
+    504: "timeout_error",
+}
+
+
 def _error_response(
-    status: int, message: str, rid: Optional[str] = None
+    status: int,
+    message: str,
+    rid: Optional[str] = None,
+    retry_after_s: Optional[float] = None,
 ) -> web.Response:
+    headers = {}
+    if rid:
+        headers["x-request-id"] = rid
+    if retry_after_s is not None:
+        headers["Retry-After"] = str(max(1, int(retry_after_s)))
     return web.json_response(
-        {"error": {"message": message, "type": "invalid_request_error", "code": status}},
+        {
+            "error": {
+                "message": message,
+                "type": _ERROR_TYPES.get(status, "invalid_request_error"),
+                "code": status,
+            }
+        },
         status=status,
-        headers={"x-request-id": rid} if rid else None,
+        headers=headers or None,
     )

@@ -12,6 +12,16 @@ from .config import RuntimeConfig, env_overrides  # noqa: F401
 from .logging_config import JsonlFormatter, parse_filter, setup_logging  # noqa: F401
 from .pipeline import MapOperator, Operator, ServiceBackend, build_pipeline
 from .client import Client, NoInstancesError, RouterMode
+from .resilience import (
+    AdmissionController,
+    AdmissionRejected,
+    BreakerState,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceededError,
+    RetryPolicy,
+)
+from .faultinject import faults
 from .component import (
     Component,
     DistributedRuntime,
@@ -27,6 +37,14 @@ __all__ = [
     "Client",
     "NoInstancesError",
     "RouterMode",
+    "AdmissionController",
+    "AdmissionRejected",
+    "BreakerState",
+    "CircuitBreaker",
+    "Deadline",
+    "DeadlineExceededError",
+    "RetryPolicy",
+    "faults",
     "Component",
     "DistributedRuntime",
     "Endpoint",

@@ -5,6 +5,16 @@ the instance prefix, maintains the live instance set (shrinking on lease
 expiry), and routes each request Random/RoundRobin/Direct.  KV-aware routing
 plugs in above this layer (the KV router picks a worker_id, then calls
 ``direct``).
+
+Request resilience (SURVEY §5 failure detection, runtime/resilience.py):
+lease expiry bounds how long a dead worker stays routable, but between the
+crash and the TTL every pick would hit a corpse.  ``generate`` therefore
+retries connect-time and before-first-token failures on OTHER instances
+(bounded attempts, exponential backoff with full jitter), consults a
+per-worker-address circuit breaker when picking (open breakers are skipped;
+a half-open probe re-admits the worker after its reset window), and honours
+the request deadline at every hop.  Once a token has streamed the request is
+NOT idempotent — mid-stream failures surface to the caller untouched.
 """
 
 from __future__ import annotations
@@ -13,12 +23,19 @@ import asyncio
 import enum
 import logging
 import random
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 logger = logging.getLogger(__name__)
 
 from .engine import AsyncEngine, Context, ResponseStream
-from .transports.service import RemoteEngine
+from .resilience import (
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceededError,
+    RetryPolicy,
+    metrics,
+)
+from .transports.service import RemoteEngine, RemoteEngineError
 
 
 class RouterMode(enum.Enum):
@@ -32,17 +49,72 @@ class RouterMode(enum.Enum):
 
 
 class NoInstancesError(RuntimeError):
-    """No live instances registered for the endpoint."""
+    """No live instances registered for the endpoint (HTTP edge → 503)."""
+
+    def __init__(self, message: str, prefix: str = ""):
+        super().__init__(message)
+        self.prefix = prefix
+
+
+def _resilience_config() -> Dict[str, Any]:
+    """The layered config's `resilience` section ({} if unloadable)."""
+    from .config import RuntimeConfig
+
+    try:
+        return RuntimeConfig.from_layers().resilience
+    except Exception:  # noqa: BLE001 — bad config file must not kill routing
+        logger.warning("could not load resilience config; using defaults",
+                       exc_info=True)
+        return {}
+
+
+def _is_retryable(exc: BaseException) -> bool:
+    """Transport/worker failures may be replayed elsewhere; app errors not."""
+    if isinstance(exc, RemoteEngineError):
+        return exc.retryable
+    return isinstance(exc, (ConnectionError, OSError, EOFError))
 
 
 class Client(AsyncEngine):
     """AsyncEngine over the live instances of one endpoint."""
 
-    def __init__(self, hub, instance_prefix: str, router_mode: RouterMode = RouterMode.ROUND_ROBIN):
+    WATCH_BACKOFF_INITIAL = 0.1
+    WATCH_BACKOFF_MAX = 5.0
+
+    def __init__(
+        self,
+        hub,
+        instance_prefix: str,
+        router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+        retry_policy: Optional[RetryPolicy] = None,
+        breaker_failure_threshold: Optional[int] = None,
+        breaker_reset_s: Optional[float] = None,
+    ):
         self.hub = hub
         self.instance_prefix = instance_prefix
         self.router_mode = router_mode
+        # Unset knobs fall back to the layered config's `resilience` section
+        # (DYN_RESILIENCE__RETRY_MAX_ATTEMPTS=5 etc.), then to defaults.
+        cfg: Dict[str, Any] = {}
+        if None in (retry_policy, breaker_failure_threshold, breaker_reset_s):
+            cfg = _resilience_config()
+        self.retry_policy = retry_policy or RetryPolicy.from_config(cfg)
+        self.breaker_failure_threshold = (
+            breaker_failure_threshold
+            if breaker_failure_threshold is not None
+            else int(cfg.get("breaker_failure_threshold", 3))
+        )
+        self.breaker_reset_s = (
+            breaker_reset_s
+            if breaker_reset_s is not None
+            else float(cfg.get("breaker_reset_s", 5.0))
+        )
         self._instances: Dict[int, Dict[str, Any]] = {}
+        # One cached RemoteEngine per live instance: constructing per call
+        # re-dialed TCP each time; the cache is evicted on connection failure
+        # and on instance removal (it is also what the breaker keys off).
+        self._engines: Dict[int, RemoteEngine] = {}
+        self._breakers: Dict[str, CircuitBreaker] = {}  # by worker address
         self._rr_index = 0
         self._watcher = None
         self._watch_task: Optional[asyncio.Task] = None
@@ -66,28 +138,107 @@ class Client(AsyncEngine):
         await self._watcher.synced.wait()
         return self
 
-    async def _watch_loop(self) -> None:
+    def _apply_event(self, event) -> None:
         try:
-            async for event in self._watcher:
+            worker_id = int(event.key.rsplit("/", 1)[-1])
+        except ValueError:
+            # unrelated key under the prefix; the watch must survive
+            logger.warning("ignoring non-instance key %r", event.key)
+            return
+        try:
+            if event.type == "put":
+                self._instances[worker_id] = event.value
+            else:
+                self._instances.pop(worker_id, None)
+                self._engines.pop(worker_id, None)
+                self._prune_breakers()
+            if self._instances:
+                self._ready.set()
+            else:
+                self._ready.clear()
+        except Exception:  # noqa: BLE001 — keep the watch alive
+            logger.exception("error handling instance event %r", event)
+
+    def _prune_breakers(self) -> None:
+        """Drop breakers for addresses no live instance uses (workers churn
+        through ephemeral ports; stale gauges must not accumulate)."""
+        live = {info["address"] for info in self._instances.values()}
+        for address in list(self._breakers):
+            if address not in live:
+                del self._breakers[address]
+                metrics.unregister_breaker(address)
+
+    async def _watch_loop(self) -> None:
+        """Consume instance deltas; survive watcher death (not just close).
+
+        A watcher that RAISES (hub hiccup, protocol slip) used to silently
+        end this task, freezing the instance set stale forever.  Now the
+        watch is re-established with exponential backoff and the instance
+        set is fully re-synced from the hub KV — deletes missed during the
+        outage must not leave phantom instances (mirrors the watch-restart
+        shape in deploy/controller.py).
+        """
+        backoff = self.WATCH_BACKOFF_INITIAL
+        while True:
+            try:
+                async for event in self._watcher:
+                    backoff = self.WATCH_BACKOFF_INITIAL
+                    self._apply_event(event)
+                return  # watcher closed cleanly (client shutdown)
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                logger.exception(
+                    "instance watch for %r died; re-establishing",
+                    self.instance_prefix,
+                )
+            while True:
                 try:
-                    worker_id = int(event.key.rsplit("/", 1)[-1])
-                except ValueError:
-                    # unrelated key under the prefix; the watch must survive
-                    logger.warning("ignoring non-instance key %r", event.key)
-                    continue
-                try:
-                    if event.type == "put":
-                        self._instances[worker_id] = event.value
-                    else:
-                        self._instances.pop(worker_id, None)
-                    if self._instances:
-                        self._ready.set()
-                    else:
-                        self._ready.clear()
-                except Exception:  # noqa: BLE001 — keep the watch alive
-                    logger.exception("error handling instance event %r", event)
-        except asyncio.CancelledError:
-            pass
+                    await asyncio.sleep(backoff)
+                    backoff = min(backoff * 2, self.WATCH_BACKOFF_MAX)
+                    old, self._watcher = self._watcher, None
+                    if old is not None:
+                        try:
+                            await old.aclose()
+                        except Exception:  # noqa: BLE001 — dead watcher
+                            pass
+                    self._watcher = await self.hub.watch_prefix(
+                        self.instance_prefix
+                    )
+                    await self._resync()
+                    metrics.watch_restarts_total += 1
+                    logger.info(
+                        "instance watch for %r re-established (%d instances)",
+                        self.instance_prefix,
+                        len(self._instances),
+                    )
+                    break
+                except asyncio.CancelledError:
+                    return
+                except Exception:  # noqa: BLE001 — hub still down
+                    logger.warning(
+                        "watch re-establish for %r failed; retrying in %.1fs",
+                        self.instance_prefix,
+                        backoff,
+                    )
+
+    async def _resync(self) -> None:
+        """Replace the instance set with the hub's current view."""
+        snapshot = await self.hub.kv_get_prefix(self.instance_prefix)
+        fresh: Dict[int, Dict[str, Any]] = {}
+        for key, value in snapshot.items():
+            try:
+                fresh[int(key.rsplit("/", 1)[-1])] = value
+            except ValueError:
+                continue
+        for wid in set(self._engines) - set(fresh):
+            self._engines.pop(wid, None)
+        self._instances = fresh
+        self._prune_breakers()
+        if fresh:
+            self._ready.set()
+        else:
+            self._ready.clear()
 
     async def close(self) -> None:
         if self._watch_task is not None:
@@ -106,27 +257,147 @@ class Client(AsyncEngine):
         return self._instances.get(worker_id)
 
     async def wait_for_instances(self, timeout: float = 10.0) -> None:
-        await asyncio.wait_for(self._ready.wait(), timeout)
+        try:
+            await asyncio.wait_for(self._ready.wait(), timeout)
+        except asyncio.TimeoutError:
+            raise NoInstancesError(
+                f"no instances under {self.instance_prefix!r} "
+                f"after {timeout:g}s",
+                prefix=self.instance_prefix,
+            ) from None
 
     # -- routing ------------------------------------------------------------
 
-    def _pick(self, worker_id: Optional[int], mode: RouterMode) -> Dict[str, Any]:
+    def _breaker(self, address: str) -> CircuitBreaker:
+        breaker = self._breakers.get(address)
+        if breaker is None:
+            breaker = CircuitBreaker(
+                key=address,
+                failure_threshold=self.breaker_failure_threshold,
+                reset_timeout_s=self.breaker_reset_s,
+            )
+            self._breakers[address] = metrics.register_breaker(breaker)
+        return breaker
+
+    def _pick(
+        self,
+        worker_id: Optional[int],
+        mode: RouterMode,
+        exclude: Set[int] = frozenset(),
+    ) -> Tuple[int, Dict[str, Any]]:
         if not self._instances:
-            raise NoInstancesError(f"no instances under {self.instance_prefix!r}")
+            raise NoInstancesError(
+                f"no instances under {self.instance_prefix!r}",
+                prefix=self.instance_prefix,
+            )
         if worker_id is not None:
             info = self._instances.get(worker_id)
             if info is None:
-                raise NoInstancesError(f"instance {worker_id} not found")
-            return info
+                raise NoInstancesError(
+                    f"instance {worker_id} not found",
+                    prefix=self.instance_prefix,
+                )
+            return worker_id, info
         ids = sorted(self._instances.keys())
+        candidates = [i for i in ids if i not in exclude] or ids
+        # Skip instances whose breaker is open — unless that empties the
+        # pool, in which case trying a sick worker beats certain failure.
+        healthy = [
+            i
+            for i in candidates
+            if self._breaker(self._instances[i]["address"]).can_attempt()
+        ]
+        if healthy:
+            candidates = healthy
         if mode == RouterMode.RANDOM:
-            return self._instances[random.choice(ids)]
-        # ROUND_ROBIN (and KV fallback when no overlap decision was made)
-        self._rr_index = (self._rr_index + 1) % len(ids)
-        return self._instances[ids[self._rr_index]]
+            wid = random.choice(candidates)
+        else:
+            # ROUND_ROBIN (and KV fallback when no overlap decision was made)
+            self._rr_index += 1
+            wid = candidates[self._rr_index % len(candidates)]
+        return wid, self._instances[wid]
 
-    def _engine_for(self, info: Dict[str, Any]) -> RemoteEngine:
-        return RemoteEngine(info["address"], info["path"])
+    def _engine_for(self, worker_id: int, info: Dict[str, Any]) -> RemoteEngine:
+        engine = self._engines.get(worker_id)
+        if engine is None or engine.address != info["address"]:
+            engine = RemoteEngine(info["address"], info["path"])
+            self._engines[worker_id] = engine
+        return engine
+
+    def _evict(self, worker_id: int) -> None:
+        self._engines.pop(worker_id, None)
+
+    async def _acquire(
+        self,
+        request: Context,
+        worker_id: Optional[int],
+        mode: RouterMode,
+        state: Dict[str, Any],
+        deadline: Optional[Deadline],
+    ) -> Tuple[int, str, ResponseStream]:
+        """Open a response stream, retrying connect/prologue failures on
+        other instances.  ``state`` ({"attempt", "tried"}) is shared with the
+        first-token failover wrapper so the TOTAL attempt budget is bounded
+        across both phases."""
+        policy = self.retry_policy
+        while True:
+            if deadline is not None and deadline.expired:
+                metrics.deadline_exceeded_total += 1
+                raise DeadlineExceededError("deadline exceeded (routing)")
+            wid, info = self._pick(worker_id, mode, exclude=state["tried"])
+            address = info["address"]
+            breaker = self._breaker(address)
+            breaker.on_attempt()
+            engine = self._engine_for(wid, info)
+            try:
+                if deadline is not None:
+                    stream = await deadline.bound(
+                        engine.generate(request), "connect"
+                    )
+                else:
+                    stream = await engine.generate(request)
+            except DeadlineExceededError:
+                # An exhausted budget is the request's problem, not proof the
+                # worker is sick — don't poison its breaker.
+                metrics.deadline_exceeded_total += 1
+                raise
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not _is_retryable(e):
+                    raise
+                breaker.record_failure()
+                self._evict(wid)
+                if worker_id is not None:
+                    # Direct routing (the KV router chose): no failover
+                    # target exists, so this is not a retry — don't let the
+                    # retry counters suggest otherwise.
+                    raise
+                state["tried"].add(wid)
+                state["attempt"] += 1
+                metrics.retries_total += 1
+                if state["attempt"] >= policy.max_attempts:
+                    metrics.retries_exhausted_total += 1
+                    raise
+                logger.warning(
+                    "request %s: worker %s failed (%s); failing over "
+                    "(attempt %d/%d)",
+                    request.id,
+                    wid,
+                    e,
+                    state["attempt"],
+                    policy.max_attempts,
+                )
+                delay = policy.backoff(state["attempt"])
+                if deadline is not None:
+                    delay = min(delay, max(deadline.remaining(), 0.0))
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                if state["tried"] >= set(self._instances):
+                    # full lap: every live instance failed once — allow
+                    # re-dials (the next lap rides the backoff ladder)
+                    state["tried"] = set()
+                continue
+            breaker.record_success()
+            return wid, address, stream
 
     async def generate(
         self,
@@ -136,8 +407,20 @@ class Client(AsyncEngine):
     ) -> ResponseStream:
         if self._static_engine is not None:
             return await self._static_engine.generate(request)
-        info = self._pick(worker_id, mode if mode is not None else self.router_mode)
-        return await self._engine_for(info).generate(request)
+        mode = mode if mode is not None else self.router_mode
+        deadline = getattr(request.ctx, "deadline", None)
+        state: Dict[str, Any] = {"attempt": 0, "tried": set()}
+        wid, address, stream = await self._acquire(
+            request, worker_id, mode, state, deadline
+        )
+        if worker_id is not None:
+            # Direct routing (KV router already chose): no failover target.
+            return stream
+        return ResponseStream(
+            _FirstTokenFailover(self, request, mode, state, deadline,
+                                wid, address, stream),
+            request.ctx,
+        )
 
     # Convenience verbs mirroring the reference bindings (_core.pyi):
     async def random(self, request: Context) -> ResponseStream:
@@ -148,3 +431,92 @@ class Client(AsyncEngine):
 
     async def direct(self, request: Context, worker_id: int) -> ResponseStream:
         return await self.generate(request, worker_id=worker_id)
+
+
+class _FirstTokenFailover:
+    """Stream wrapper: transparent failover until the first token lands.
+
+    A worker that accepted the stream prologue can still die before
+    producing a token; until then nothing user-visible has happened, so the
+    request is safely replayable on another instance.  From the first token
+    on, generation is NOT idempotent (tokens already reached the caller) —
+    failures propagate untouched.  The deadline bounds the wait for every
+    item.
+    """
+
+    def __init__(
+        self,
+        client: Client,
+        request: Context,
+        mode: RouterMode,
+        state: Dict[str, Any],
+        deadline: Optional[Deadline],
+        wid: int,
+        address: str,
+        stream: ResponseStream,
+    ):
+        self._client = client
+        self._request = request
+        self._mode = mode
+        self._state = state
+        self._deadline = deadline
+        self._wid = wid
+        self._address = address
+        self._stream = stream
+        self._got_first = False
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        while True:
+            try:
+                if self._deadline is not None:
+                    item = await self._deadline.bound(
+                        self._stream.__anext__(),
+                        "first token" if not self._got_first else "stream",
+                    )
+                else:
+                    item = await self._stream.__anext__()
+            except (StopAsyncIteration, asyncio.CancelledError):
+                raise
+            except DeadlineExceededError:
+                metrics.deadline_exceeded_total += 1
+                await self.aclose()
+                raise
+            except Exception as e:  # noqa: BLE001 — classified below
+                if self._got_first or not _is_retryable(e):
+                    raise
+                client = self._client
+                client._breaker(self._address).record_failure()
+                client._evict(self._wid)
+                self._state["tried"].add(self._wid)
+                self._state["attempt"] += 1
+                metrics.retries_total += 1
+                metrics.failovers_total += 1
+                if self._state["attempt"] >= client.retry_policy.max_attempts:
+                    metrics.retries_exhausted_total += 1
+                    raise
+                logger.warning(
+                    "request %s: worker %s died before first token (%s); "
+                    "failing over (attempt %d/%d)",
+                    self._request.id,
+                    self._wid,
+                    e,
+                    self._state["attempt"],
+                    client.retry_policy.max_attempts,
+                )
+                delay = client.retry_policy.backoff(self._state["attempt"])
+                if self._deadline is not None:
+                    delay = min(delay, max(self._deadline.remaining(), 0.0))
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                self._wid, self._address, self._stream = await client._acquire(
+                    self._request, None, self._mode, self._state, self._deadline
+                )
+                continue
+            self._got_first = True
+            return item
+
+    async def aclose(self) -> None:
+        await self._stream.aclose()

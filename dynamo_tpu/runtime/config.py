@@ -82,6 +82,12 @@ class RuntimeConfig:
     metrics_port: int = 9091
     # engine defaults (overridable per worker)
     engine: Dict[str, Any] = field(default_factory=dict)
+    # request-resilience knobs (runtime/resilience.py): retry_max_attempts,
+    # retry_base_delay_s, retry_max_delay_s, breaker_failure_threshold,
+    # breaker_reset_s, http_max_inflight, http_admission_queue,
+    # http_admission_timeout_s, request_deadline_s.  Nested env works:
+    # ``DYN_RESILIENCE__RETRY_MAX_ATTEMPTS=5``.
+    resilience: Dict[str, Any] = field(default_factory=dict)
     extra: Dict[str, Any] = field(default_factory=dict)  # unrecognized keys
 
     @classmethod

@@ -35,14 +35,18 @@ class AsyncEngineContext:
     Child contexts are linked so cancelling a parent cascades.
     """
 
-    __slots__ = ("_id", "_stopped", "_killed", "_children", "_stop_event")
+    __slots__ = ("_id", "_stopped", "_killed", "_children", "_stop_event", "deadline")
 
-    def __init__(self, id: Optional[str] = None):
+    def __init__(self, id: Optional[str] = None, deadline=None):
         self._id = id if id is not None else uuid.uuid4().hex
         self._stopped = False
         self._killed = False
         self._children: List["AsyncEngineContext"] = []
         self._stop_event: asyncio.Event = asyncio.Event()
+        # Optional resilience.Deadline: the request's remaining wall-clock
+        # budget, decremented across hops (serialized on the wire by the
+        # service plane, enforced by Client retries and the HTTP edge).
+        self.deadline = deadline
 
     @property
     def id(self) -> str:

@@ -1,0 +1,164 @@
+"""Deterministic fault injection for chaos tests and the fault-matrix sweep.
+
+Fault points (hooked where the failure would really occur, behind
+``faults.enabled`` so the disabled path costs one attribute read):
+
+=================  =========================================  ==============
+point              hooked in                                  simulates
+=================  =========================================  ==============
+``connect_error``  ``transports/service.MuxConnection``       dead worker /
+                                                              refused dial
+``delay``          ``transports/service.ServiceServer``       slow worker
+                   (before the response prologue)             (stalls TTFB)
+``error_prologue`` ``transports/service.ServiceServer``       worker sick at
+                                                              stream setup
+``drop_mid_stream`` ``transports/service.ServiceServer``      worker killed
+                   (connection aborted after an item)         after 1st token
+``watch_stall``    ``transports/hub.HubState._notify``        hub partition:
+                                                              watchers stale
+``watch_error``    ``transports/hub.Watcher``                 watch stream
+                                                              crash
+=================  =========================================  ==============
+
+Arming: programmatic (``faults.arm("connect_error", match=addr, count=2)``)
+or env-driven for subprocess workers — ``DYN_FAULTS`` is a comma-separated
+list of ``point[:match][#count]`` specs (``match`` substring-matches the
+hook's key, and may itself contain ``:`` as in ``host:port``; ``*`` matches
+everything; no ``#count`` = until disarmed), e.g.
+``DYN_FAULTS='connect_error:127.0.0.1:9001#2,delay:*'``.
+
+A ``count``-armed fault auto-expires after firing ``count`` times, so a test
+can kill exactly the first N dials and then watch recovery.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = "DYN_FAULTS"
+
+
+@dataclass
+class _Fault:
+    point: str
+    match: str = "*"
+    count: Optional[int] = None  # None = until disarmed
+    delay_s: float = 0.05  # only meaningful for the "delay" point
+    fired: int = field(default=0)
+
+    def matches(self, key: str) -> bool:
+        return self.match == "*" or self.match in key
+
+    @property
+    def exhausted(self) -> bool:
+        return self.count is not None and self.fired >= self.count
+
+
+class FaultInjector:
+    """Process-global registry of armed fault points.
+
+    ``enabled`` is the single hot-path guard: every hook site reads it first
+    (``if faults.enabled and faults.should(...)``) so production traffic with
+    nothing armed pays one attribute load.
+    """
+
+    def __init__(self):
+        self.enabled = False
+        self._points: Dict[str, List[_Fault]] = {}
+
+    # -- arming -------------------------------------------------------------
+
+    def arm(
+        self,
+        point: str,
+        match: str = "*",
+        count: Optional[int] = None,
+        delay_s: float = 0.05,
+    ) -> _Fault:
+        fault = _Fault(point=point, match=match, count=count, delay_s=delay_s)
+        self._points.setdefault(point, []).append(fault)
+        self.enabled = True
+        logger.warning("fault armed: %s match=%r count=%s", point, match, count)
+        return fault
+
+    def disarm(self, point: Optional[str] = None, match: Optional[str] = None) -> None:
+        if point is None:
+            self._points.clear()
+        elif match is None:
+            self._points.pop(point, None)
+        else:
+            kept = [f for f in self._points.get(point, []) if f.match != match]
+            if kept:
+                self._points[point] = kept
+            else:
+                self._points.pop(point, None)
+        self.enabled = any(self._points.values())
+
+    def reset(self) -> None:
+        self.disarm()
+
+    # -- hook-site queries ---------------------------------------------------
+
+    def _find(self, point: str, key: str) -> Optional[_Fault]:
+        for fault in self._points.get(point, []):
+            if not fault.exhausted and fault.matches(key):
+                return fault
+        return None
+
+    def is_armed(self, point: str, key: str = "") -> bool:
+        """Non-consuming check (for faults that hold, e.g. watch_stall)."""
+        return self._find(point, key) is not None
+
+    def should(self, point: str, key: str = "") -> bool:
+        """Consuming check: counts one firing against a count-limited fault."""
+        fault = self._find(point, key)
+        if fault is None:
+            return False
+        fault.fired += 1
+        if fault.exhausted:
+            self._prune(point)
+        logger.warning("fault fired: %s key=%r (%d)", point, key, fault.fired)
+        return True
+
+    def delay_for(self, point: str, key: str = "") -> float:
+        """Consuming delay lookup: seconds to stall, or 0.0 if not armed."""
+        fault = self._find(point, key)
+        if fault is None:
+            return 0.0
+        fault.fired += 1
+        if fault.exhausted:
+            self._prune(point)
+        return fault.delay_s
+
+    def _prune(self, point: str) -> None:
+        kept = [f for f in self._points.get(point, []) if not f.exhausted]
+        if kept:
+            self._points[point] = kept
+        else:
+            self._points.pop(point, None)
+        self.enabled = any(self._points.values())
+
+    # -- env ----------------------------------------------------------------
+
+    def load_env(self, raw: Optional[str] = None) -> None:
+        """Parse ``DYN_FAULTS`` (``point[:match][#count]`` comma-list)."""
+        raw = os.environ.get(ENV_VAR, "") if raw is None else raw
+        for spec in filter(None, (s.strip() for s in raw.split(","))):
+            count: Optional[int] = None
+            # '#' separates the count so a match may contain ':' (host:port)
+            if "#" in spec:
+                spec, _, count_s = spec.rpartition("#")
+                if count_s.isdigit():
+                    count = int(count_s)
+            point, _, match = spec.partition(":")
+            self.arm(point, match=match or "*", count=count)
+
+
+faults = FaultInjector()
+if os.environ.get(ENV_VAR):
+    faults.load_env()

@@ -38,6 +38,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Set, Tuple
 
+from ..faultinject import faults
+
 logger = logging.getLogger(__name__)
 
 
@@ -169,6 +171,10 @@ class HubState:
         return True
 
     def _notify(self, event: WatchEvent) -> None:
+        if faults.enabled and faults.is_armed("watch_stall"):
+            # Simulated hub partition: deltas silently stop reaching
+            # watchers (their view goes stale until the fault clears).
+            return
         for prefix, q in self._watches.values():
             if event.key.startswith(prefix):
                 q.put_nowait(event)
@@ -357,6 +363,8 @@ class Watcher(_QueueIter):
         self.synced = asyncio.Event()
 
     async def __anext__(self) -> WatchEvent:
+        if faults.enabled and faults.should("watch_error"):
+            raise RuntimeError("[fault] injected watch stream failure")
         while True:
             ev = await super().__anext__()
             if ev.type == "sync":

@@ -23,6 +23,8 @@ import logging
 from typing import Any, AsyncIterator, Dict, Optional, Tuple
 
 from ..engine import AsyncEngine, AsyncEngineContext, Context, ResponseStream
+from ..faultinject import faults
+from ..resilience import Deadline
 from .codec import Frame, FrameType, read_frame, write_frame
 
 logger = logging.getLogger(__name__)
@@ -31,7 +33,19 @@ _DONE = object()
 
 
 class RemoteEngineError(RuntimeError):
-    """Error raised by the remote engine (propagated through RESP_ERROR)."""
+    """Error raised by the remote engine (propagated through RESP_ERROR).
+
+    ``retryable`` distinguishes transport/worker failures (connection refused,
+    connection closed before the stream finished, injected worker faults) from
+    application errors the engine raised for THIS request (bad sampling
+    params, oversized prompt) — the Client's failover loop only ever retries
+    the former; replaying a deterministic request error across every worker
+    would just multiply the damage.
+    """
+
+    def __init__(self, message: str, retryable: bool = True):
+        super().__init__(message)
+        self.retryable = retryable
 
 
 class ServiceServer:
@@ -83,29 +97,66 @@ class ServiceServer:
                 await write_frame(writer, ftype, obj, stream=sid)
 
         async def serve_stream(sid: int, header: Dict[str, Any], data: Any):
+            endpoint_name = header.get("endpoint", "")
             ctx = AsyncEngineContext(header.get("id"))
+            # Deadline propagation: the caller sends its REMAINING budget;
+            # restart the clock here so queue/transit time already spent is
+            # charged to the request (the edge decremented before sending).
+            budget = header.get("deadline_s")
+            if budget is not None:
+                ctx.deadline = Deadline.after(float(budget))
             streams[sid] = (ctx, asyncio.current_task())
             try:
-                engine = self._endpoints.get(header.get("endpoint", ""))
+                if faults.enabled:
+                    delay = faults.delay_for("delay", endpoint_name)
+                    if delay > 0:
+                        await asyncio.sleep(delay)
+                    if faults.should("error_prologue", endpoint_name):
+                        await send(
+                            FrameType.RESP_PROLOGUE,
+                            {"ok": False, "error": "[fault] injected prologue error",
+                             "kind": "internal"},
+                            sid,
+                        )
+                        return
+                engine = self._endpoints.get(endpoint_name)
                 if engine is None:
                     await send(
                         FrameType.RESP_PROLOGUE,
                         {"ok": False,
-                         "error": f"no such endpoint: {header.get('endpoint')}"},
+                         "error": f"no such endpoint: {header.get('endpoint')}",
+                         "kind": "endpoint"},
                         sid,
                     )
                     return
                 try:
                     stream = await engine.generate(Context(data, ctx))
                 except Exception as e:  # noqa: BLE001 — remote boundary
+                    # Request-shape errors are the caller's fault — tag them
+                    # non-retryable so failover doesn't replay them.
+                    kind = (
+                        "request"
+                        if isinstance(e, (ValueError, TypeError, KeyError))
+                        else "internal"
+                    )
                     await send(
-                        FrameType.RESP_PROLOGUE, {"ok": False, "error": str(e)}, sid
+                        FrameType.RESP_PROLOGUE,
+                        {"ok": False, "error": str(e), "kind": kind},
+                        sid,
                     )
                     return
                 await send(FrameType.RESP_PROLOGUE, {"ok": True}, sid)
                 try:
                     async for item in stream:
                         await send(FrameType.RESP_ITEM, item, sid)
+                        if faults.enabled and faults.should(
+                            "drop_mid_stream", endpoint_name
+                        ):
+                            # Simulate the worker dying mid-stream: hard-abort
+                            # the transport (no RESP_ERROR courtesy).
+                            ctx.stop_generating()
+                            writer.transport.abort()
+                            return
                     await send(FrameType.RESP_COMPLETE, None, sid)
                 except (ConnectionResetError, BrokenPipeError):
                     ctx.stop_generating()
@@ -198,6 +249,11 @@ class MuxConnection:
             return conn
 
     async def _connect(self) -> None:
+        if faults.enabled and faults.should("connect_error", self.address):
+            self.closed = True
+            raise ConnectionRefusedError(
+                f"[fault] connect to {self.address} refused"
+            )
         host, port = self.address.rsplit(":", 1)
         self._reader, self._writer = await asyncio.open_connection(host, int(port))
         self._reader_task = asyncio.create_task(self._read_loop())
@@ -266,16 +322,24 @@ class RemoteEngine(AsyncEngine):
 
     async def generate(self, request: Context) -> ResponseStream:
         conn = await MuxConnection.get(self.address)
-        sid, queue = await conn.open_stream(
-            {"id": request.id, "endpoint": self.endpoint}, request.data
-        )
+        header = {"id": request.id, "endpoint": self.endpoint}
+        deadline = getattr(request.ctx, "deadline", None)
+        if deadline is not None:
+            # Ship the REMAINING budget; the server restarts its own clock.
+            header["deadline_s"] = max(deadline.remaining(), 0.0)
+        sid, queue = await conn.open_stream(header, request.data)
         try:
             first = await queue.get()
             if first is _DONE:
                 raise RemoteEngineError("remote connection closed")
             prologue = first.unpack()
             if not prologue.get("ok"):
-                raise RemoteEngineError(prologue.get("error", "remote engine error"))
+                raise RemoteEngineError(
+                    prologue.get("error", "remote engine error"),
+                    # Application errors (bad request shape) must not be
+                    # replayed on other workers; transport/worker sickness may.
+                    retryable=prologue.get("kind") != "request",
+                )
         except BaseException:
             conn.release(sid)
             raise
@@ -337,7 +401,8 @@ class _RemoteStreamIter:
                 if frame.type == FrameType.RESP_ERROR:
                     err = frame.unpack().get("error", "remote error")
                     await self.aclose(notify=False)
-                    raise RemoteEngineError(err)
+                    # The engine raised for this request — not worker health.
+                    raise RemoteEngineError(err, retryable=False)
                 # ignore heartbeats/unknown frame types
         except BaseException:
             await self.aclose()

@@ -1,0 +1,820 @@
+"""Chaos suite for the request-resilience layer (ISSUE 1).
+
+Every distributed test here uses the REAL stack — HubServer over TCP,
+ServiceServer workers, the routed Client — with faults injected through
+``runtime/faultinject.py`` at the exact points real failures occur, so a
+passing test demonstrates the behaviour, not a mock of it.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import (
+    Client,
+    Context,
+    DistributedRuntime,
+    HubServer,
+    NoInstancesError,
+    RemoteEngineError,
+    RetryPolicy,
+    RouterMode,
+    collect,
+    faults,
+)
+from dynamo_tpu.runtime.resilience import (
+    AdmissionController,
+    AdmissionRejected,
+    BreakerState,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceededError,
+    metrics as resilience_metrics,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    resilience_metrics.reset()
+    yield
+    faults.reset()
+    resilience_metrics.reset()
+
+
+# --------------------------------------------------------------------------
+# Unit: primitives
+# --------------------------------------------------------------------------
+
+
+def test_retry_policy_backoff_bounded_with_jitter():
+    policy = RetryPolicy(max_attempts=5, base_delay_s=0.1, max_delay_s=1.0)
+    for attempt in range(1, 10):
+        cap = min(1.0, 0.1 * 2 ** (attempt - 1))
+        for _ in range(20):
+            delay = policy.backoff(attempt)
+            assert 0.0 <= delay <= cap
+
+
+def test_deadline_expiry_and_check():
+    d = Deadline.after(1000)
+    assert not d.expired
+    assert d.remaining() > 999
+    past = Deadline.after(-0.001)
+    assert past.expired
+    with pytest.raises(DeadlineExceededError):
+        past.check("unit")
+
+
+def test_circuit_breaker_open_half_open_close_cycle():
+    t = [0.0]
+    b = CircuitBreaker(key="w", failure_threshold=3, reset_timeout_s=5.0,
+                       clock=lambda: t[0])
+    assert b.state is BreakerState.CLOSED
+    b.record_failure()
+    b.record_failure()
+    assert b.state is BreakerState.CLOSED  # below threshold
+    b.record_failure()
+    assert b.state is BreakerState.OPEN
+    assert not b.can_attempt()  # reset window not elapsed
+    t[0] += 5.1
+    assert b.can_attempt()  # eligible for a probe
+    b.on_attempt()
+    assert b.state is BreakerState.HALF_OPEN
+    assert not b.can_attempt()  # single probe in flight
+    b.record_failure()  # probe failed → re-open
+    assert b.state is BreakerState.OPEN
+    t[0] += 5.1
+    b.on_attempt()
+    b.record_success()  # probe succeeded → close
+    assert b.state is BreakerState.CLOSED
+    assert b.can_attempt()
+
+
+def test_circuit_breaker_success_resets_failure_streak():
+    b = CircuitBreaker(key="w", failure_threshold=3)
+    b.record_failure()
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    b.record_failure()
+    assert b.state is BreakerState.CLOSED  # streak broken by the success
+
+
+@pytest.mark.asyncio
+async def test_admission_controller_sheds_and_hands_over():
+    adm = AdmissionController(max_inflight=1, max_queue=1, queue_timeout_s=0.2)
+    await adm.acquire()
+    assert adm.inflight == 1
+
+    # second request queues; third overflows with 429
+    waiter = asyncio.create_task(adm.acquire())
+    await asyncio.sleep(0.01)
+    assert adm.queued == 1
+    with pytest.raises(AdmissionRejected) as e429:
+        await adm.acquire()
+    assert e429.value.status == 429
+    assert e429.value.retry_after_s >= 1.0
+
+    # releasing hands the slot to the queued waiter
+    adm.release()
+    await waiter
+    assert adm.inflight == 1 and adm.queued == 0
+    adm.release()
+    assert adm.inflight == 0
+
+
+@pytest.mark.asyncio
+async def test_admission_wait_timeout_sheds_503():
+    adm = AdmissionController(max_inflight=1, max_queue=2, queue_timeout_s=0.05)
+    await adm.acquire()
+    with pytest.raises(AdmissionRejected) as e503:
+        await adm.acquire()
+    assert e503.value.status == 503
+    adm.release()
+    assert adm.inflight == 0 and adm.queued == 0
+
+
+def test_fault_env_spec_parsing_keeps_host_port_matches():
+    from dynamo_tpu.runtime.faultinject import FaultInjector
+
+    fi = FaultInjector()
+    fi.load_env("connect_error:127.0.0.1:9001#2,delay:*,error_prologue")
+    ce = fi._points["connect_error"][0]
+    assert ce.match == "127.0.0.1:9001"  # ':' in host:port is NOT a count
+    assert ce.count == 2
+    assert fi._points["delay"][0].match == "*"
+    assert fi._points["delay"][0].count is None
+    assert fi._points["error_prologue"][0].match == "*"
+    assert fi.is_armed("connect_error", "127.0.0.1:9001")
+    assert not fi.is_armed("connect_error", "127.0.0.1:9002")
+
+
+def test_client_reads_resilience_knobs_from_env(monkeypatch):
+    monkeypatch.setenv("DYN_RESILIENCE__RETRY_MAX_ATTEMPTS", "7")
+    monkeypatch.setenv("DYN_RESILIENCE__BREAKER_RESET_S", "1.5")
+    client = Client(hub=None, instance_prefix="cfg-test")
+    assert client.retry_policy.max_attempts == 7
+    assert client.breaker_reset_s == 1.5
+    # explicit arguments still win over the environment
+    explicit = Client(hub=None, instance_prefix="cfg-test",
+                      retry_policy=RetryPolicy(max_attempts=2),
+                      breaker_reset_s=0.25)
+    assert explicit.retry_policy.max_attempts == 2
+    assert explicit.breaker_reset_s == 0.25
+
+
+# --------------------------------------------------------------------------
+# Distributed chaos helpers
+# --------------------------------------------------------------------------
+
+
+async def _serve_echo(runtime, ns="chaos", comp="worker", ep="generate", n_items=3):
+    async def echo(request: Context):
+        for i in range(n_items):
+            yield {"i": i, "worker": runtime.worker_id}
+
+    endpoint = runtime.namespace(ns).component(comp).endpoint(ep)
+    await endpoint.serve_endpoint(echo)
+    return endpoint
+
+
+def _resilient_client(rt, ns="chaos", comp="worker", ep="generate", **kw):
+    endpoint = rt.namespace(ns).component(comp).endpoint(ep)
+    kw.setdefault("retry_policy", RetryPolicy(max_attempts=4, base_delay_s=0.01))
+    kw.setdefault("breaker_failure_threshold", 3)
+    kw.setdefault("breaker_reset_s", 0.3)
+    return Client(rt.hub, endpoint.instance_prefix, **kw)
+
+
+# --------------------------------------------------------------------------
+# Chaos: failover
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_connect_failure_fails_over_to_live_worker():
+    hub = await HubServer().start()
+    w1 = await DistributedRuntime.connect(hub.address)
+    w2 = await DistributedRuntime.connect(hub.address)
+    crt = await DistributedRuntime.connect(hub.address)
+    try:
+        await _serve_echo(w1)
+        await _serve_echo(w2)
+        dead_addr = (await w1.service_server()).address
+        client = await _resilient_client(crt).start()
+        await client.wait_for_instances(5)
+        while len(client.instance_ids) < 2:
+            await asyncio.sleep(0.02)
+
+        faults.arm("connect_error", match=dead_addr)
+        for _ in range(6):
+            items = await collect(await client.generate(Context({})))
+            assert len(items) == 3
+            assert items[0]["worker"] == w2.worker_id  # only the live one
+        assert resilience_metrics.retries_total > 0
+        await client.close()
+    finally:
+        faults.reset()
+        for rt in (w1, w2, crt):
+            await rt.close()
+        await hub.close()
+
+
+@pytest.mark.asyncio
+async def test_error_prologue_fails_over_before_first_token():
+    hub = await HubServer().start()
+    w1 = await DistributedRuntime.connect(hub.address)
+    w2 = await DistributedRuntime.connect(hub.address)
+    crt = await DistributedRuntime.connect(hub.address)
+    try:
+        await _serve_echo(w1)
+        await _serve_echo(w2)
+        client = await _resilient_client(crt).start()
+        await client.wait_for_instances(5)
+        while len(client.instance_ids) < 2:
+            await asyncio.sleep(0.02)
+
+        # the next stream setup fails at the prologue, whichever worker gets
+        # it — the request must transparently land on the other
+        faults.arm("error_prologue", count=1)
+        items = await collect(await client.generate(Context({})))
+        assert len(items) == 3
+        assert resilience_metrics.retries_total >= 1
+        await client.close()
+    finally:
+        faults.reset()
+        for rt in (w1, w2, crt):
+            await rt.close()
+        await hub.close()
+
+
+@pytest.mark.asyncio
+async def test_no_retry_after_first_token():
+    """A mid-stream death after tokens flowed is NOT idempotent — the error
+    must surface, not a silent replay on another worker."""
+    hub = await HubServer().start()
+    w1 = await DistributedRuntime.connect(hub.address)
+    w2 = await DistributedRuntime.connect(hub.address)
+    crt = await DistributedRuntime.connect(hub.address)
+    try:
+        await _serve_echo(w1, n_items=10)
+        await _serve_echo(w2, n_items=10)
+        client = await _resilient_client(crt).start()
+        await client.wait_for_instances(5)
+        while len(client.instance_ids) < 2:
+            await asyncio.sleep(0.02)
+
+        faults.arm("drop_mid_stream", count=1)
+        stream = await client.generate(Context({}))
+        got = []
+        with pytest.raises(RemoteEngineError):
+            async for item in stream:
+                got.append(item)
+        assert 1 <= len(got) < 10  # tokens flowed, then the worker died
+        assert resilience_metrics.failovers_total == 0  # no post-token retry
+        await client.close()
+    finally:
+        faults.reset()
+        for rt in (w1, w2, crt):
+            await rt.close()
+        await hub.close()
+
+
+@pytest.mark.asyncio
+async def test_application_errors_are_not_replayed():
+    """An engine ValueError (bad request) must not burn retries on every
+    other worker — the prologue tags it non-retryable."""
+    hub = await HubServer().start()
+    w1 = await DistributedRuntime.connect(hub.address)
+    crt = await DistributedRuntime.connect(hub.address)
+    try:
+        from dynamo_tpu.runtime.engine import AsyncEngine
+
+        class RejectingEngine(AsyncEngine):
+            async def generate(self, request):
+                raise ValueError("bad sampling params")
+
+        ep = w1.namespace("chaos").component("worker").endpoint("generate")
+        await ep.serve_endpoint(RejectingEngine())
+        client = await _resilient_client(crt).start()
+        await client.wait_for_instances(5)
+
+        with pytest.raises(RemoteEngineError, match="bad sampling params"):
+            await client.generate(Context({}))
+        assert resilience_metrics.retries_total == 0
+        await client.close()
+    finally:
+        for rt in (w1, crt):
+            await rt.close()
+        await hub.close()
+
+
+# --------------------------------------------------------------------------
+# Chaos: the acceptance scenario — burst over a dead worker, breaker cycle
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_burst_over_dead_worker_zero_errors_and_breaker_recovery():
+    """3 workers, one refusing connections: a 50-request burst completes with
+    zero client-visible errors, the dead worker's breaker opens (visible in
+    the metrics exposition), and a half-open probe closes it once the fault
+    clears."""
+    hub = await HubServer().start()
+    workers = [await DistributedRuntime.connect(hub.address) for _ in range(3)]
+    crt = await DistributedRuntime.connect(hub.address)
+    try:
+        for w in workers:
+            await _serve_echo(w)
+        dead_addr = (await workers[0].service_server()).address
+        client = await _resilient_client(crt).start()
+        await client.wait_for_instances(5)
+        while len(client.instance_ids) < 3:
+            await asyncio.sleep(0.02)
+
+        faults.arm("connect_error", match=dead_addr)
+
+        async def one(i):
+            return await collect(await client.generate(Context({"n": i})))
+
+        results = await asyncio.gather(*[one(i) for i in range(50)])
+        assert all(len(r) == 3 for r in results)  # zero client-visible errors
+        live = {workers[1].worker_id, workers[2].worker_id}
+        assert all(r[0]["worker"] in live for r in results)
+
+        # the dead worker's breaker is open and visible in Prometheus text
+        breaker = client._breakers[dead_addr]
+        assert breaker.state is BreakerState.OPEN
+        exposition = resilience_metrics.render()
+        assert f'breaker_state{{worker="{dead_addr}"}} 2' in exposition
+        assert resilience_metrics.retries_total >= 1
+
+        # fault clears → half-open probe → breaker closes, worker takes
+        # traffic again
+        faults.reset()
+        await asyncio.sleep(0.35)  # breaker_reset_s elapses
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while breaker.state is not BreakerState.CLOSED:
+            await collect(await client.generate(Context({})))
+            assert asyncio.get_running_loop().time() < deadline, (
+                "breaker never closed after the fault cleared"
+            )
+        seen = set()
+        for _ in range(12):
+            items = await collect(await client.generate(Context({})))
+            seen.add(items[0]["worker"])
+        assert workers[0].worker_id in seen  # recovered worker serves again
+        await client.close()
+    finally:
+        faults.reset()
+        for rt in (*workers, crt):
+            await rt.close()
+        await hub.close()
+
+
+# --------------------------------------------------------------------------
+# Chaos: deadlines
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_deadline_expires_waiting_for_slow_worker():
+    hub = await HubServer().start()
+    w1 = await DistributedRuntime.connect(hub.address)
+    crt = await DistributedRuntime.connect(hub.address)
+    try:
+        await _serve_echo(w1)
+        client = await _resilient_client(crt).start()
+        await client.wait_for_instances(5)
+
+        faults.arm("delay", delay_s=1.0)  # worker stalls before the prologue
+        ctx = Context({})
+        ctx.ctx.deadline = Deadline.after(0.15)
+        with pytest.raises(DeadlineExceededError):
+            await collect(await client.generate(ctx))
+        assert resilience_metrics.deadline_exceeded_total >= 1
+        await client.close()
+    finally:
+        faults.reset()
+        for rt in (w1, crt):
+            await rt.close()
+        await hub.close()
+
+
+@pytest.mark.asyncio
+async def test_deadline_propagates_to_remote_context():
+    """The server-side engine sees the remaining budget on its context."""
+    hub = await HubServer().start()
+    w1 = await DistributedRuntime.connect(hub.address)
+    crt = await DistributedRuntime.connect(hub.address)
+    seen = {}
+    try:
+        async def probe(request: Context):
+            d = getattr(request.ctx, "deadline", None)
+            seen["remaining"] = d.remaining() if d is not None else None
+            yield {"ok": True}
+
+        ep = w1.namespace("chaos").component("worker").endpoint("generate")
+        await ep.serve_endpoint(probe)
+        client = await _resilient_client(crt).start()
+        await client.wait_for_instances(5)
+
+        ctx = Context({})
+        ctx.ctx.deadline = Deadline.after(5.0)
+        await collect(await client.generate(ctx))
+        assert seen["remaining"] is not None
+        assert 0 < seen["remaining"] <= 5.0
+        await client.close()
+    finally:
+        for rt in (w1, crt):
+            await rt.close()
+        await hub.close()
+
+
+# --------------------------------------------------------------------------
+# Chaos: watch-loop survival (satellite 1) + wait_for_instances (satellite 2)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_watch_loop_survives_watcher_crash_and_resyncs():
+    hub = await HubServer().start()
+    w1 = await DistributedRuntime.connect(hub.address)
+    crt = await DistributedRuntime.connect(hub.address)
+    try:
+        await _serve_echo(w1)
+        client = await _resilient_client(crt).start()
+        await client.wait_for_instances(5)
+        assert len(client.instance_ids) == 1
+
+        # crash the watch stream (the next delivered event trips it), then
+        # register a SECOND worker — the re-established watch + resync must
+        # observe it and keep routing
+        faults.arm("watch_error", count=1)
+        w2 = await DistributedRuntime.connect(hub.address)
+        await _serve_echo(w2)
+        try:
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while (
+                resilience_metrics.watch_restarts_total < 1
+                or len(client.instance_ids) < 2
+            ):
+                await asyncio.sleep(0.05)
+                assert asyncio.get_running_loop().time() < deadline, (
+                    "watch never recovered: instance set frozen stale"
+                )
+            # routing still works end to end after the restart
+            items = await collect(await client.generate(Context({})))
+            assert len(items) == 3
+        finally:
+            await w2.close()
+        await client.close()
+    finally:
+        faults.reset()
+        for rt in (w1, crt):
+            await rt.close()
+        await hub.close()
+
+
+@pytest.mark.asyncio
+async def test_wait_for_instances_raises_no_instances_error():
+    hub = await HubServer().start()
+    crt = await DistributedRuntime.connect(hub.address)
+    try:
+        client = await _resilient_client(crt).start()
+        with pytest.raises(NoInstancesError) as err:
+            await client.wait_for_instances(0.1)
+        assert "instances/chaos/worker/generate/" in str(err.value)
+        assert err.value.prefix.startswith("instances/chaos")
+        await client.close()
+    finally:
+        await crt.close()
+        await hub.close()
+
+
+@pytest.mark.asyncio
+async def test_remote_engine_cached_per_instance_and_evicted():
+    hub = await HubServer().start()
+    w1 = await DistributedRuntime.connect(hub.address)
+    crt = await DistributedRuntime.connect(hub.address)
+    try:
+        await _serve_echo(w1)
+        client = await _resilient_client(crt).start()
+        await client.wait_for_instances(5)
+
+        await collect(await client.generate(Context({})))
+        engine1 = client._engines[w1.worker_id]
+        await collect(await client.generate(Context({})))
+        assert client._engines[w1.worker_id] is engine1  # reused, not rebuilt
+
+        # instance removal evicts the cached engine
+        await w1.close()
+        deadline = asyncio.get_running_loop().time() + 15.0
+        while w1.worker_id in client.instance_ids:
+            await asyncio.sleep(0.05)
+            assert asyncio.get_running_loop().time() < deadline
+        assert w1.worker_id not in client._engines
+        await client.close()
+    finally:
+        await crt.close()
+        await hub.close()
+
+
+# --------------------------------------------------------------------------
+# Chaos: HTTP edge — admission 429/503, deadline 504, no-instances 503
+# --------------------------------------------------------------------------
+
+
+def _chat_chunk(content: str) -> dict:
+    return {
+        "id": "chatcmpl-test",
+        "object": "chat.completion.chunk",
+        "created": 0,
+        "model": "echo",
+        "choices": [
+            {"index": 0, "delta": {"role": "assistant", "content": content},
+             "finish_reason": "stop"}
+        ],
+        "usage": {"prompt_tokens": 1, "completion_tokens": 1, "total_tokens": 2},
+    }
+
+
+def _make_http_service(**kw):
+    from dynamo_tpu.llm import (
+        Backend,
+        ByteTokenizer,
+        EchoEngineCore,
+        HttpService,
+        OpenAIPreprocessor,
+    )
+    from dynamo_tpu.runtime import build_pipeline
+
+    service = HttpService(host="127.0.0.1", port=0, **kw)
+    tok = ByteTokenizer()
+    pipeline = build_pipeline(
+        [OpenAIPreprocessor(tok, "echo"), Backend(tok)], EchoEngineCore()
+    )
+    service.models.add_chat_model("echo", pipeline)
+    service.models.add_completion_model("echo", pipeline)
+    return service
+
+
+@pytest.mark.asyncio
+async def test_http_admission_sheds_429_under_burst_never_500():
+    from aiohttp import ClientSession
+
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.runtime.engine import AsyncEngine, ResponseStream
+
+    class SlowEngine(AsyncEngine):
+        async def generate(self, request):
+            async def gen():
+                await asyncio.sleep(0.3)
+                yield _chat_chunk("hi")
+
+            return ResponseStream(gen(), request.ctx)
+
+    service = HttpService(
+        host="127.0.0.1", port=0, max_inflight=2, admission_queue=0
+    )
+    service.models.add_chat_model("echo", SlowEngine())
+    await service.start()
+    base = f"http://127.0.0.1:{service.port}"
+    body = {"model": "echo", "messages": [{"role": "user", "content": "x"}]}
+    try:
+        async with ClientSession() as http:
+            async def one():
+                async with http.post(f"{base}/v1/chat/completions", json=body) as r:
+                    return r.status, r.headers.get("Retry-After")
+
+            results = await asyncio.gather(*[one() for _ in range(10)])
+        statuses = [s for s, _ in results]
+        assert statuses.count(200) == 2  # exactly the in-flight cap
+        assert statuses.count(429) == 8  # the rest shed, never 500
+        assert 500 not in statuses
+        assert all(ra is not None for s, ra in results if s == 429)
+
+        # shed counters are visible on /metrics
+        async with ClientSession() as http:
+            async with http.get(f"{base}/metrics") as r:
+                text = await r.text()
+        assert 'admission_shed_total{status="429"} 8' in text
+    finally:
+        await service.close()
+
+
+@pytest.mark.asyncio
+async def test_http_admission_queue_absorbs_then_sheds_503():
+    from aiohttp import ClientSession
+
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.runtime.engine import AsyncEngine, ResponseStream
+
+    class SlowEngine(AsyncEngine):
+        async def generate(self, request):
+            async def gen():
+                await asyncio.sleep(0.15)
+                yield _chat_chunk("ok")
+
+            return ResponseStream(gen(), request.ctx)
+
+    service = HttpService(
+        host="127.0.0.1", port=0,
+        max_inflight=1, admission_queue=1, admission_timeout_s=0.05,
+    )
+    service.models.add_chat_model("echo", SlowEngine())
+    await service.start()
+    base = f"http://127.0.0.1:{service.port}"
+    body = {"model": "echo", "messages": [{"role": "user", "content": "x"}]}
+    try:
+        async with ClientSession() as http:
+            async def one():
+                async with http.post(f"{base}/v1/chat/completions", json=body) as r:
+                    return r.status
+
+            statuses = await asyncio.gather(*[one() for _ in range(3)])
+        # 1 admitted, 1 queued past its wait budget → 503, 1 overflow → 429
+        assert sorted(statuses) == [200, 429, 503]
+    finally:
+        await service.close()
+
+
+@pytest.mark.asyncio
+async def test_http_deadline_maps_to_504():
+    from aiohttp import ClientSession
+
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.runtime.engine import AsyncEngine, ResponseStream
+
+    class StalledEngine(AsyncEngine):
+        async def generate(self, request):
+            async def gen():
+                await asyncio.sleep(5.0)
+                yield {"choices": []}
+
+            return ResponseStream(gen(), request.ctx)
+
+    service = HttpService(host="127.0.0.1", port=0, default_deadline_s=0.1)
+    service.models.add_chat_model("echo", StalledEngine())
+    await service.start()
+    base = f"http://127.0.0.1:{service.port}"
+    try:
+        async with ClientSession() as http:
+            async with http.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "echo", "messages": [{"role": "user", "content": "x"}]},
+            ) as r:
+                assert r.status == 504
+                data = await r.json()
+                assert data["error"]["type"] == "timeout_error"
+    finally:
+        await service.close()
+
+
+@pytest.mark.asyncio
+async def test_http_per_request_deadline_header_wins():
+    from aiohttp import ClientSession
+
+    service = _make_http_service(default_deadline_s=None)
+    await service.start()
+    base = f"http://127.0.0.1:{service.port}"
+    try:
+        async with ClientSession() as http:
+            # generous per-request deadline on a fast engine: succeeds
+            async with http.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "echo", "messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 16},
+                headers={"x-deadline-s": "10"},
+            ) as r:
+                assert r.status == 200
+    finally:
+        await service.close()
+
+
+@pytest.mark.asyncio
+async def test_http_no_instances_maps_to_503():
+    from aiohttp import ClientSession
+
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.runtime.engine import AsyncEngine
+
+    class NoWorkers(AsyncEngine):
+        async def generate(self, request):
+            raise NoInstancesError("no instances under 'instances/x/'",
+                                   prefix="instances/x/")
+
+    service = HttpService(host="127.0.0.1", port=0)
+    service.models.add_chat_model("echo", NoWorkers())
+    await service.start()
+    base = f"http://127.0.0.1:{service.port}"
+    try:
+        async with ClientSession() as http:
+            async with http.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "echo", "messages": [{"role": "user", "content": "x"}]},
+            ) as r:
+                assert r.status == 503
+                assert r.headers.get("Retry-After") is not None
+    finally:
+        await service.close()
+
+
+# --------------------------------------------------------------------------
+# Chaos: disagg degraded mode (remote prefill falls back to local)
+# --------------------------------------------------------------------------
+
+
+class _FakeDisaggEngine:
+    def estimate_prefix_hit(self, tokens):
+        return 0
+
+    async def generate(self, request):
+        from dynamo_tpu.runtime.engine import ResponseStream
+
+        async def gen():
+            yield {"token": 1}
+
+        return ResponseStream(gen(), request.ctx)
+
+
+class _DeadQueue:
+    async def size(self):
+        return 0
+
+    async def enqueue(self, item):
+        raise ConnectionError("hub unreachable")
+
+
+class _BlackHoleQueue:
+    """Accepts work that no prefill worker will ever serve."""
+
+    def __init__(self):
+        self.items = []
+
+    async def size(self):
+        return 0
+
+    async def enqueue(self, item):
+        self.items.append(item)
+
+
+def _make_decode_worker(queue, transfer_timeout=0.1):
+    from dynamo_tpu.llm.disagg.router import DisaggConfig, DisaggregatedRouter
+    from dynamo_tpu.llm.disagg.worker import DisaggDecodeWorker
+
+    return DisaggDecodeWorker(
+        engine=_FakeDisaggEngine(),
+        queue=queue,
+        router=DisaggregatedRouter(
+            "m", DisaggConfig(max_local_prefill_length=2, max_prefill_queue_size=64)
+        ),
+        import_address="127.0.0.1:0",
+        import_path="kv",
+        transfer_timeout=transfer_timeout,
+    )
+
+
+@pytest.mark.asyncio
+async def test_disagg_enqueue_failure_degrades_to_local_prefill():
+    worker = _make_decode_worker(_DeadQueue())
+    stream = await worker.generate(Context({"token_ids": list(range(64))}))
+    items = [i async for i in stream]
+    assert items == [{"token": 1}]  # request served despite the dead queue
+    stats = worker.stats()
+    assert stats["degraded_fallbacks"] == 1
+    assert stats["local_prefills"] == 1
+    assert stats["remote_prefills"] == 0
+
+
+@pytest.mark.asyncio
+async def test_disagg_transfer_timeout_degrades_to_local_prefill():
+    queue = _BlackHoleQueue()
+    worker = _make_decode_worker(queue, transfer_timeout=0.05)
+    stream = await worker.generate(Context({"token_ids": list(range(64))}))
+    items = [i async for i in stream]
+    assert items == [{"token": 1}]
+    assert len(queue.items) == 1  # the transfer WAS attempted
+    stats = worker.stats()
+    assert stats["degraded_fallbacks"] == 1
+    assert stats["pending_transfers"] == 0  # timed-out future cleaned up
+
+
+@pytest.mark.asyncio
+async def test_disagg_deadline_caps_transfer_wait():
+    import time
+
+    queue = _BlackHoleQueue()
+    worker = _make_decode_worker(queue, transfer_timeout=30.0)
+    ctx = Context({"token_ids": list(range(64))})
+    ctx.ctx.deadline = Deadline.after(0.2)
+    t0 = time.monotonic()
+    stream = await worker.generate(ctx)
+    items = [i async for i in stream]
+    assert items == [{"token": 1}]
+    # the 30s transfer_timeout was capped by the 0.2s request deadline
+    assert time.monotonic() - t0 < 2.0
+    assert worker.stats()["degraded_fallbacks"] == 1
