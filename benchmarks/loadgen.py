@@ -114,6 +114,8 @@ async def _one(session: ClientSession, url: str, model: str, prompt: List[int],
                     ntok += 1
                 if done:
                     break
+    except asyncio.CancelledError:
+        raise
     except Exception as e:  # connection errors count as failures, not crashes
         return RequestResult(0, error=f"{type(e).__name__}: {e}")
     return RequestResult(ttft, itls, ntok, time.perf_counter() - t0)
@@ -198,6 +200,8 @@ async def _self_host(args):
     if layers <= 0 and model == "llama-3.1-8b" and not quant:
         try:
             mem = jax.devices()[0].memory_stats().get("bytes_limit", 16 << 30)
+        except asyncio.CancelledError:
+            raise
         except Exception:
             mem = 16 << 30
         # Leave room for the KV pool: weights ~0.52 GB/layer + ~2 GB fixed

@@ -18,6 +18,7 @@ Routes (mirroring the reference's shape):
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from typing import Any, Dict, Optional
 
@@ -91,6 +92,8 @@ class ApiStore:
     async def _create(self, request: web.Request) -> web.Response:
         try:
             body = await request.json()
+        except asyncio.CancelledError:
+            raise
         except Exception:
             return web.json_response({"error": "invalid JSON"}, status=400)
         name = (
@@ -105,6 +108,8 @@ class ApiStore:
         cr = _as_cr(name, body)
         try:
             render(cr)  # validate: reject specs the renderer can't map
+        except asyncio.CancelledError:
+            raise
         except Exception as e:
             return web.json_response(
                 {"error": f"invalid spec: {e}"}, status=400
@@ -116,6 +121,8 @@ class ApiStore:
                 status = await self.reconciler.reconcile(cr)
                 cr = dict(cr, status=status)
                 await self.hub.kv_put(PREFIX + name, cr)
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 logger.exception("reconcile on create failed")
         return web.json_response(cr, status=200 if existed else 201)
@@ -139,6 +146,8 @@ class ApiStore:
         if self.reconciler is not None:
             try:
                 await self.reconciler.teardown(name)
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 logger.exception("teardown on delete failed")
         return web.json_response({"deleted": name})

@@ -422,6 +422,8 @@ class Reconciler:
         for cr in crs:
             try:
                 await self.reconcile(cr)
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 logger.exception(
                     "reconcile failed for %s", cr["metadata"]["name"]
@@ -468,6 +470,8 @@ class Reconciler:
                 wake.clear()
                 try:
                     await self.run_pass()
+                except asyncio.CancelledError:
+                    raise
                 except Exception:
                     logger.exception("controller pass failed")
                 try:

@@ -815,6 +815,8 @@ class TpuEngine(
                     # Completed background fetches apply for free — parked
                     # rows resume without the loop ever blocking on D2H.
                     await self._harvest_pending()
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 # Same engine-fatal contract as the step path below: a
                 # failed D2H must fail all streams, never strand them.
@@ -828,6 +830,8 @@ class TpuEngine(
                 if self._pending_fetches:
                     try:
                         await self._harvest_pending(all_pending=True)
+                    except asyncio.CancelledError:
+                        raise
                     except Exception:
                         logger.exception("deferred fetch failed")
                         self._fail_all()
@@ -903,6 +907,8 @@ class TpuEngine(
                     # pure-decode state): single unified step still advances
                     # every sequence one token, and finishes free blocks.
                     await self._run_unified(plan)
+            except asyncio.CancelledError:
+                raise
             except Exception:  # engine-fatal: fail all inflight requests
                 logger.exception("engine step failed")
                 self._fail_all()

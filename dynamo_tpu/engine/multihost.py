@@ -102,6 +102,8 @@ class StepPublisher:
                 hello = await asyncio.wait_for(
                     reader.readexactly(len(expect)), 30.0
                 )
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 writer.close()
                 return
@@ -138,6 +140,8 @@ class StepPublisher:
     async def close(self) -> None:
         try:
             await self.publish("close")
+        except asyncio.CancelledError:
+            raise
         except Exception:
             pass
         await self.abort()

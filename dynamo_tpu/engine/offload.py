@@ -29,6 +29,8 @@ class HostOffloadMixin:
             if self._offload_queue:
                 try:
                     await self.drain_offload()
+                except asyncio.CancelledError:
+                    raise
                 except Exception:
                     # Offload is an optimization; never let it kill serving.
                     logger.exception("host KV offload cycle failed")

@@ -89,6 +89,8 @@ async def run_text_chat(pipeline, model: str, args, *, instream=None, out=None) 
             result = await _stream_chat(
                 pipeline, _chat_request(model, messages, args), out
             )
+        except asyncio.CancelledError:
+            raise
         except Exception as e:  # noqa: BLE001 — REPL stays alive
             out.write(f"error: {e}\n")
             messages.pop()
@@ -142,6 +144,8 @@ async def run_batch(
             req = _chat_request(model, [{"role": "user", "content": entry["text"]}], args)
             try:
                 r = await _stream_chat(pipeline, req, None)
+            except asyncio.CancelledError:
+                raise
             except Exception as e:  # noqa: BLE001 — batch keeps going
                 results[i] = dict(entry, response=None, error=str(e))
                 return

@@ -104,6 +104,8 @@ class DisaggregatedRouter:
                 if old is not None:
                     try:
                         await old.aclose()  # free the hub-side registration
+                    except asyncio.CancelledError:
+                        raise
                     except Exception:  # noqa: BLE001 — dead watcher
                         pass
                 self._watcher = await self._hub.watch_prefix(self.config_key)

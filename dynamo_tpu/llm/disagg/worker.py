@@ -132,6 +132,8 @@ class DisaggDecodeWorker(AsyncEngine):
         if remote:
             try:
                 qsize = await self.queue.size()
+            except asyncio.CancelledError:
+                raise
             except Exception:  # noqa: BLE001 — hub/queue unreachable
                 # Degraded mode: can't even ask the queue — serve locally
                 # rather than failing the request.
@@ -167,6 +169,8 @@ class DisaggDecodeWorker(AsyncEngine):
                     "reply": {"address": self.import_address, "path": self.import_path},
                 }
             )
+        except asyncio.CancelledError:
+            raise
         except Exception:  # noqa: BLE001 — hub/queue unreachable
             self._pending.pop(transfer_id, None)
             logger.warning("prefill enqueue failed; degrading to local prefill")

@@ -156,6 +156,8 @@ class ModelWatcher:
                         await self._handle_put(name, event.value)
                     else:
                         await self._handle_delete(name)
+                except asyncio.CancelledError:
+                    raise
                 except Exception:  # noqa: BLE001 — keep watching
                     logger.exception("model watcher failed handling %s", event.key)
         except asyncio.CancelledError:

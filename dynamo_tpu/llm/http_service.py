@@ -252,6 +252,8 @@ class HttpService:
             guard.finish(Status.REJECTED)
             logger.warning("no instances for %s: %s", model, e)
             return _error_response(503, str(e), rid=ctx.id, retry_after_s=1.0)
+        except asyncio.CancelledError:
+            raise
         except Exception as e:  # noqa: BLE001 — edge boundary
             guard.finish(Status.ERROR)
             logger.exception("engine rejected request")
@@ -288,6 +290,10 @@ class HttpService:
             guard.finish(Status.CLIENT_DROP)
             raise
         except DeadlineExceededError as e:
+            # Abandoning the request must also stop upstream generation —
+            # otherwise the engine keeps burning batch slots on a response
+            # nobody will read, exactly when the server is already slow.
+            ctx.stop_generating()
             guard.finish(Status.ERROR)
             logger.warning("request %s deadline exceeded mid-generation", ctx.id)
             return _error_response(504, str(e) or "deadline exceeded", rid=ctx.id)
@@ -334,8 +340,10 @@ class HttpService:
                 guard.on_token()
                 await resp.write(sse_encode(chunk))
             await resp.write(SSE_DONE)
-        except (ConnectionResetError, asyncio.CancelledError):
-            # client went away: stop upstream generation
+        except (ConnectionResetError, asyncio.CancelledError):  # dynalint: disable=DYN003
+            # Client went away: aiohttp cancels this handler on disconnect.
+            # Deliberately absorb it — upstream generation must be stopped
+            # and the CLIENT_DROP metric recorded before the handler exits.
             ctx.stop_generating()
             status = Status.CLIENT_DROP
         except DeadlineExceededError:

@@ -71,6 +71,8 @@ class KvEventPublisher:
             payload, done = self._queue.get_nowait()
             try:
                 await self._component.publish(KV_EVENTS_TOPIC, payload)
+            except asyncio.CancelledError:
+                raise
             except Exception as exc:
                 logger.exception("kv event publish failed")
                 if not done.done():

@@ -9,6 +9,7 @@ formatted prompt / token ids back to the caller as annotation events.
 
 from __future__ import annotations
 
+import asyncio
 from typing import Any, AsyncIterator, Dict, Optional, Union
 
 from ..runtime.engine import AsyncEngine, Context, ResponseStream
@@ -144,6 +145,8 @@ class OpenAIPreprocessor(Operator):
                                 None,
                             )
                         )
+            except asyncio.CancelledError:
+                raise
             except Exception as e:  # surface, don't truncate silently
                 await queue.put((e, None))
             finally:

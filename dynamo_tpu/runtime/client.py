@@ -200,6 +200,8 @@ class Client(AsyncEngine):
                     if old is not None:
                         try:
                             await old.aclose()
+                        except asyncio.CancelledError:
+                            raise
                         except Exception:  # noqa: BLE001 — dead watcher
                             pass
                     self._watcher = await self.hub.watch_prefix(
@@ -358,11 +360,17 @@ class Client(AsyncEngine):
                     stream = await engine.generate(request)
             except DeadlineExceededError:
                 # An exhausted budget is the request's problem, not proof the
-                # worker is sick — don't poison its breaker.
+                # worker is sick — don't poison its breaker, but do hand back
+                # the half-open probe slot if this attempt was the probe.
+                breaker.release_probe()
                 metrics.deadline_exceeded_total += 1
+                raise
+            except asyncio.CancelledError:
+                breaker.release_probe()
                 raise
             except Exception as e:  # noqa: BLE001 — classified below
                 if not _is_retryable(e):
+                    breaker.release_probe()
                     raise
                 breaker.record_failure()
                 self._evict(wid)

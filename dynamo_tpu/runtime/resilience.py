@@ -169,6 +169,16 @@ class CircuitBreaker:
         if self._state is not BreakerState.CLOSED:
             self._transition(BreakerState.CLOSED)
 
+    def release_probe(self) -> None:
+        """The in-flight half-open probe ended inconclusively (deadline hit,
+        caller cancelled, non-retryable request error — none of which prove
+        the WORKER sick or healthy): return to OPEN keeping the original
+        open timestamp, so the next pick may probe immediately.  Without
+        this the breaker wedges in HALF_OPEN (can_attempt always False) and
+        a recovered worker is excluded from routing forever."""
+        if self._state is BreakerState.HALF_OPEN:
+            self._transition(BreakerState.OPEN)
+
     def record_failure(self) -> None:
         self._consecutive_failures += 1
         if self._state is BreakerState.HALF_OPEN:

@@ -510,6 +510,11 @@ class HubServer:
         self.persist_path = persist_path
         self.persist_interval_s = persist_interval_s
         self._persist_task: Optional[asyncio.Task] = None
+        # Live per-connection handler tasks.  asyncio's Server.close() does
+        # NOT end established connections (and 3.12's wait_closed would wait
+        # on them forever), so close() cancels these explicitly — no orphan
+        # pump/handler tasks may survive a closed hub.
+        self._conn_tasks: set = set()
 
     async def start(self) -> "HubServer":
         if self.persist_path and os.path.exists(self.persist_path):
@@ -537,6 +542,8 @@ class HubServer:
             await asyncio.sleep(self.persist_interval_s)
             try:
                 self._persist_now()
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 logger.exception("hub snapshot failed")
 
@@ -554,14 +561,21 @@ class HubServer:
             self._persist_task = None
         try:
             self._persist_now()
+        except asyncio.CancelledError:
+            raise
         except Exception:
             logger.exception("final hub snapshot failed")
         if self._server is not None:
             self._server.close()
+            for task in list(self._conn_tasks):
+                task.cancel()
             await self._server.wait_closed()
+            self._server = None
         await self.state.close()
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        conn_task = asyncio.current_task()
+        self._conn_tasks.add(conn_task)
         session_watches: Dict[str, asyncio.Task] = {}
         session_subs: Dict[str, asyncio.Task] = {}
         session_unacked: Set[str] = set()
@@ -619,7 +633,16 @@ class HubServer:
                         # respond before pumping: the client must map wid → queue
                         # before the first push (snapshot) hits the socket
                         await send({"rid": rid, "ok": True, "id": wid})
-                        session_watches[wid] = asyncio.create_task(pump_watch(wid, q))
+                        wt = asyncio.create_task(pump_watch(wid, q))
+                        session_watches[wid] = wt
+                        # A crashed pump must not linger as a live-looking
+                        # entry (close() would "cancel" a dead task and
+                        # leak the watch registration).
+                        wt.add_done_callback(
+                            lambda t, wid=wid: session_watches.pop(wid, None)
+                            if session_watches.get(wid) is t
+                            else None
+                        )
                     elif op == "watch_cancel":
                         wid = msg["id"]
                         task = session_watches.pop(wid, None)
@@ -642,7 +665,13 @@ class HubServer:
                     elif op == "subscribe":
                         sid, q = await st.subscribe(msg["pattern"])
                         await send({"rid": rid, "ok": True, "id": sid})
-                        session_subs[sid] = asyncio.create_task(pump_sub(sid, q))
+                        st_task = asyncio.create_task(pump_sub(sid, q))
+                        session_subs[sid] = st_task
+                        st_task.add_done_callback(
+                            lambda t, sid=sid: session_subs.pop(sid, None)
+                            if session_subs.get(sid) is t
+                            else None
+                        )
                     elif op == "unsubscribe":
                         sid = msg["id"]
                         task = session_subs.pop(sid, None)
@@ -669,6 +698,8 @@ class HubServer:
                         await send({"rid": rid, "ok": True})
                     else:
                         await send({"rid": rid, "ok": False, "error": f"unknown op {op}"})
+                except asyncio.CancelledError:
+                    raise
                 except Exception as e:  # noqa: BLE001 — protocol surface
                     await send({"rid": rid, "ok": False, "error": str(e)})
         except (ConnectionResetError, asyncio.IncompleteReadError):
@@ -685,6 +716,7 @@ class HubServer:
             for token in list(session_unacked):
                 await self.state.q_nack(token)
             writer.close()
+            self._conn_tasks.discard(conn_task)
 
 
 # --------------------------------------------------------------------------

@@ -91,6 +91,11 @@ class ServiceServer:
         wlock = asyncio.Lock()
         headers: Dict[int, Dict[str, Any]] = {}  # sid → REQ_HEADER awaiting data
         streams: Dict[int, Tuple[AsyncEngineContext, asyncio.Task]] = {}
+        # Every spawned serve_stream keeps a strong ref here until done —
+        # `streams` only covers tasks past their first registration line, so
+        # a task cancelled (or GC'd) before its first step would otherwise
+        # leak out of the finally-block sweep below.
+        stream_tasks: set = set()
 
         async def send(ftype: FrameType, obj: Any = None, sid: int = 0) -> None:
             async with wlock:
@@ -131,6 +136,8 @@ class ServiceServer:
                     return
                 try:
                     stream = await engine.generate(Context(data, ctx))
+                except asyncio.CancelledError:
+                    raise
                 except Exception as e:  # noqa: BLE001 — remote boundary
                     # Request-shape errors are the caller's fault — tag them
                     # non-retryable so failover doesn't replay them.
@@ -160,6 +167,8 @@ class ServiceServer:
                     await send(FrameType.RESP_COMPLETE, None, sid)
                 except (ConnectionResetError, BrokenPipeError):
                     ctx.stop_generating()
+                except asyncio.CancelledError:
+                    raise
                 except Exception as e:  # noqa: BLE001 — stream error to client
                     try:
                         await send(FrameType.RESP_ERROR, {"error": str(e)}, sid)
@@ -181,7 +190,11 @@ class ServiceServer:
                     header = headers.pop(sid, None)
                     if header is None:
                         continue  # protocol slip; drop
-                    asyncio.create_task(serve_stream(sid, header, frame.unpack()))
+                    t = asyncio.create_task(
+                        serve_stream(sid, header, frame.unpack())
+                    )
+                    stream_tasks.add(t)
+                    t.add_done_callback(stream_tasks.discard)
                 elif frame.type == FrameType.CANCEL:
                     if sid in streams:
                         streams[sid][0].stop_generating()
@@ -194,6 +207,10 @@ class ServiceServer:
         finally:
             for ctx, task in list(streams.values()):
                 ctx.stop_generating()
+                task.cancel()
+            # Catch stragglers not yet registered in `streams` too — after
+            # close() the connection must own zero live tasks.
+            for task in list(stream_tasks):
                 task.cancel()
             writer.close()
             self._conn_tasks.discard(conn_task)
@@ -353,7 +370,9 @@ class RemoteEngine(AsyncEngine):
                     FrameType.KILL if ctx.is_killed else FrameType.CANCEL, sid
                 )
             except asyncio.CancelledError:
-                pass
+                # aclose() cancels this helper when the stream ends; ending
+                # as a cancelled task (nobody awaits the result) is clean.
+                raise
 
         cancel_task = asyncio.create_task(forward_cancel())
         return ResponseStream(

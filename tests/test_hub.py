@@ -33,6 +33,21 @@ async def hub_pair():
     return server, client
 
 
+async def assert_no_orphan_tasks(*needles: str) -> None:
+    """After close(), no transport-owned task may still be alive (dynalint
+    DYN002 contract: every spawned pump/handler is tracked and cancelled)."""
+    for _ in range(3):  # let just-cancelled tasks actually finish
+        await asyncio.sleep(0)
+    orphans = [
+        getattr(t.get_coro(), "__qualname__", repr(t))
+        for t in asyncio.all_tasks()
+        if t is not asyncio.current_task()
+        and not t.done()
+        and any(n in getattr(t.get_coro(), "__qualname__", "") for n in needles)
+    ]
+    assert not orphans, f"orphan tasks after close(): {orphans}"
+
+
 @pytest.mark.asyncio
 async def test_kv_roundtrip_tcp():
     server, client = await hub_pair()
@@ -63,10 +78,12 @@ async def test_watch_snapshot_then_delta():
         await client.kv_delete("w/a")
         ev = await asyncio.wait_for(watcher.__anext__(), 2)
         assert (ev.type, ev.key) == ("delete", "w/a")
-        await watcher.aclose()
+        # Deliberately do NOT aclose() the watcher: closing the hub alone
+        # must still reap its server-side pump task (no orphans).
     finally:
         await client.close()
         await server.close()
+    await assert_no_orphan_tasks("pump_watch", "pump_sub", "HubServer._handle")
 
 
 @pytest.mark.asyncio

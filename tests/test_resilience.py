@@ -93,6 +93,31 @@ def test_circuit_breaker_open_half_open_close_cycle():
     assert b.can_attempt()
 
 
+def test_circuit_breaker_inconclusive_probe_releases_half_open():
+    """A half-open probe that ends without a verdict (deadline exhausted,
+    caller cancelled, non-retryable request error) must hand the probe slot
+    back — otherwise the breaker wedges in HALF_OPEN (can_attempt() always
+    False) and a recovered worker is excluded from routing forever."""
+    t = [0.0]
+    b = CircuitBreaker(key="w", failure_threshold=1, reset_timeout_s=5.0,
+                       clock=lambda: t[0])
+    b.record_failure()
+    assert b.state is BreakerState.OPEN
+    t[0] += 5.1
+    b.on_attempt()
+    assert b.state is BreakerState.HALF_OPEN
+    b.release_probe()  # probe died of deadline/cancel, not worker health
+    assert b.state is BreakerState.OPEN
+    # The original open timestamp is kept: the next pick may probe NOW.
+    assert b.can_attempt()
+    b.on_attempt()
+    b.record_success()
+    assert b.state is BreakerState.CLOSED
+    # release_probe outside HALF_OPEN is a no-op.
+    b.release_probe()
+    assert b.state is BreakerState.CLOSED
+
+
 def test_circuit_breaker_success_resets_failure_streak():
     b = CircuitBreaker(key="w", failure_threshold=3)
     b.record_failure()

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# CI entrypoint: dynalint gate first (cheap, fails fast), then the tier-1
+# pytest command from ROADMAP.md.  Run from anywhere; works from repo root.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== dynalint (async-safety & JAX invariants) =="
+python -m tools.dynalint dynamo_tpu --json
+
+echo "== tier-1 tests =="
+set -o pipefail
+rm -f /tmp/_t1.log
+rc=0
+# `|| rc=$?` keeps a red test run from tripping `set -e` before the
+# pass-count summary below is printed.
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+  2>&1 | tee /tmp/_t1.log || rc=$?
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

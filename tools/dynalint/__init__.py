@@ -1,0 +1,28 @@
+"""dynalint — project-specific async-safety & JAX-invariant static analyzer.
+
+Usage: ``python -m tools.dynalint [paths] [--json]`` or, programmatically,
+:func:`analyze_paths` / :func:`analyze_sources`.  The tier-1 gate lives in
+``tests/test_dynalint.py``; the rule catalog in ``docs/dynalint.md``.
+"""
+
+from .baseline import (
+    DEFAULT_BASELINE,
+    load_baseline,
+    save_baseline,
+    split_by_baseline,
+)
+from .core import Finding, analyze_paths, analyze_sources, parse_suppressions
+from .rules import ALL_RULES, RULE_TITLES
+
+__all__ = [
+    "ALL_RULES",
+    "DEFAULT_BASELINE",
+    "Finding",
+    "RULE_TITLES",
+    "analyze_paths",
+    "analyze_sources",
+    "load_baseline",
+    "parse_suppressions",
+    "save_baseline",
+    "split_by_baseline",
+]

@@ -1,0 +1,97 @@
+"""CLI: ``python -m tools.dynalint [paths] [options]``.
+
+Exit codes: 0 = no non-baselined findings, 1 = new findings, 2 = bad usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .baseline import (
+    DEFAULT_BASELINE,
+    load_baseline,
+    save_baseline,
+    split_by_baseline,
+)
+from .core import analyze_paths
+from .report import render_json, render_rules, render_text
+from .rules import ALL_RULES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dynalint",
+        description="async-safety & JAX-invariant static analyzer for "
+        "dynamo_tpu (rules DYN001-DYN007; see docs/dynalint.md)",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        default=["dynamo_tpu"],
+        help="files or directories to analyze (default: dynamo_tpu)",
+    )
+    ap.add_argument("--json", action="store_true", help="JSON report")
+    ap.add_argument(
+        "--rules",
+        help="comma-separated rule subset (e.g. DYN001,DYN003)",
+    )
+    ap.add_argument(
+        "--baseline",
+        type=Path,
+        default=DEFAULT_BASELINE,
+        help=f"baseline file (default: {DEFAULT_BASELINE})",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline: every finding fails",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline from the current findings and exit 0",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    ap.add_argument(
+        "-v", "--verbose", action="store_true", help="also list baselined"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(render_rules())
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(ALL_RULES)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+
+    # Anchor relative paths at the repo root (parent of tools/) so the tool
+    # behaves the same from any cwd — fingerprints embed relative paths.
+    root = Path(__file__).resolve().parents[2]
+    try:
+        findings = analyze_paths(args.paths, root=root, rules=rules)
+    except FileNotFoundError as e:
+        print(f"dynalint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        save_baseline(args.baseline, findings)
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, old = split_by_baseline(findings, baseline)
+    print(render_json(new, old) if args.json else render_text(new, old, args.verbose))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+"""Baseline file: grandfathered findings that do not fail the gate.
+
+The baseline is a committed JSON file of finding fingerprints (rule + path +
+enclosing symbol + normalized snippet hash — no line numbers, so unrelated
+edits don't churn it).  New findings fail; baselined ones are reported but
+exit 0.  `--write-baseline` regenerates it from the current tree; the gate
+test additionally caps its size so the debt can only shrink.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Sequence, Tuple
+
+from .core import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def load_baseline(path: Path) -> Dict[str, Dict[str, str]]:
+    """fingerprint -> entry dict; missing file means an empty baseline."""
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def save_baseline(path: Path, findings: Sequence[Finding]) -> None:
+    entries = [
+        {
+            "fingerprint": f.fingerprint(),
+            "rule": f.rule,
+            "path": f.path,
+            "symbol": f.symbol,
+            "snippet": f.snippet,
+        }
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+    ]
+    path.write_text(
+        json.dumps(
+            {"version": BASELINE_VERSION, "findings": entries}, indent=2
+        )
+        + "\n"
+    )
+
+
+def split_by_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, Dict[str, str]]
+) -> Tuple[List[Finding], List[Finding]]:
+    """(new, grandfathered) — only `new` fails the run."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        (old if f.fingerprint() in baseline else new).append(f)
+    return new, old

@@ -1,0 +1,292 @@
+"""dynalint core: findings, suppressions, corpus index, analyzer driver.
+
+The reference Dynamo leans on rustc + clippy for its concurrency guarantees;
+this asyncio port has no borrow checker, so dynalint encodes the project's
+async-safety and JAX invariants as AST checks that run as a tier-1 gate
+(tests/test_dynalint.py) and from the CLI (``python -m tools.dynalint``).
+
+Two passes:
+
+1. **Index** every file into a :class:`CorpusIndex` — which function names
+   are (always) async, and each function's parameter names.  Cross-module
+   rules (DYN005 unawaited coroutine, DYN006 context forwarding) resolve
+   callees by name against this index rather than doing real type inference:
+   cheap, deterministic, and precise enough for a codebase with consistent
+   naming.  Ambiguity (a name defined both sync and async) disables the rule
+   for that name instead of guessing.
+2. **Check** each file with the rule visitors (rules.py), then drop findings
+   suppressed by ``# dynalint: disable=DYN00x`` comments on the offending
+   line (or ``disable-next`` on the line above).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*dynalint:\s*(disable|disable-next)\s*=\s*([A-Za-z0-9_,\s]+|all)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    symbol: str  # enclosing function qualname, or "<module>"
+    snippet: str  # stripped source of the offending line
+
+    def fingerprint(self) -> str:
+        """Stable id for baselining: survives line moves, not edits.
+
+        Line numbers are deliberately excluded so unrelated insertions above
+        a grandfathered finding don't un-baseline it; the snippet hash means
+        touching the offending line itself re-surfaces the finding.
+        """
+        raw = "|".join(
+            (self.rule, self.path, self.symbol, " ".join(self.snippet.split()))
+        )
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "symbol": self.symbol,
+            "snippet": self.snippet,
+            "fingerprint": self.fingerprint(),
+        }
+
+
+def parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    """Map line number -> set of rule ids suppressed there ("all" wildcard)."""
+    out: Dict[int, Set[str]] = {}
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        kind, spec = m.group(1), m.group(2).strip()
+        rules = (
+            {"all"}
+            if spec == "all"
+            else {r.strip().upper() for r in spec.split(",") if r.strip()}
+        )
+        target = lineno + 1 if kind == "disable-next" else lineno
+        out.setdefault(target, set()).update(rules)
+    return out
+
+
+def is_suppressed(
+    finding: Finding, suppressions: Dict[int, Set[str]]
+) -> bool:
+    rules = suppressions.get(finding.line, set())
+    return "all" in rules or finding.rule in rules
+
+
+# --------------------------------------------------------------------------
+# Corpus index (pass 1)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class FuncInfo:
+    name: str
+    is_async: bool
+    params: Tuple[str, ...]
+
+
+@dataclass
+class CorpusIndex:
+    """Name-keyed view of every function definition in the analyzed tree."""
+
+    # name -> kinds seen across the corpus ({"async"}, {"sync"}, or both)
+    kinds: Dict[str, Set[str]] = field(default_factory=dict)
+    # name -> list of parameter-name tuples (one per definition site)
+    signatures: Dict[str, List[Tuple[str, ...]]] = field(default_factory=dict)
+
+    def add(self, info: FuncInfo) -> None:
+        self.kinds.setdefault(info.name, set()).add(
+            "async" if info.is_async else "sync"
+        )
+        self.signatures.setdefault(info.name, []).append(info.params)
+
+    def always_async(self, name: str) -> bool:
+        return self.kinds.get(name) == {"async"}
+
+    def every_def_accepts(self, name: str, param: str) -> bool:
+        """True iff `name` is defined in the corpus and EVERY definition
+        takes `param` — the unanimity requirement keeps DYN006 from firing
+        on same-named helpers with different shapes."""
+        sigs = self.signatures.get(name)
+        return bool(sigs) and all(param in sig for sig in sigs)
+
+
+def _param_names(node: ast.AST) -> Tuple[str, ...]:
+    a = node.args
+    names = [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+    return tuple(n for n in names if n not in ("self", "cls"))
+
+
+def index_tree(tree: ast.AST, index: CorpusIndex) -> None:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            index.add(
+                FuncInfo(
+                    name=node.name,
+                    is_async=isinstance(node, ast.AsyncFunctionDef),
+                    params=_param_names(node),
+                )
+            )
+
+
+# --------------------------------------------------------------------------
+# Shared AST helpers
+# --------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'time.sleep' for Attribute/Name chains; None when a link is dynamic
+    (subscripts, intermediate calls) — callers then fall back to the
+    trailing attribute name alone."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_target(call: ast.Call) -> Tuple[Optional[str], Optional[str]]:
+    """(dotted, tail) for a call: dotted may be None, tail is the last
+    attribute / bare name ('create_task' for loop.create_task(...))."""
+    func = call.func
+    dotted = dotted_name(func)
+    if isinstance(func, ast.Attribute):
+        return dotted, func.attr
+    if isinstance(func, ast.Name):
+        return dotted, func.id
+    return None, None
+
+
+def iter_names(node: ast.AST) -> Iterable[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+
+
+def contains_await(node: ast.AST) -> bool:
+    """Awaits lexically inside `node`, not crossing function boundaries."""
+    return any(
+        isinstance(sub, (ast.Await, ast.AsyncFor, ast.AsyncWith))
+        for sub in _walk_same_func(node)
+    )
+
+
+def _walk_same_func(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk, but do not descend into nested function/class definitions
+    (their awaits run on someone else's schedule)."""
+    stack = [node]
+    first = True
+    while stack:
+        cur = stack.pop()
+        if not first and isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        first = False
+        yield cur
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+# --------------------------------------------------------------------------
+# Analyzer driver
+# --------------------------------------------------------------------------
+
+
+def analyze_sources(
+    sources: Sequence[Tuple[str, str]],
+    rules: Optional[Set[str]] = None,
+) -> List[Finding]:
+    """Run all (or `rules`) checks over (path, source) pairs.
+
+    Parse errors become a DYN000 finding rather than crashing the run —
+    a file the linter cannot read is a finding, not an excuse.
+    """
+    from .rules import FileChecker  # late import: rules imports core
+
+    index = CorpusIndex()
+    parsed: List[Tuple[str, str, ast.AST]] = []
+    findings: List[Finding] = []
+    for path, source in sources:
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            findings.append(
+                Finding(
+                    rule="DYN000",
+                    path=path,
+                    line=e.lineno or 1,
+                    col=e.offset or 0,
+                    message=f"file does not parse: {e.msg}",
+                    symbol="<module>",
+                    snippet="",
+                )
+            )
+            continue
+        index_tree(tree, index)
+        parsed.append((path, source, tree))
+
+    for path, source, tree in parsed:
+        checker = FileChecker(path, source, index, rules=rules)
+        raw = checker.run(tree)
+        sup = parse_suppressions(source)
+        findings.extend(f for f in raw if not is_suppressed(f, sup))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def collect_files(paths: Sequence[str], root: Path) -> List[Path]:
+    files: List[Path] = []
+    for p in paths:
+        path = (root / p) if not Path(p).is_absolute() else Path(p)
+        if path.is_dir():
+            files.extend(
+                f
+                for f in sorted(path.rglob("*.py"))
+                if "__pycache__" not in f.parts
+            )
+        elif path.is_file() and path.suffix == ".py":
+            files.append(path)
+        else:
+            # A gate that silently skips a mistyped/renamed path reports
+            # "clean" while checking nothing — fail loudly instead.
+            raise FileNotFoundError(f"no such file or directory: {path}")
+    return files
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    root: Optional[Path] = None,
+    rules: Optional[Set[str]] = None,
+) -> List[Finding]:
+    root = root or Path.cwd()
+    sources = []
+    for f in collect_files(paths, root):
+        try:
+            rel = f.relative_to(root).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        sources.append((rel, f.read_text(encoding="utf-8")))
+    return analyze_sources(sources, rules=rules)

@@ -1,0 +1,56 @@
+"""Text and JSON reporters for dynalint findings."""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import List, Sequence
+
+from .core import Finding
+from .rules import RULE_TITLES
+
+
+def render_text(
+    new: Sequence[Finding], baselined: Sequence[Finding], verbose: bool = False
+) -> str:
+    lines: List[str] = []
+    for f in new:
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message}")
+        if f.snippet:
+            lines.append(f"    {f.snippet}")
+    if verbose and baselined:
+        lines.append("")
+        lines.append("grandfathered (baseline):")
+        for f in baselined:
+            lines.append(f"  {f.path}:{f.line}: {f.rule} [{f.symbol}]")
+    counts = Counter(f.rule for f in new)
+    summary = ", ".join(f"{r}={n}" for r, n in sorted(counts.items()))
+    lines.append("")
+    if new:
+        lines.append(
+            f"dynalint: {len(new)} new finding(s) ({summary}); "
+            f"{len(baselined)} baselined"
+        )
+    else:
+        lines.append(
+            f"dynalint: clean ({len(baselined)} baselined finding(s))"
+        )
+    return "\n".join(lines)
+
+
+def render_json(
+    new: Sequence[Finding], baselined: Sequence[Finding]
+) -> str:
+    return json.dumps(
+        {
+            "new": [f.to_dict() for f in new],
+            "baselined": [f.to_dict() for f in baselined],
+            "counts": dict(Counter(f.rule for f in new)),
+            "ok": not new,
+        },
+        indent=2,
+    )
+
+
+def render_rules() -> str:
+    return "\n".join(f"{rid}  {title}" for rid, title in RULE_TITLES.items())

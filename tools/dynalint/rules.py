@@ -1,0 +1,424 @@
+"""dynalint rules DYN001–DYN007.
+
+Each rule encodes a hazard this codebase has actually exhibited (see
+docs/dynalint.md for the catalog with examples); the checker is one AST
+walk per file with a function-context stack, so rules stay cheap and share
+the async/jit scoping logic.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (
+    CorpusIndex,
+    Finding,
+    _walk_same_func,
+    call_target,
+    contains_await,
+    dotted_name,
+    iter_names,
+)
+
+ALL_RULES = (
+    "DYN001",
+    "DYN002",
+    "DYN003",
+    "DYN004",
+    "DYN005",
+    "DYN006",
+    "DYN007",
+)
+
+RULE_TITLES = {
+    "DYN001": "blocking call inside async def",
+    "DYN002": "fire-and-forget task: create_task result dropped",
+    "DYN003": "broad except in async code may swallow CancelledError",
+    "DYN004": "sync lock held across await",
+    "DYN005": "coroutine-returning call is never awaited",
+    "DYN006": "request ctx/deadline not forwarded to downstream call",
+    "DYN007": "host coercion / side effect inside a jitted function",
+}
+
+# DYN001 — calls that park the whole event loop.  Dotted names only: a bare
+# `sleep(...)` may be a local helper, but `time.sleep(...)` is unambiguous.
+BLOCKING_CALLS = {
+    "time.sleep",
+    "os.system",
+    "os.wait",
+    "os.waitpid",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "urllib.request.urlopen",
+    "requests.get",
+    "requests.post",
+    "requests.put",
+    "requests.patch",
+    "requests.delete",
+    "requests.head",
+    "requests.request",
+}
+
+# DYN002 — spawn APIs whose returned handle must be kept.
+SPAWN_TAILS = {"create_task", "ensure_future"}
+
+# DYN007 — tracer-to-host coercions and side effects inside jit.
+JIT_HOST_BUILTINS = {"float", "int", "bool", "print"}
+JIT_HOST_DOTTED = {
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+    "jax.device_get",
+    "time.time",
+    "time.perf_counter",
+}
+JIT_HOST_TAILS = {"item", "tolist"}
+
+# DYN006 — request-scoped values that must thread through the call graph.
+FORWARD_PARAMS = ("ctx", "deadline")
+
+_BROAD_NAMES = {"Exception", "BaseException"}
+
+
+def _is_broad_handler(h: ast.ExceptHandler) -> Tuple[bool, str]:
+    if h.type is None:
+        return True, "bare except:"
+    names = []
+    types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    for t in types:
+        d = dotted_name(t)
+        names.append(d or "?")
+    hit = [n for n in names if n.split(".")[-1] in _BROAD_NAMES]
+    if hit:
+        return True, f"except {', '.join(names)}:"
+    return False, ""
+
+
+def _catches_cancelled(h: ast.ExceptHandler) -> bool:
+    if h.type is None:
+        return False
+    types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    return any(
+        (dotted_name(t) or "").split(".")[-1] == "CancelledError" for t in types
+    )
+
+
+def _is_jit_decorated(node: ast.AST) -> bool:
+    """@jax.jit / @jit / @partial(jax.jit, ...) / @jax.jit(...) forms."""
+    for dec in getattr(node, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        d = dotted_name(target) or ""
+        if d.split(".")[-1] == "jit":
+            return True
+        # partial(jax.jit, ...) — jit hides in the first argument
+        if isinstance(dec, ast.Call) and d.split(".")[-1] == "partial":
+            for a in dec.args:
+                if (dotted_name(a) or "").split(".")[-1] == "jit":
+                    return True
+    return False
+
+
+def _jitted_local_names(tree: ast.AST) -> Set[str]:
+    """Names of local functions passed to jax.jit(fn, ...) call-sites —
+    engine.py builds its step functions this way rather than decorating."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func) or ""
+            if d.split(".")[-1] == "jit" and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Name):
+                    out.add(first.id)
+    return out
+
+
+class FileChecker:
+    """One-pass rule evaluation over a parsed file."""
+
+    def __init__(
+        self,
+        path: str,
+        source: str,
+        index: CorpusIndex,
+        rules: Optional[Set[str]] = None,
+    ):
+        self.path = path
+        self.lines = source.splitlines()
+        self.index = index
+        self.rules = set(rules) if rules else set(ALL_RULES)
+        self.findings: List[Finding] = []
+        # (kind, name, node) stack: kind in {"async", "sync", "class"}
+        self._stack: List[Tuple[str, str, ast.AST]] = []
+        self._jit_depth = 0
+        self._jitted_names: Set[str] = set()
+        self._cancel_scope_cache: Dict[int, bool] = {}
+
+    # ------------------------------------------------------------- plumbing
+
+    def run(self, tree: ast.AST) -> List[Finding]:
+        self._jitted_names = _jitted_local_names(tree)
+        self._visit(tree)
+        return self.findings
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        if rule not in self.rules:
+            return
+        line = getattr(node, "lineno", 1)
+        snippet = (
+            self.lines[line - 1].strip() if 0 < line <= len(self.lines) else ""
+        )
+        self.findings.append(
+            Finding(
+                rule=rule,
+                path=self.path,
+                line=line,
+                col=getattr(node, "col_offset", 0),
+                message=message,
+                symbol=self._symbol(),
+                snippet=snippet,
+            )
+        )
+
+    def _symbol(self) -> str:
+        names = [n for _, n, _ in self._stack]
+        return ".".join(names) if names else "<module>"
+
+    def _in_async(self) -> bool:
+        for kind, _, _ in reversed(self._stack):
+            if kind == "class":
+                continue
+            return kind == "async"
+        return False
+
+    def _scope_cancels_tasks(self) -> bool:
+        """Does the enclosing class (or, for free functions, the outermost
+        enclosing def) call `.cancel()` anywhere?  Marks the deliberate
+        stop()-pattern — `task.cancel(); try: await task; except
+        CancelledError: pass` — where swallowing the echo is correct."""
+        scope: Optional[ast.AST] = None
+        for kind, _, node in reversed(self._stack):
+            if kind == "class":
+                scope = node
+                break
+        if scope is None and self._stack:
+            scope = self._stack[0][2]
+        if scope is None:
+            return False
+        key = id(scope)
+        if key not in self._cancel_scope_cache:
+            self._cancel_scope_cache[key] = any(
+                isinstance(n, ast.Call) and call_target(n)[1] == "cancel"
+                for n in ast.walk(scope)
+            )
+        return self._cancel_scope_cache[key]
+
+    # ------------------------------------------------------------- traversal
+
+    def _visit(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            jitted = _is_jit_decorated(node) or node.name in self._jitted_names
+            kind = "async" if isinstance(node, ast.AsyncFunctionDef) else "sync"
+            self._stack.append((kind, node.name, node))
+            if jitted:
+                self._jit_depth += 1
+            if kind == "async":
+                self._check_function_dyn006(node)
+            for child in ast.iter_child_nodes(node):
+                self._visit(child)
+            if jitted:
+                self._jit_depth -= 1
+            self._stack.pop()
+            return
+        if isinstance(node, ast.ClassDef):
+            self._stack.append(("class", node.name, node))
+            for child in ast.iter_child_nodes(node):
+                self._visit(child)
+            self._stack.pop()
+            return
+
+        if isinstance(node, ast.Try):
+            self._check_try_dyn003(node)
+        elif isinstance(node, ast.With):
+            self._check_with_dyn004(node)
+        elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            self._check_stmt_call(node, node.value)
+        elif isinstance(node, ast.Call):
+            self._check_call(node)
+
+        # An Expr statement's Call still needs the generic Call checks
+        # (DYN001/DYN007) — visit children for every non-function node.
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+    # ------------------------------------------------------------- DYN001/7
+
+    def _check_call(self, call: ast.Call) -> None:
+        dotted, tail = call_target(call)
+        if self._in_async() and dotted in BLOCKING_CALLS:
+            self._emit(
+                "DYN001",
+                call,
+                f"blocking call `{dotted}()` inside async def "
+                f"`{self._symbol()}` stalls the event loop — use the asyncio "
+                "equivalent or `asyncio.to_thread`",
+            )
+        if self._jit_depth > 0:
+            self._check_call_dyn007(call, dotted, tail)
+
+    def _check_call_dyn007(
+        self, call: ast.Call, dotted: Optional[str], tail: Optional[str]
+    ) -> None:
+        offender = None
+        if tail in JIT_HOST_TAILS and isinstance(call.func, ast.Attribute):
+            offender = f".{tail}()"
+        elif dotted in JIT_HOST_DOTTED:
+            offender = f"{dotted}()"
+        elif (
+            dotted in JIT_HOST_BUILTINS
+            and call.args
+            and not isinstance(call.args[0], ast.Constant)
+        ):
+            offender = f"{dotted}()"
+        if offender:
+            self._emit(
+                "DYN007",
+                call,
+                f"`{offender}` inside a jitted function forces a "
+                "tracer-to-host transfer (or is a traced-away side effect) — "
+                "keep jitted code pure; coerce outside jit",
+            )
+
+    # --------------------------------------------------------------- DYN002/5
+
+    def _check_stmt_call(self, stmt: ast.Expr, call: ast.Call) -> None:
+        dotted, tail = call_target(call)
+        if tail in SPAWN_TAILS:
+            self._emit(
+                "DYN002",
+                stmt,
+                f"`{tail}()` result discarded: the task can be GC'd mid-flight "
+                "and its exception is silently dropped — store the handle "
+                "(set + done-callback discard) and cancel it on close",
+            )
+            return
+        # DYN005: bare-statement call to a function every definition of
+        # which is async — the coroutine object is created then dropped.
+        # Attribute calls only count with a `self.`/`cls.` receiver: on an
+        # arbitrary object the name likely belongs to a foreign type
+        # (task.cancel() is not our async cancel()).
+        func = call.func
+        resolvable = isinstance(func, ast.Name) or (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in ("self", "cls")
+        )
+        if (
+            resolvable
+            and tail
+            and self.index.always_async(tail)
+            and tail not in SPAWN_TAILS
+        ):
+            self._emit(
+                "DYN005",
+                stmt,
+                f"`{tail}()` returns a coroutine that is never awaited — "
+                "nothing runs; await it or wrap it in a task",
+            )
+        # DYN001/DYN007 on this call happen when _visit descends into it.
+
+    # ----------------------------------------------------------------- DYN003
+
+    def _check_try_dyn003(self, node: ast.Try) -> None:
+        if not self._in_async():
+            return
+        seen_cancelled = False
+        for h in node.handlers:
+            reraises = any(
+                isinstance(s, ast.Raise) and s.exc is None for s in h.body
+            )
+            if _catches_cancelled(h):
+                # Naming CancelledError only protects if the handler
+                # re-raises.  `except CancelledError: pass` is the hazard
+                # in its most explicit form — except in the deliberate
+                # stop()-pattern (this scope cancelled the task itself and
+                # is absorbing the echo).
+                if not reraises and not self._scope_cancels_tasks():
+                    self._emit(
+                        "DYN003",
+                        h,
+                        f"cancellation handler in async `{self._symbol()}` "
+                        "swallows CancelledError without re-raising — the "
+                        "task becomes uncancellable; add `raise`",
+                    )
+                seen_cancelled = True
+                continue
+            broad, shown = _is_broad_handler(h)
+            if not broad or seen_cancelled:
+                continue
+            # A handler that immediately re-raises swallows nothing.
+            if reraises:
+                continue
+            self._emit(
+                "DYN003",
+                h,
+                f"`{shown}` in async `{self._symbol()}` can swallow "
+                "cancellation — add `except asyncio.CancelledError: raise` "
+                "before it",
+            )
+
+    # ----------------------------------------------------------------- DYN004
+
+    def _check_with_dyn004(self, node: ast.With) -> None:
+        for item in node.items:
+            ctx = item.context_expr
+            target = ctx.func if isinstance(ctx, ast.Call) else ctx
+            d = (dotted_name(target) or "").lower()
+            if ("lock" in d or "mutex" in d) and contains_await(node):
+                self._emit(
+                    "DYN004",
+                    node,
+                    f"sync lock `{dotted_name(target)}` held across an await "
+                    "in async code: every other task blocks until this one "
+                    "resumes — use asyncio.Lock or drop the lock before "
+                    "awaiting",
+                )
+                return
+
+    # ----------------------------------------------------------------- DYN006
+
+    def _check_function_dyn006(self, fn: ast.AST) -> None:
+        from .core import _param_names
+
+        params = set(_param_names(fn))
+        carried = [p for p in FORWARD_PARAMS if p in params]
+        if not carried:
+            return
+        for sub in _walk_same_func(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            _, tail = call_target(sub)
+            if not tail or tail == fn.name:
+                continue
+            for p in carried:
+                if not self.index.every_def_accepts(tail, p):
+                    continue
+                passed = any(n == p for a in sub.args for n in iter_names(a))
+                passed = passed or any(
+                    n == p
+                    for kw in sub.keywords
+                    for n in iter_names(kw.value)
+                )
+                if not passed:
+                    self._emit(
+                        "DYN006",
+                        sub,
+                        f"`{self._symbol()}` holds request `{p}` but calls "
+                        f"`{tail}()` (which accepts `{p}`) without forwarding "
+                        "it — deadlines/cancellation stop propagating here",
+                    )
