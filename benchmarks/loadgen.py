@@ -20,6 +20,13 @@ p50/p99.  One JSON line per level on stdout; a markdown table on stderr.
 
 Env knobs for the self-hosted engine: LOADGEN_MODEL, LOADGEN_LAYERS,
 LOADGEN_MAX_BATCH, LOADGEN_DECODE_STEPS.
+
+Arrival traces (planner/sim.py JSONL format, one ``{"t","isl","osl"}`` per
+line): ``--trace poisson|burst|ramp`` generates a seedable open-loop
+arrival process and replays it against the target (``--trace-out`` saves
+the JSONL; ``--trace-file`` replays an existing one; ``--trace-only``
+emits without load).  The same files drive the planner simulator, so a
+bench trace replays in the sim and vice versa.
 """
 
 from __future__ import annotations
@@ -172,6 +179,73 @@ async def _sweep_level(url: str, model: str, conc: int, n_requests: int,
     }
 
 
+# ------------------------------------------------------------- trace mode
+async def _run_trace(url: str, model: str, arrivals, vocab: int) -> dict:
+    """Open-loop replay: request i fires at its trace timestamp (late
+    arrivals fire immediately), unlike the closed-loop concurrency sweep."""
+    indexed: List[tuple] = []
+    timeout = ClientTimeout(total=3600, sock_read=600)
+    t0 = time.perf_counter()
+
+    async def fire(i, a, session):
+        delay = a.t - (time.perf_counter() - t0)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        indexed.append(
+            (i, await _one(session, url, model,
+                           _prompt_tokens(i, a.isl, vocab), a.osl))
+        )
+
+    async with ClientSession(timeout=timeout) as session:
+        await asyncio.gather(*[fire(i, a, session) for i, a in enumerate(arrivals)])
+    wall = time.perf_counter() - t0
+
+    results = [r for _, r in sorted(indexed)]
+    ok = [r for r in results if r.error is None]
+    errors = [r.error for r in results if r.error is not None]
+    all_itls = [x for r in ok for x in r.itls_s]
+    total_tokens = sum(r.tokens for r in ok)
+    return {
+        "mode": "trace",
+        "requests": len(arrivals),
+        "ok": len(ok),
+        "errors": len(errors),
+        "error_sample": errors[0] if errors else None,
+        "wall_s": round(wall, 2),
+        "output_tok_s": round(total_tokens / wall, 2) if wall else 0.0,
+        "req_s": round(len(ok) / wall, 3) if wall else 0.0,
+        "ttft_p50_ms": round(_pct([r.ttft_s for r in ok], 0.5) * 1e3, 1),
+        "ttft_p95_ms": round(_pct([r.ttft_s for r in ok], 0.95) * 1e3, 1),
+        "ttft_p99_ms": round(_pct([r.ttft_s for r in ok], 0.99) * 1e3, 1),
+        "itl_p50_ms": round(_pct(all_itls, 0.5) * 1e3, 2),
+        "itl_p95_ms": round(_pct(all_itls, 0.95) * 1e3, 2),
+        "itl_p99_ms": round(_pct(all_itls, 0.99) * 1e3, 2),
+        "ttfts_ms": [round(r.ttft_s * 1e3, 1) for r in results if r.error is None],
+    }
+
+
+def _build_trace(args):
+    """Generate or load the arrival trace (shared planner/sim.py format)."""
+    from dynamo_tpu.planner.sim import gen_trace, read_trace, write_trace
+
+    if args.trace_file:
+        arrivals = read_trace(args.trace_file)
+    else:
+        arrivals = gen_trace(
+            args.trace,
+            rate=args.trace_rate,
+            duration_s=args.trace_duration,
+            seed=args.trace_seed,
+            isl=args.isl,
+            osl=args.osl,
+            spike_mult=args.spike_mult,
+        )
+    if args.trace_out:
+        n = write_trace(args.trace_out, arrivals)
+        print(f"loadgen: wrote {n} arrivals to {args.trace_out}", file=sys.stderr)
+    return arrivals
+
+
 # --------------------------------------------------------- self-hosted mode
 async def _self_host(args):
     """In-process aggregated deployment: TPU engine + HTTP frontend."""
@@ -276,12 +350,55 @@ async def main() -> None:
     ap.add_argument("--vocab", type=int, default=128256)
     ap.add_argument("--port", type=int, default=18723)
     ap.add_argument("--out", default=None, help="write JSON results here")
+    # Arrival-trace mode (open loop; JSONL shared with planner/sim.py)
+    ap.add_argument("--trace", default=None,
+                    choices=["poisson", "burst", "ramp"],
+                    help="generate + replay a seedable arrival trace")
+    ap.add_argument("--trace-file", default=None, dest="trace_file",
+                    help="replay an existing arrival-trace JSONL")
+    ap.add_argument("--trace-out", default=None, dest="trace_out",
+                    help="write the arrival trace here (JSONL)")
+    ap.add_argument("--trace-only", action="store_true", dest="trace_only",
+                    help="emit the trace and exit (no load)")
+    ap.add_argument("--trace-rate", type=float, default=2.0, dest="trace_rate",
+                    help="baseline arrivals/s for generated traces")
+    ap.add_argument("--trace-duration", type=float, default=60.0,
+                    dest="trace_duration")
+    ap.add_argument("--trace-seed", type=int, default=0, dest="trace_seed")
+    ap.add_argument("--spike-mult", type=float, default=3.0, dest="spike_mult",
+                    help="burst/ramp peak multiplier over --trace-rate")
     args = ap.parse_args()
+
+    trace_mode = bool(args.trace or args.trace_file)
+    arrivals = _build_trace(args) if trace_mode else None
+    if args.trace_only:
+        if not trace_mode:
+            raise SystemExit("--trace-only requires --trace or --trace-file")
+        return
 
     engine = service = None
     url, vocab = args.url, args.vocab
     if url is None:
         engine, service, url, vocab = await _self_host(args)
+
+    if trace_mode:
+        try:
+            print(
+                f"loadgen: trace replay — {len(arrivals)} arrivals over "
+                f"{arrivals[-1].t:.1f}s" if arrivals else "loadgen: empty trace",
+                file=sys.stderr,
+            )
+            row = await _run_trace(url, args.model, arrivals, vocab)
+            print(json.dumps(row), flush=True)
+            if args.out:
+                with open(args.out, "w") as f:
+                    json.dump({"mode": "trace", "rows": [row]}, f, indent=1)
+        finally:
+            if service is not None:
+                await service.close()
+            if engine is not None:
+                await engine.close()
+        return
 
     levels = [int(c) for c in args.conc.split(",")]
     rows = []

@@ -121,10 +121,19 @@ async def _run_http_frontend(args) -> None:
     mode = RouterMode(getattr(args, "router", "round_robin"))
     watcher = await ModelWatcher(runtime, service.models, router_mode=mode).start()
     await service.start()
+    # Publish the edge's rolling TTFT/ITL percentiles on the namespace's
+    # slo_metrics subject — the planner's SLO input (planner/signals.py).
+    from .planner.signals import EdgeSloPublisher
+
+    ns = RuntimeConfig.from_layers().namespace
+    slo_pub = await EdgeSloPublisher(
+        runtime.namespace(ns), service.metrics
+    ).start()
     print(f"OpenAI frontend on http://{service.host}:{service.port}", flush=True)
     try:
         await _wait_forever()
     finally:
+        await slo_pub.stop()
         await watcher.stop()
         await service.close()
         await runtime.close()
@@ -256,13 +265,26 @@ async def _run(args) -> None:
         cleanups = []
 
         if role == "prefill":
-            # Dedicated prefill worker: drains the queue; serves no endpoint.
+            # Dedicated prefill worker: drains the queue; serves no
+            # endpoint.  It still registers a lease-bound heartbeat under
+            # its endpoint path (metadata role=prefill) so the planner's
+            # SignalCollector sees prefill-pool membership and its death
+            # is observable — nothing routes to this path.
             from .llm.disagg import PrefillQueue, PrefillWorkerLoop
 
             ploop = await PrefillWorkerLoop(
                 engine, PrefillQueue(runtime.hub, args.model)
             ).start()
             cleanups.append(ploop.stop)
+            await runtime.register_key(
+                endpoint.instance_key(runtime.worker_id),
+                {
+                    "address": "",
+                    "path": endpoint.path,
+                    "worker_id": runtime.worker_id,
+                    "metadata": {"role": "prefill"},
+                },
+            )
             print(f"prefill worker draining queue for {args.model!r}", flush=True)
             try:
                 await _wait_forever()
@@ -302,7 +324,51 @@ async def _run(args) -> None:
             await stats_ep.serve_endpoint(worker.stats_handler)
             served_engine = worker
 
-        await endpoint.serve_endpoint(served_engine)
+        served = await endpoint.serve_endpoint(
+            served_engine,
+            metadata={"role": role} if role else None,
+        )
+
+        if role == "decode":
+            # Planner role flips (planner/actuate.py LocalActuator →
+            # planner/roles/{worker_id}): a decode worker can be flipped
+            # into the prefill pool — drain pending transfers, stop
+            # serving + deregister the model entry, start a queue-drain
+            # loop on the same engine (weights stay resident).
+            from .llm.disagg import PrefillQueue as _PQ
+            from .llm.disagg import PrefillWorkerLoop as _PWL
+            from .planner.actuate import RoleFlipWatcher
+
+            _decode_worker = served_engine
+
+            async def _drain_decode() -> None:
+                await _decode_worker.drain(timeout=10.0)
+                await served.stop()
+                await runtime.unregister_key(
+                    f"models/{args.model}/{runtime.worker_id}"
+                )
+
+            async def _switch_prefill() -> None:
+                ploop = await _PWL(engine, _PQ(runtime.hub, args.model)).start()
+                cleanups.append(ploop.stop)
+                await runtime.register_key(
+                    endpoint.instance_key(runtime.worker_id),
+                    {
+                        "address": "",
+                        "path": endpoint.path,
+                        "worker_id": runtime.worker_id,
+                        "metadata": {"role": "prefill"},
+                    },
+                )
+
+            flipper = await RoleFlipWatcher(
+                runtime.hub,
+                runtime.worker_id,
+                "decode",
+                drain={"decode": _drain_decode},
+                switch={"prefill": _switch_prefill},
+            ).start()
+            cleanups.append(flipper.stop)
         kv_block_size = 16
         if hasattr(engine, "set_event_callback"):  # native TPU engine
             from .llm.kv_router.publisher import KvEventPublisher, KvMetricsPublisher

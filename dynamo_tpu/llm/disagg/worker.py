@@ -58,6 +58,10 @@ class DisaggDecodeWorker(AsyncEngine):
         self.transfer_timeout = transfer_timeout
         self._pending: Dict[str, asyncio.Future] = {}
         self._covered: Dict[str, int] = {}  # per-transfer chunk accumulation
+        # Planner drain/role-flip support: while draining, no NEW remote
+        # prefills are enqueued (everything serves locally) so the pending
+        # set can only shrink.
+        self.draining = False
         self.remote_prefills = 0
         self.local_prefills = 0
         # Degraded-mode fallbacks: remote prefill abandoned (timeout, queue
@@ -127,7 +131,8 @@ class DisaggDecodeWorker(AsyncEngine):
         # Cheap local length test first; the queue-depth RPC to the hub only
         # runs for prompts that are candidates for remote prefill.
         remote = (
-            len(tokens) - prefix_hit > self.router.config.max_local_prefill_length
+            not self.draining
+            and len(tokens) - prefix_hit > self.router.config.max_local_prefill_length
         )
         if remote:
             try:
@@ -149,6 +154,21 @@ class DisaggDecodeWorker(AsyncEngine):
         else:
             self.local_prefills += 1
         return await self.engine.generate(request)
+
+    async def drain(self, timeout: float = 30.0) -> None:
+        """Quiesce remote-prefill activity (planner role flip): stop
+        enqueueing new remote prefills, give in-flight transfers
+        ``timeout`` to land, then resolve leftovers with 0 covered tokens
+        — their requests fall back to local prefill, nothing is lost."""
+        self.draining = True
+        deadline = time.perf_counter() + timeout
+        while self._pending and time.perf_counter() < deadline:
+            await asyncio.sleep(0.02)
+        for fut in list(self._pending.values()):
+            if not fut.done():
+                fut.set_result(0)
+        self._pending.clear()
+        self._covered.clear()
 
     def _degrade(self) -> None:
         self.local_prefills += 1
@@ -236,6 +256,7 @@ class PrefillWorkerLoop:
         self._task: Optional[asyncio.Task] = None
         self._clients: Dict[str, Client] = {}
         self._attempts: Dict[str, int] = {}
+        self._busy = False  # an item is between dequeue and ack/nack
         self.handled = 0
         self.dropped = 0
         self.direct_transfers = 0
@@ -267,10 +288,21 @@ class PrefillWorkerLoop:
                 pass
             self._task = None
 
+    async def drain(self, timeout: float = 30.0) -> None:
+        """Graceful stop (planner role flip): let the in-flight item
+        finish (bounded by ``timeout``), then stop pulling.  A cancel
+        that does land mid-dequeue requeues via the hub's at-least-once
+        pop path, so no request is ever lost."""
+        deadline = time.perf_counter() + timeout
+        while self._busy and time.perf_counter() < deadline:
+            await asyncio.sleep(0.02)
+        await self.stop()
+
     async def _run(self) -> None:
         try:
             while True:
                 item, token = await self.queue.dequeue()
+                self._busy = True
                 tid = item.get("transfer_id", "?")
                 try:
                     await self._handle(item)
@@ -301,6 +333,8 @@ class PrefillWorkerLoop:
                         logger.warning("prefill %s failed; requeueing", tid)
                         await self.queue.nack(token)
                         await asyncio.sleep(0.2 * attempts)
+                finally:
+                    self._busy = False
         except asyncio.CancelledError:
             pass
 

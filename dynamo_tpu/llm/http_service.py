@@ -148,9 +148,15 @@ class HttpService:
         return web.json_response({"status": "ok", "models": self.models.model_names()})
 
     async def _metrics(self, request: web.Request) -> web.Response:
-        body = self.metrics.render() + resilience_metrics.render(
-            self._metrics_prefix
-        ).encode()
+        # Planner decisions/state ride along when a planner runs in this
+        # process (module-level singleton, same pattern as resilience).
+        from ..planner.pmetrics import metrics as planner_metrics
+
+        body = (
+            self.metrics.render()
+            + resilience_metrics.render(self._metrics_prefix).encode()
+            + planner_metrics.render(self._metrics_prefix).encode()
+        )
         return web.Response(body=body, content_type="text/plain")
 
     async def _list_models(self, request: web.Request) -> web.Response:

@@ -11,7 +11,8 @@ that records duration + status when dropped.
 from __future__ import annotations
 
 import time
-from typing import Optional
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
 
 from prometheus_client import (
     CollectorRegistry,
@@ -30,6 +31,29 @@ class Status:
     CLIENT_DROP = "client_drop"
     REJECTED = "rejected"
     ERROR = "error"
+
+
+class RollingWindow:
+    """Bounded rolling sample window with percentile queries.
+
+    Histograms answer "distribution since process start"; the planner's
+    SLO loop needs "distribution right now" — a window of the most recent
+    observations, cheap to query at scrape/publish time."""
+
+    def __init__(self, maxlen: int = 2048):
+        self._xs: Deque[float] = deque(maxlen=maxlen)
+
+    def observe(self, x: float) -> None:
+        self._xs.append(x)
+
+    def percentile(self, p: float) -> float:
+        if not self._xs:
+            return 0.0
+        xs = sorted(self._xs)
+        return xs[min(len(xs) - 1, int(len(xs) * p))]
+
+    def __len__(self) -> int:
+        return len(self._xs)
 
 
 class Metrics:
@@ -75,11 +99,72 @@ class Metrics:
             ["model", "endpoint"],
             registry=self.registry,
         )
+        # Rolling-window percentile gauges (the planner's SLO input): the
+        # histograms above accumulate since start; these answer "now".
+        self.ttft_p50_gauge = Gauge(
+            f"{ns}_ttft_p50_seconds",
+            "Rolling-window TTFT p50",
+            ["model", "endpoint"],
+            registry=self.registry,
+        )
+        self.ttft_p95_gauge = Gauge(
+            f"{ns}_ttft_p95_seconds",
+            "Rolling-window TTFT p95",
+            ["model", "endpoint"],
+            registry=self.registry,
+        )
+        self.itl_p50_gauge = Gauge(
+            f"{ns}_itl_p50_seconds",
+            "Rolling-window inter-token-latency p50",
+            ["model", "endpoint"],
+            registry=self.registry,
+        )
+        self.itl_p95_gauge = Gauge(
+            f"{ns}_itl_p95_seconds",
+            "Rolling-window inter-token-latency p95",
+            ["model", "endpoint"],
+            registry=self.registry,
+        )
+        # (model, endpoint) → (ttft window, itl window)
+        self._windows: Dict[Tuple[str, str], Tuple[RollingWindow, RollingWindow]] = {}
+
+    def window(self, model: str, endpoint: str) -> Tuple[RollingWindow, RollingWindow]:
+        key = (model, endpoint)
+        if key not in self._windows:
+            self._windows[key] = (RollingWindow(), RollingWindow())
+        return self._windows[key]
 
     def guard(self, model: str, endpoint: str, request_type: str) -> "InflightGuard":
         return InflightGuard(self, model, endpoint, request_type)
 
+    def _update_quantile_gauges(self) -> None:
+        for (model, endpoint), (ttft_w, itl_w) in self._windows.items():
+            self.ttft_p50_gauge.labels(model, endpoint).set(ttft_w.percentile(0.5))
+            self.ttft_p95_gauge.labels(model, endpoint).set(ttft_w.percentile(0.95))
+            self.itl_p50_gauge.labels(model, endpoint).set(itl_w.percentile(0.5))
+            self.itl_p95_gauge.labels(model, endpoint).set(itl_w.percentile(0.95))
+
+    def edge_slo_snapshot(self) -> Dict[str, float]:
+        """Merged-over-models rolling percentiles in ms (what the edge
+        publishes to the planner on the ``slo_metrics`` subject)."""
+        ttft_all = RollingWindow(maxlen=4096)
+        itl_all = RollingWindow(maxlen=4096)
+        for ttft_w, itl_w in self._windows.values():
+            for x in ttft_w._xs:
+                ttft_all.observe(x)
+            for x in itl_w._xs:
+                itl_all.observe(x)
+        return {
+            "ttft_p50_ms": ttft_all.percentile(0.5) * 1e3,
+            "ttft_p95_ms": ttft_all.percentile(0.95) * 1e3,
+            "itl_p50_ms": itl_all.percentile(0.5) * 1e3,
+            "itl_p95_ms": itl_all.percentile(0.95) * 1e3,
+            "ttft_samples": float(len(ttft_all)),
+            "itl_samples": float(len(itl_all)),
+        }
+
     def render(self) -> bytes:
+        self._update_quantile_gauges()
         return generate_latest(self.registry)
 
 
@@ -102,10 +187,13 @@ class InflightGuard:
 
     def on_token(self, n_tokens: int = 1) -> None:
         now = time.monotonic()
+        ttft_w, itl_w = self._m.window(self.model, self.endpoint)
         if self._last_token_t is None:
             self._m.ttft.labels(self.model, self.endpoint).observe(now - self._start)
+            ttft_w.observe(now - self._start)
         else:
             self._m.itl.labels(self.model, self.endpoint).observe(now - self._last_token_t)
+            itl_w.observe(now - self._last_token_t)
         self._last_token_t = now
         self._m.output_tokens.labels(self.model, self.endpoint).inc(n_tokens)
 

@@ -24,22 +24,36 @@ from ..llm.kv_router.publisher import (
     unpack_message,
 )
 from ..llm.kv_router.scheduler import KV_HIT_RATE_SUBJECT
+from ..planner.signals import StalenessTracker, classify_instance
+from ..runtime.component import INSTANCE_PREFIX
 
 logger = logging.getLogger(__name__)
 
 
 class MetricsAggregatorService:
-    """Aggregates worker metrics + hit-rate events; serves /metrics."""
+    """Aggregates worker metrics + hit-rate events; serves /metrics.
 
-    def __init__(self, component, host: str = "0.0.0.0", port: int = 9091):
+    Rows are TTL-evicted (``StalenessTracker`` — shared with the
+    planner's SignalCollector) and dropped immediately when the worker's
+    discovery registration disappears, so ``/metrics`` never serves a
+    dead worker's last snapshot forever."""
+
+    def __init__(
+        self,
+        component,
+        host: str = "0.0.0.0",
+        port: int = 9091,
+        stale_after_s: Optional[float] = 30.0,
+    ):
         self.component = component
         self.host = host
         self.port = port
-        self._metrics: Dict[int, ForwardPassMetrics] = {}
+        self._metrics: StalenessTracker = StalenessTracker(ttl_s=stale_after_s)
         self._hit_isl_blocks = 0
         self._hit_overlap_blocks = 0
         self._tasks: List[asyncio.Task] = []
         self._subs: List = []
+        self._watcher = None
         self._runner: Optional[web.AppRunner] = None
 
     async def start(self) -> "MetricsAggregatorService":
@@ -51,6 +65,14 @@ class MetricsAggregatorService:
             loop.create_task(self._consume_metrics(m_sub)),
             loop.create_task(self._consume_hit_rate(h_sub)),
         ]
+        # Instance-gone eviction: watch the namespace's discovery prefix;
+        # a delete (lease expiry / deregistration) drops the row at once —
+        # the TTL only covers workers that die without ever registering.
+        ns = self.component.namespace.name
+        self._watcher = await self.component.runtime.hub.watch_prefix(
+            f"{INSTANCE_PREFIX}/{ns}/"
+        )
+        self._tasks.append(loop.create_task(self._consume_instances(self._watcher)))
         app = web.Application()
         app.router.add_get("/metrics", self._handle_metrics)
         self._runner = web.AppRunner(app)
@@ -70,6 +92,9 @@ class MetricsAggregatorService:
         for sub in self._subs:
             if hasattr(sub, "aclose"):
                 await sub.aclose()
+        if self._watcher is not None:
+            await self._watcher.aclose()
+            self._watcher = None
         if self._runner is not None:
             await self._runner.cleanup()
 
@@ -78,11 +103,23 @@ class MetricsAggregatorService:
             async for msg in sub:
                 payload = unpack_message(msg)
                 try:
-                    self._metrics[payload["worker_id"]] = ForwardPassMetrics.from_dict(
-                        payload["metrics"]
+                    self._metrics.put(
+                        payload["worker_id"],
+                        ForwardPassMetrics.from_dict(payload["metrics"]),
                     )
                 except (KeyError, TypeError):
                     pass
+        except asyncio.CancelledError:
+            pass
+
+    async def _consume_instances(self, watcher) -> None:
+        try:
+            async for event in watcher:
+                if event.type != "delete":
+                    continue
+                parsed = classify_instance(event.key, event.value)
+                if parsed is not None:
+                    self._metrics.pop(parsed[0])
         except asyncio.CancelledError:
             pass
 

@@ -1,0 +1,433 @@
+"""Planner signal plane: windowed, per-pool views of the metrics topics.
+
+``SignalCollector`` consumes the same namespace subjects as
+``MetricsAggregatorService`` (llm/metrics_service.py) — per-worker
+``ForwardPassMetrics`` on ``kv_metrics`` and router hit-rate events on
+``kv-hit-rate`` — plus edge-reported TTFT/ITL percentiles published by the
+HTTP frontend (``slo_metrics``), and maintains per-pool views with
+staleness eviction: a worker that stops publishing (or whose discovery
+registration disappears) drops out of the pool view instead of pinning the
+planner's picture of the fleet forever.
+
+``StalenessTracker`` is the shared eviction primitive — the metrics
+aggregator reuses it so its ``/metrics`` rows stop leaking dead workers
+(the pre-planner bug: ``_metrics`` rows outlived discovery forever).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from ..llm.kv_router.protocols import ForwardPassMetrics
+from ..llm.kv_router.publisher import KV_METRICS_TOPIC, unpack_message
+from ..llm.kv_router.scheduler import KV_HIT_RATE_SUBJECT
+from ..runtime.component import INSTANCE_PREFIX
+
+logger = logging.getLogger(__name__)
+
+# Namespace subject the HTTP edge publishes rolling TTFT/ITL percentiles on
+# (llm/metrics.py EdgeSloPublisher → planner).
+SLO_METRICS_TOPIC = "slo_metrics"
+
+
+def percentile(xs: List[float], p: float) -> float:
+    """Nearest-rank percentile (shared by the sim and collectors)."""
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * p))]
+
+
+class StalenessTracker:
+    """Dict of key → value where every entry carries a last-update stamp
+    and expires ``ttl_s`` after its last put (None = never).
+
+    Iteration (`items()`/`values()`) evicts expired entries first, so a
+    consumer that only ever reads still converges — no background task
+    required.  The clock is injectable for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        ttl_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.ttl_s = ttl_s
+        self._clock = clock
+        self._data: Dict[Any, Tuple[Any, float]] = {}
+
+    def put(self, key: Any, value: Any) -> None:
+        self._data[key] = (value, self._clock())
+
+    def get(self, key: Any, default: Any = None) -> Any:
+        entry = self._data.get(key)
+        if entry is None:
+            return default
+        if self.ttl_s is not None and self._clock() - entry[1] > self.ttl_s:
+            self._data.pop(key, None)
+            return default
+        return entry[0]
+
+    def pop(self, key: Any, default: Any = None) -> Any:
+        entry = self._data.pop(key, None)
+        return default if entry is None else entry[0]
+
+    def age(self, key: Any) -> Optional[float]:
+        entry = self._data.get(key)
+        return None if entry is None else self._clock() - entry[1]
+
+    def evict_stale(self) -> List[Any]:
+        """Drop entries older than ttl; returns the evicted keys."""
+        if self.ttl_s is None:
+            return []
+        now = self._clock()
+        dead = [k for k, (_, t) in self._data.items() if now - t > self.ttl_s]
+        for k in dead:
+            del self._data[k]
+        return dead
+
+    def items(self) -> Iterator[Tuple[Any, Any]]:
+        self.evict_stale()
+        for k, (v, _) in list(self._data.items()):
+            yield k, v
+
+    def values(self) -> Iterator[Any]:
+        for _, v in self.items():
+            yield v
+
+    def keys(self) -> Iterator[Any]:
+        for k, _ in self.items():
+            yield k
+
+    def __contains__(self, key: Any) -> bool:
+        return self.get(key, _MISSING) is not _MISSING
+
+    def __len__(self) -> int:
+        self.evict_stale()
+        return len(self._data)
+
+
+_MISSING = object()
+
+
+# ---------------------------------------------------------------- snapshots
+
+
+@dataclass
+class PoolStats:
+    """Aggregated view over one worker pool (prefill or decode)."""
+
+    workers: Tuple[int, ...] = ()
+    queue_depth: int = 0  # requests waiting at the workers
+    active_slots: int = 0
+    total_slots: int = 0
+    kv_usage: float = 0.0  # mean KV cache usage fraction
+    per_worker_load: Dict[int, float] = field(default_factory=dict)
+
+    @property
+    def size(self) -> int:
+        return len(self.workers)
+
+    def coldest_worker(self) -> Optional[int]:
+        """Deterministic flip victim: lowest load, ties to lowest id."""
+        if not self.workers:
+            return None
+        return min(
+            self.workers,
+            key=lambda w: (self.per_worker_load.get(w, 0.0), w),
+        )
+
+
+@dataclass
+class SignalSnapshot:
+    """One planner tick's input — everything the policy may read."""
+
+    t: float = 0.0
+    pools: Dict[str, PoolStats] = field(default_factory=dict)
+    ttft_p95_ms: Optional[float] = None
+    itl_p95_ms: Optional[float] = None
+    ttft_p50_ms: Optional[float] = None
+    itl_p50_ms: Optional[float] = None
+    prefill_queue_depth: int = 0
+    hit_isl_blocks: int = 0
+    hit_overlap_blocks: int = 0
+
+    def pool(self, name: str) -> PoolStats:
+        return self.pools.get(name) or PoolStats()
+
+
+def pool_stats(per_worker: Dict[int, ForwardPassMetrics]) -> PoolStats:
+    """Fold per-worker ForwardPassMetrics into one PoolStats."""
+    loads = {
+        w: (m.request_active_slots / m.request_total_slots)
+        if m.request_total_slots
+        else 0.0
+        for w, m in per_worker.items()
+    }
+    usages = [m.gpu_cache_usage_perc for m in per_worker.values()]
+    return PoolStats(
+        workers=tuple(sorted(per_worker)),
+        queue_depth=sum(m.num_requests_waiting for m in per_worker.values()),
+        active_slots=sum(m.request_active_slots for m in per_worker.values()),
+        total_slots=sum(m.request_total_slots for m in per_worker.values()),
+        kv_usage=sum(usages) / len(usages) if usages else 0.0,
+        per_worker_load=loads,
+    )
+
+
+def classify_instance(key: str, info: Any) -> Optional[Tuple[int, str]]:
+    """``instances/{ns}/{comp}/{ep}/{worker_id}`` → (worker_id, pool).
+
+    Pool = the registration's ``metadata.role`` when present, else the
+    endpoint name when it names a disagg role, else ``decode`` (an
+    aggregated worker serves both phases; the decode pool is the
+    conservative bucket for its KV/slot signals).
+    """
+    parts = key.split("/")
+    if len(parts) != 5 or parts[0] != INSTANCE_PREFIX:
+        return None
+    try:
+        worker_id = int(parts[4])
+    except ValueError:
+        return None
+    role = None
+    if isinstance(info, dict):
+        role = (info.get("metadata") or {}).get("role")
+    if not role:
+        ep = parts[3]
+        role = ep if ep in ("prefill", "decode") else "decode"
+    return worker_id, role
+
+
+# ---------------------------------------------------------------- collector
+
+
+class SignalCollector:
+    """Consume metrics/hit-rate/SLO topics into per-pool windowed views.
+
+    Construction wants the namespace-scoped ``component`` whose workers
+    publish (same as MetricsAggregatorService).  ``snapshot()`` is cheap
+    and side-effect free apart from staleness eviction and (optionally)
+    one hub queue-depth probe.
+    """
+
+    def __init__(
+        self,
+        component,
+        model: Optional[str] = None,
+        stale_after_s: float = 10.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.component = component
+        self.model = model
+        self._clock = clock
+        # worker_id → ForwardPassMetrics, TTL-evicted (same tracker the
+        # metrics aggregator uses).
+        self._metrics = StalenessTracker(ttl_s=stale_after_s, clock=clock)
+        # edge id → slo snapshot dict
+        self._edges = StalenessTracker(ttl_s=stale_after_s, clock=clock)
+        # worker_id → pool name, maintained from the discovery watch; no
+        # TTL (instance-gone events delete rows — lease expiry IS the
+        # liveness signal here, exactly like every other watcher).
+        self._pool_of: Dict[int, str] = {}
+        self._hit_isl = 0
+        self._hit_overlap = 0
+        self._tasks: List[asyncio.Task] = []
+        self._subs: List[Any] = []
+        self._watcher = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> "SignalCollector":
+        loop = asyncio.get_running_loop()
+        m_sub = await self.component.subscribe(KV_METRICS_TOPIC)
+        h_sub = await self.component.subscribe(KV_HIT_RATE_SUBJECT)
+        e_sub = await self.component.namespace.subscribe(SLO_METRICS_TOPIC)
+        self._subs = [m_sub, h_sub, e_sub]
+        ns = self.component.namespace.name
+        self._watcher = await self.component.runtime.hub.watch_prefix(
+            f"{INSTANCE_PREFIX}/{ns}/"
+        )
+        self._tasks = [
+            loop.create_task(self._consume_metrics(m_sub)),
+            loop.create_task(self._consume_hit_rate(h_sub)),
+            loop.create_task(self._consume_edges(e_sub)),
+            loop.create_task(self._consume_instances(self._watcher)),
+        ]
+        await self._watcher.synced.wait()
+        return self
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        self._tasks = []
+        for sub in self._subs:
+            if hasattr(sub, "aclose"):
+                await sub.aclose()
+        self._subs = []
+        if self._watcher is not None:
+            await self._watcher.aclose()
+            self._watcher = None
+
+    # -- consumers ---------------------------------------------------------
+
+    async def _consume_metrics(self, sub) -> None:
+        try:
+            async for msg in sub:
+                payload = unpack_message(msg)
+                try:
+                    self._metrics.put(
+                        payload["worker_id"],
+                        ForwardPassMetrics.from_dict(payload["metrics"]),
+                    )
+                except (KeyError, TypeError):
+                    logger.warning("malformed kv_metrics payload: %r", payload)
+        except asyncio.CancelledError:
+            pass
+
+    async def _consume_hit_rate(self, sub) -> None:
+        try:
+            async for msg in sub:
+                payload = unpack_message(msg)
+                try:
+                    self._hit_isl += payload["isl_blocks"]
+                    self._hit_overlap += payload["overlap_blocks"]
+                except (KeyError, TypeError):
+                    pass
+        except asyncio.CancelledError:
+            pass
+
+    async def _consume_edges(self, sub) -> None:
+        try:
+            async for msg in sub:
+                payload = unpack_message(msg)
+                if isinstance(payload, dict) and "edge_id" in payload:
+                    self._edges.put(payload["edge_id"], payload)
+        except asyncio.CancelledError:
+            pass
+
+    async def _consume_instances(self, watcher) -> None:
+        try:
+            async for event in watcher:
+                parsed = classify_instance(event.key, event.value)
+                if parsed is None:
+                    continue
+                worker_id, pool = parsed
+                if event.type == "put":
+                    self._pool_of[worker_id] = pool
+                else:  # lease expiry / deregistration: worker is GONE
+                    self._pool_of.pop(worker_id, None)
+                    self._metrics.pop(worker_id)
+        except asyncio.CancelledError:
+            pass
+
+    # -- views -------------------------------------------------------------
+
+    def evict_worker(self, worker_id: int) -> None:
+        self._pool_of.pop(worker_id, None)
+        self._metrics.pop(worker_id)
+
+    def _edge_percentile(self, key: str) -> Optional[float]:
+        """Merge the live edges' windows: worst (max) fresh percentile —
+        the conservative read when several frontends report."""
+        vals = [
+            e[key]
+            for e in self._edges.values()
+            if isinstance(e.get(key), (int, float))
+        ]
+        return max(vals) if vals else None
+
+    async def snapshot(self) -> SignalSnapshot:
+        by_pool: Dict[str, Dict[int, ForwardPassMetrics]] = {}
+        for worker_id, m in self._metrics.items():
+            pool = self._pool_of.get(worker_id, "decode")
+            by_pool.setdefault(pool, {})[worker_id] = m
+        # Discovery-known workers that have not published metrics yet still
+        # count toward pool SIZE (a just-scaled-up worker must not read as
+        # "pool shrank" while it warms up).
+        for worker_id, pool in self._pool_of.items():
+            by_pool.setdefault(pool, {}).setdefault(
+                worker_id, ForwardPassMetrics()
+            )
+        queue_depth = 0
+        if self.model is not None:
+            try:
+                queue_depth = await self.component.runtime.hub.q_len(
+                    f"prefill/{self.model}"
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — hub hiccup: signal degrades
+                logger.warning("prefill queue depth probe failed")
+        return SignalSnapshot(
+            t=self._clock(),
+            pools={p: pool_stats(w) for p, w in by_pool.items()},
+            ttft_p95_ms=self._edge_percentile("ttft_p95_ms"),
+            itl_p95_ms=self._edge_percentile("itl_p95_ms"),
+            ttft_p50_ms=self._edge_percentile("ttft_p50_ms"),
+            itl_p50_ms=self._edge_percentile("itl_p50_ms"),
+            prefill_queue_depth=queue_depth,
+            hit_isl_blocks=self._hit_isl,
+            hit_overlap_blocks=self._hit_overlap,
+        )
+
+
+class EdgeSloPublisher:
+    """HTTP-frontend side: periodically publish the edge's rolling
+    TTFT/ITL percentiles (llm/metrics.py windows) on the namespace's
+    ``slo_metrics`` subject — the planner's SLO input."""
+
+    def __init__(
+        self,
+        namespace,
+        metrics,
+        edge_id: Optional[str] = None,
+        interval: float = 2.0,
+    ):
+        self.namespace = namespace
+        self.metrics = metrics
+        self.edge_id = edge_id or f"edge-{id(self):x}"
+        self.interval = interval
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "EdgeSloPublisher":
+        self._task = asyncio.get_running_loop().create_task(self._run())
+        return self
+
+    async def publish_once(self) -> None:
+        snap = self.metrics.edge_slo_snapshot()
+        snap["edge_id"] = self.edge_id
+        await self.namespace.publish(SLO_METRICS_TOPIC, snap)
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.publish_once()
+            except asyncio.CancelledError:
+                return
+            except Exception:  # noqa: BLE001 — transient hub hiccup: the
+                # feed must survive it (a dead publisher silently disables
+                # SLO-driven scaling for the life of the frontend).
+                logger.warning("edge SLO publish failed; retrying", exc_info=True)
+            try:
+                await asyncio.sleep(self.interval)
+            except asyncio.CancelledError:
+                return
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None

@@ -102,3 +102,47 @@ class Frontend:
     @dynamo_endpoint
     async def health(self, request: Context) -> AsyncIterator[Dict]:
         yield {"ok": True}
+
+
+@service(namespace="examples")
+class PlannerService:
+    """SLA planner riding the worker graph (dynamo_tpu/planner): watches
+    the TpuWorker component's metrics topics and emits scale/flip
+    decisions — dry-run by default inside the example graph."""
+
+    def __init__(self, config: Dict[str, Any] | None = None):
+        self.config = config or {}
+        self.planner = None
+
+    @async_on_start
+    async def boot(self) -> None:
+        from dynamo_tpu.planner import (
+            DecisionEngine,
+            LocalActuator,
+            Planner,
+            PolicyConfig,
+            SignalCollector,
+            SloTargets,
+        )
+
+        component = self.runtime.namespace("examples").component("TpuWorker")
+        collector = await SignalCollector(
+            component, model=self.config.get("served_model_name")
+        ).start()
+        self._collector = collector
+        self.planner = await Planner(
+            collector,
+            DecisionEngine(
+                SloTargets.from_dict(self.config),
+                PolicyConfig.from_dict(self.config),
+            ),
+            LocalActuator(self.runtime.hub),
+            interval_s=float(self.config.get("interval_s", 2.0)),
+            dry_run=bool(self.config.get("dry_run", True)),
+        ).start()
+
+    @dynamo_endpoint
+    async def status(self, request: Context) -> AsyncIterator[Dict]:
+        from dynamo_tpu.planner import planner_metrics
+
+        yield planner_metrics.state()

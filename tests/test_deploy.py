@@ -591,3 +591,53 @@ def test_ingress_annotation_removal_counts_as_drift():
         assert "drop" not in live and live["keep"] == "1"
 
     asyncio.run(main())
+
+
+def test_planner_cr_patch_reconciles_to_new_replica_count():
+    """SLA planner actuation (planner/actuate.py KubeActuator): a
+    planner-issued CR replica patch flows through the normal reconcile
+    path and lands as the child StatefulSet's replica count."""
+    import asyncio
+
+    from dynamo_tpu.deploy.controller import FakeKube, Reconciler
+    from dynamo_tpu.planner.actuate import KubeActuator
+    from dynamo_tpu.planner.policy import Decision, scale_decode, scale_prefill
+
+    cr = _mini_cr(
+        services={
+            "hub": {"role": "hub"},
+            "prefill": {"role": "prefill", "replicas": 1},
+            "decode": {"role": "decode", "replicas": 2},
+        }
+    )
+
+    async def main():
+        kube = FakeKube()
+        rec = Reconciler(kube)
+        kube.objects[("DynamoTpuDeployment", "app")] = cr
+        await rec.run_pass()
+        assert kube.objects[("StatefulSet", "app-prefill")]["spec"]["replicas"] == 1
+
+        actuator = KubeActuator(kube, cr_name="app")
+        await actuator.apply(
+            Decision(
+                tick=9,
+                actions=[scale_prefill(2, 3, "spike"), scale_decode(1, 3, "kv")],
+                pressures={},
+            )
+        )
+        # the CR itself now carries the new targets...
+        patched = kube.objects[("DynamoTpuDeployment", "app")]
+        assert patched["spec"]["services"]["prefill"]["replicas"] == 3
+        assert patched["spec"]["services"]["decode"]["replicas"] == 3
+        # ...and the next reconcile pass drives the children to them.
+        await rec.run_pass()
+        assert kube.objects[("StatefulSet", "app-prefill")]["spec"]["replicas"] == 3
+        assert kube.objects[("StatefulSet", "app-decode")]["spec"]["replicas"] == 3
+        # FakeKube auto-readies; the CR status reflects the new fleet.
+        status = kube.objects[("DynamoTpuDeployment", "app")]["status"]
+        by_name = {s["name"]: s for s in status["services"]}
+        assert by_name["app-prefill"]["want"] == 3
+        assert status["phase"] == "Ready"
+
+    asyncio.run(main())

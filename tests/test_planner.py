@@ -1,0 +1,648 @@
+"""SLA planner (dynamo_tpu/planner): policy tables, hysteresis, signal
+staleness, actuation (kube CR patch + hub role flips), and the sim
+acceptance scenario — seeded 3× spike → bounded scale-up → SLO restored →
+clean scale-down, with dry-run emitting the identical decision stream and
+zero actuation calls."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.planner.actuate import (
+    ROLE_PREFIX,
+    KubeActuator,
+    LocalActuator,
+    RecordingActuator,
+    RoleFlipWatcher,
+)
+from dynamo_tpu.planner.policy import (
+    DECODE,
+    PREFILL,
+    Decision,
+    DecisionEngine,
+    PolicyConfig,
+    SloTargets,
+    flip_role,
+    scale_decode,
+    scale_prefill,
+)
+from dynamo_tpu.planner.signals import (
+    SLO_METRICS_TOPIC,
+    PoolStats,
+    SignalCollector,
+    SignalSnapshot,
+    StalenessTracker,
+)
+from dynamo_tpu.planner.sim import (
+    SimConfig,
+    gen_trace,
+    read_trace,
+    run_sim,
+    smoke,
+    write_trace,
+)
+
+pytestmark = pytest.mark.planner
+
+
+# ----------------------------------------------------------- snapshot maker
+
+
+def snap(
+    n_prefill=2,
+    n_decode=1,  # at min bound: a cold decode pool stays quiet by default
+    queue=0,
+    ttft=None,
+    itl=None,
+    kv=0.0,
+    decode_waiting=0,
+    prefill_util=0.0,
+    decode_loads=None,
+):
+    prefill = PoolStats(
+        workers=tuple(range(n_prefill)),
+        queue_depth=0,
+        active_slots=int(prefill_util * 1000 * n_prefill),
+        total_slots=n_prefill * 1000,
+    )
+    decode_workers = tuple(range(100, 100 + n_decode))
+    decode = PoolStats(
+        workers=decode_workers,
+        queue_depth=decode_waiting,
+        active_slots=0,
+        total_slots=n_decode * 8,
+        kv_usage=kv,
+        per_worker_load=decode_loads or {w: 0.0 for w in decode_workers},
+    )
+    return SignalSnapshot(
+        pools={PREFILL: prefill, DECODE: decode},
+        ttft_p95_ms=ttft,
+        itl_p95_ms=itl,
+        prefill_queue_depth=queue,
+    )
+
+
+def engine(**overrides):
+    cfg = dict(
+        min_prefill=1, max_prefill=8, min_decode=1, max_decode=8,
+        confirm_up_ticks=2, confirm_down_ticks=3, cooldown_ticks=4,
+        queue_high_per_worker=4.0,
+    )
+    cfg.update(overrides)
+    return DecisionEngine(SloTargets(), PolicyConfig(**cfg))
+
+
+def acts(decision: Decision):
+    return [a for a in decision.actions if a.kind != "noop"]
+
+
+# ------------------------------------------------------------- policy tables
+
+
+def test_scale_up_on_queue_growth():
+    """Sustained prefill queue growth breaches the band and scales up
+    after confirm_up_ticks — not on the first breaching tick."""
+    eng = engine()
+    hot = snap(n_prefill=2, queue=16)  # pressure 16/(4*2) = 2.0
+    first = eng.decide(hot)
+    assert first.is_noop, "acted before the breach was confirmed"
+    second = eng.decide(hot)
+    (action,) = acts(second)
+    assert action.kind == "scale_prefill" and action.delta == 1
+    assert action.target == 3
+
+
+def test_scale_up_on_ttft_slo_breach():
+    eng = engine()
+    hot = snap(n_prefill=2, ttft=5000.0)  # 2x the 2500ms default SLO
+    eng.decide(hot)
+    (action,) = acts(eng.decide(hot))
+    assert action.kind == "scale_prefill" and action.delta == 1
+
+
+def test_decode_scale_up_on_kv_pressure():
+    eng = engine()
+    hot = snap(n_decode=2, kv=0.99)  # vs (1 - 0.15 headroom) → 1.16
+    eng.decide(hot)
+    (action,) = acts(eng.decide(hot))
+    assert action.kind == "scale_decode" and action.delta == 1
+
+
+def test_cooldown_blocks_consecutive_actions():
+    """After an action the pool stays quiet for cooldown_ticks even under
+    continued confirmed pressure."""
+    eng = engine(cooldown_ticks=4)
+    hot = snap(n_prefill=2, queue=40)
+    decisions = [eng.decide(hot) for _ in range(8)]
+    action_ticks = [d.tick for d in decisions if not d.is_noop]
+    assert action_ticks[0] == 2  # confirm_up_ticks
+    assert len(action_ticks) >= 2
+    # no two actions closer than the cooldown
+    gaps = [b - a for a, b in zip(action_ticks, action_ticks[1:])]
+    assert all(g >= 4 for g in gaps), f"cooldown violated: {action_ticks}"
+
+
+def test_scale_down_requires_sustained_low_and_cooldown():
+    eng = engine(confirm_down_ticks=3)
+    cold = snap(n_prefill=4, queue=0, ttft=100.0, prefill_util=0.1)
+    d1, d2 = eng.decide(cold), eng.decide(cold)
+    assert d1.is_noop and d2.is_noop
+    (action,) = acts(eng.decide(cold))
+    assert action.kind == "scale_prefill" and action.delta == -1
+    # cooldown: the very next low tick does nothing
+    assert eng.decide(cold).is_noop
+
+
+def test_scale_down_blocked_by_utilization_guard():
+    """Latency low but the pool is busy: removing a worker would push the
+    survivors past the utilization guard — no scale-down."""
+    eng = engine(confirm_down_ticks=1)
+    busy_but_fast = snap(n_prefill=2, queue=0, ttft=100.0, prefill_util=0.6)
+    # 0.6 * 2/1 = 1.2 > 0.85 guard → blocked
+    for _ in range(6):
+        assert eng.decide(busy_but_fast).is_noop
+
+
+def test_no_oscillation_inside_hysteresis_band():
+    """Pressure bouncing between the band edges (above the down
+    threshold, below the up threshold) must produce ZERO actions."""
+    eng = engine()
+    wobble = [
+        snap(n_prefill=2, ttft=2700.0),  # ratio 1.08 < 1.15
+        snap(n_prefill=2, ttft=1600.0),  # ratio 0.64 > 0.60
+    ]
+    for i in range(40):
+        assert eng.decide(wobble[i % 2]).is_noop
+
+
+def test_bounds_respected_and_flip_at_max():
+    """At max_prefill with a cold decode pool, the engine flips the
+    coldest decode worker instead of exceeding the bound."""
+    loads = {100: 0.5, 101: 0.05, 102: 0.3}
+    eng = engine(max_prefill=2, flip_enabled=True)
+    hot = snap(
+        n_prefill=2, n_decode=3, queue=40,
+        decode_loads=loads,
+    )
+    eng.decide(hot)
+    (action,) = acts(eng.decide(hot))
+    assert action.kind == "flip_role"
+    assert action.pool == PREFILL
+    assert action.worker_id == 101  # coldest, deterministically
+    # both pools are now in cooldown
+    assert eng.decide(hot).is_noop
+
+
+def test_no_scale_down_when_flip_pushed_pool_past_max():
+    """A flip can leave a pool above its max bound.  Sustained UP pressure
+    on that pool must never emit a scale-DOWN (the clamp-to-bound bug):
+    either another flip fires or nothing does."""
+    eng = engine(max_prefill=2, cooldown_ticks=0)
+    over = snap(n_prefill=3, n_decode=1, queue=60)  # above max, still hot
+    for _ in range(6):
+        for a in acts(eng.decide(over)):
+            assert not (a.kind == "scale_prefill" and a.delta < 0), (
+                "scale-down emitted against confirmed up-pressure"
+            )
+
+
+def test_flip_blocked_while_donor_in_cooldown():
+    """A decision must never combine a scale action on a pool with a flip
+    draining the same pool — the donor must be out of cooldown."""
+    eng = engine(max_decode=1, confirm_down_ticks=2, cooldown_ticks=6)
+    # prefill cold+overprovisioned (scale-down eligible), decode hot at max
+    mixed = snap(
+        n_prefill=4, n_decode=1, queue=0, ttft=100.0,
+        prefill_util=0.05, kv=0.99,
+    )
+    for _ in range(10):
+        d = eng.decide(mixed)
+        pools_touched = [
+            p
+            for a in acts(d)
+            for p in (
+                [a.pool] if a.kind != "flip_role"
+                else [a.pool, PREFILL if a.pool == DECODE else DECODE]
+            )
+        ]
+        assert len(pools_touched) == len(set(pools_touched)), (
+            f"one decision touched a pool twice: {d.to_dict()}"
+        )
+
+
+def test_flip_disabled_means_noop_at_bound():
+    eng = engine(max_prefill=2, flip_enabled=False)
+    hot = snap(n_prefill=2, n_decode=3, queue=40)
+    eng.decide(hot)
+    assert eng.decide(hot).is_noop
+
+
+def test_min_bound_blocks_scale_down():
+    eng = engine(confirm_down_ticks=1, min_prefill=1)
+    cold = snap(n_prefill=1, queue=0, ttft=100.0, prefill_util=0.0)
+    for _ in range(5):
+        assert eng.decide(cold).is_noop
+
+
+def test_decision_engine_deterministic():
+    trace = (
+        [snap(n_prefill=1, queue=12)] * 5
+        + [snap(n_prefill=2, queue=1, ttft=300.0)] * 8
+        + [snap(n_prefill=2, ttft=6000.0)] * 5
+    )
+    a, b = engine(), engine()
+    da = [a.decide(s).to_dict() for s in trace]
+    db = [b.decide(s).to_dict() for s in trace]
+    assert da == db
+
+
+# ------------------------------------------------------------------- traces
+
+
+def test_trace_generation_deterministic(tmp_path):
+    t1 = gen_trace("burst", rate=2.0, duration_s=30.0, seed=5)
+    t2 = gen_trace("burst", rate=2.0, duration_s=30.0, seed=5)
+    t3 = gen_trace("burst", rate=2.0, duration_s=30.0, seed=6)
+    assert [a.to_dict() for a in t1] == [a.to_dict() for a in t2]
+    assert [a.to_dict() for a in t1] != [a.to_dict() for a in t3]
+    # JSONL round trip (the loadgen interchange format)
+    path = str(tmp_path / "trace.jsonl")
+    n = write_trace(path, t1)
+    assert n == len(t1)
+    back = read_trace(path)
+    assert [a.to_dict() for a in back] == [a.to_dict() for a in t1]
+
+
+def test_trace_shapes():
+    dur, rate = 90.0, 2.0
+    poisson = gen_trace("poisson", rate=rate, duration_s=dur, seed=1)
+    burst = gen_trace("burst", rate=rate, duration_s=dur, seed=1, spike_mult=3.0)
+    ramp = gen_trace("ramp", rate=rate, duration_s=dur, seed=1, spike_mult=3.0)
+    assert len(burst) > len(poisson)  # the spike adds arrivals
+    # burst concentrates arrivals in the middle third
+    mid = [a for a in burst if dur / 3 <= a.t < 2 * dur / 3]
+    assert len(mid) > len(burst) / 2
+    # ramp's second half is denser than its first
+    first = [a for a in ramp if a.t < dur / 2]
+    second = [a for a in ramp if a.t >= dur / 2]
+    assert len(second) > len(first)
+    with pytest.raises(ValueError):
+        gen_trace("sawtooth", rate=1.0, duration_s=1.0)
+
+
+# ----------------------------------------------------------- sim acceptance
+
+
+def _spike_scenario():
+    trace = gen_trace(
+        "burst", rate=1.2, duration_s=120.0, seed=7, isl=2000, osl=60
+    )
+    slo = SloTargets(ttft_p95_ms=2500.0, itl_p95_ms=200.0)
+    cfg = PolicyConfig(
+        max_prefill=6, max_decode=6, confirm_down_ticks=8,
+        queue_high_per_worker=8.0,
+    )
+    sim_cfg = SimConfig(n_prefill=1, n_decode=2)
+    return trace, slo, cfg, sim_cfg
+
+
+def test_sim_spike_acceptance():
+    """The ISSUE acceptance scenario: under a seeded 3× load spike the
+    planner scales prefill up within a bounded number of ticks, restores
+    TTFT p95 below the SLO, and scales back down afterwards with zero
+    flip-flop decisions."""
+    trace, slo, cfg, sim_cfg = _spike_scenario()
+    report = run_sim(trace, DecisionEngine(slo, cfg), sim_cfg)
+
+    ups = [a for a in report.scale_actions(PREFILL) if a.delta > 0]
+    downs = [a for a in report.scale_actions(PREFILL) if a.delta < 0]
+    assert ups, "no prefill scale-up under a 3x spike"
+    spike_onset_tick = int(120.0 / 3.0)  # burst spike starts at t/3
+    first_up = min(
+        d.tick for d in report.decisions
+        for a in d.actions if a.kind == "scale_prefill" and a.delta > 0
+    )
+    assert first_up <= spike_onset_tick + 20, (
+        f"scale-up too slow: tick {first_up}"
+    )
+    # TTFT p95 restored below the SLO after the last scale-up
+    last_up = max(
+        d.tick for d in report.decisions
+        for a in d.actions if a.kind == "scale_prefill" and a.delta > 0
+    )
+    recovered = [
+        r["ttft_p95_ms"]
+        for r in report.ticks
+        if r["tick"] > last_up and r["ttft_p95_ms"] is not None
+    ]
+    assert recovered and min(recovered) < slo.ttft_p95_ms
+    # scaled back down after the spike, and never flip-flopped
+    assert downs, "never scaled back down after the spike"
+    assert report.ticks[-1]["n_prefill"] == 1
+    assert report.flip_flops() == 0
+
+
+def test_sim_dry_run_identical_decisions_no_actuation():
+    """--dry-run: the same scenario emits the identical decision stream
+    and performs zero actuation calls."""
+    trace, slo, cfg, sim_cfg = _spike_scenario()
+    live = run_sim(trace, DecisionEngine(slo, cfg), sim_cfg)
+    dry = run_sim(trace, DecisionEngine(slo, cfg), sim_cfg, dry_run=True)
+    assert live.decision_dicts() == dry.decision_dicts()
+    assert dry.actuation_calls == 0
+    assert live.actuation_calls > 0
+
+
+def test_sim_smoke_passes():
+    """The CI smoke (tools/ci.sh runs it ahead of tier-1)."""
+    ok, summary = smoke()
+    assert ok, summary
+
+
+# -------------------------------------------------------- staleness tracker
+
+
+def test_staleness_tracker_ttl_and_iteration():
+    now = [0.0]
+    t = StalenessTracker(ttl_s=5.0, clock=lambda: now[0])
+    t.put("a", 1)
+    now[0] = 3.0
+    t.put("b", 2)
+    assert dict(t.items()) == {"a": 1, "b": 2}
+    now[0] = 6.0  # "a" is 6s old, "b" 3s
+    assert dict(t.items()) == {"b": 2}
+    assert t.get("a") is None
+    assert "b" in t and len(t) == 1
+    assert t.pop("b") == 2
+    assert len(t) == 0
+
+
+# ------------------------------------------------------------ signal plane
+
+
+@pytest.mark.asyncio
+async def test_signal_collector_pools_staleness_and_instance_gone():
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.llm.kv_router.publisher import KV_METRICS_TOPIC
+    from dynamo_tpu.runtime.component import DistributedRuntime, instance_key
+
+    rt = await DistributedRuntime.detached()
+    try:
+        component = rt.namespace("plan").component("TpuWorker")
+        now = [0.0]
+        collector = await SignalCollector(
+            component, model="m", stale_after_s=10.0, clock=lambda: now[0]
+        ).start()
+
+        # Discovery: one decode worker (metadata role), one prefill
+        # heartbeat, one legacy worker (endpoint-name fallback).
+        await rt.hub.kv_put(
+            instance_key("plan", "TpuWorker", "generate", 1),
+            {"metadata": {"role": "decode"}},
+        )
+        await rt.hub.kv_put(
+            instance_key("plan", "TpuWorker", "prefill", 2),
+            {"metadata": {"role": "prefill"}},
+        )
+        await rt.hub.kv_put(
+            instance_key("plan", "TpuWorker", "generate", 3), {}
+        )
+        # Metrics for the decode worker; edge SLO report.
+        await component.publish(
+            KV_METRICS_TOPIC,
+            {
+                "worker_id": 1,
+                "metrics": ForwardPassMetrics(
+                    request_active_slots=4,
+                    request_total_slots=8,
+                    num_requests_waiting=2,
+                    gpu_cache_usage_perc=0.5,
+                ).to_dict(),
+            },
+        )
+        await rt.namespace("plan").publish(
+            SLO_METRICS_TOPIC,
+            {"edge_id": "e1", "ttft_p95_ms": 1800.0, "itl_p95_ms": 40.0},
+        )
+        await asyncio.sleep(0.1)
+
+        s = await collector.snapshot()
+        assert s.pool("decode").workers == (1, 3)
+        assert s.pool("prefill").workers == (2,)
+        assert s.pool("decode").queue_depth == 2
+        assert s.pool("decode").kv_usage > 0  # worker 3 contributes 0
+        assert s.ttft_p95_ms == 1800.0 and s.itl_p95_ms == 40.0
+
+        # Instance-gone: deleting the discovery key evicts worker 1 from
+        # both the pool map and the metrics view.
+        await rt.hub.kv_delete(instance_key("plan", "TpuWorker", "generate", 1))
+        await asyncio.sleep(0.1)
+        s = await collector.snapshot()
+        assert s.pool("decode").workers == (3,)
+
+        # Staleness: the edge report and worker-3 registration persist,
+        # but anything metric-like ages out past the TTL.
+        now[0] = 60.0
+        s = await collector.snapshot()
+        assert s.ttft_p95_ms is None  # edge window went stale
+        await collector.stop()
+    finally:
+        await rt.close()
+
+
+@pytest.mark.asyncio
+async def test_metrics_aggregator_evicts_stale_and_gone_workers():
+    """Satellite: the /metrics aggregator no longer serves dead workers
+    forever — instance-gone evicts immediately, TTL covers the rest."""
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.llm.kv_router.publisher import KV_METRICS_TOPIC
+    from dynamo_tpu.llm.metrics_service import MetricsAggregatorService
+    from dynamo_tpu.runtime.component import DistributedRuntime, instance_key
+
+    rt = await DistributedRuntime.detached()
+    try:
+        component = rt.namespace("obs").component("worker")
+        service = await MetricsAggregatorService(
+            component, host="127.0.0.1", port=0, stale_after_s=30.0
+        ).start()
+        # Swap in a controllable clock after construction.
+        now = [0.0]
+        service._metrics._clock = lambda: now[0]
+
+        await rt.hub.kv_put(
+            instance_key("obs", "worker", "generate", 7),
+            {"metadata": {"role": "decode"}},
+        )
+        for wid in (7, 8):
+            await component.publish(
+                KV_METRICS_TOPIC,
+                {
+                    "worker_id": wid,
+                    "metrics": ForwardPassMetrics(kv_total_blocks=64).to_dict(),
+                },
+            )
+        await asyncio.sleep(0.1)
+        text = service.render()
+        assert 'worker_id="7"' in text and 'worker_id="8"' in text
+
+        # worker 7's registration disappears (lease expiry) → row evicted
+        await rt.hub.kv_delete(instance_key("obs", "worker", "generate", 7))
+        await asyncio.sleep(0.1)
+        text = service.render()
+        assert 'worker_id="7"' not in text and 'worker_id="8"' in text
+
+        # worker 8 never registered; the TTL reaps it
+        now[0] = 31.0
+        assert 'worker_id="8"' not in service.render()
+        await service.stop()
+    finally:
+        await rt.close()
+
+
+# ---------------------------------------------------------------- actuation
+
+
+@pytest.mark.asyncio
+async def test_local_actuator_role_flip_drains_then_switches():
+    from dynamo_tpu.runtime.transports.hub import InprocHub
+
+    hub = await InprocHub().start()
+    try:
+        order = []
+
+        async def drain_decode():
+            order.append("drain:decode")
+
+        async def switch_prefill():
+            order.append("switch:prefill")
+
+        flipper = await RoleFlipWatcher(
+            hub, 42, "decode",
+            drain={"decode": drain_decode},
+            switch={"prefill": switch_prefill},
+        ).start()
+        decision = Decision(
+            tick=1, actions=[flip_role(42, PREFILL)], pressures={}
+        )
+        await LocalActuator(hub).apply(decision)
+        for _ in range(50):
+            if flipper.flips:
+                break
+            await asyncio.sleep(0.02)
+        assert order == ["drain:decode", "switch:prefill"]
+        assert flipper.role == "prefill"
+        acked = await hub.kv_get(f"{ROLE_PREFIX}42")
+        assert acked["acked"] is True and acked["from"] == "decode"
+        await flipper.stop()
+    finally:
+        await hub.close()
+
+
+@pytest.mark.asyncio
+async def test_local_actuator_records_scale_targets():
+    from dynamo_tpu.planner.actuate import TARGET_PREFIX
+    from dynamo_tpu.runtime.transports.hub import InprocHub
+
+    hub = await InprocHub().start()
+    try:
+        decision = Decision(
+            tick=3,
+            actions=[scale_prefill(1, 4, "x"), scale_decode(-1, 2, "y")],
+            pressures={},
+        )
+        await LocalActuator(hub).apply(decision)
+        assert (await hub.kv_get(f"{TARGET_PREFIX}prefill"))["replicas"] == 4
+        assert (await hub.kv_get(f"{TARGET_PREFIX}decode"))["replicas"] == 2
+    finally:
+        await hub.close()
+
+
+@pytest.mark.asyncio
+async def test_disagg_decode_drain_resolves_pending():
+    """drain(): pending transfer futures resolve (0 covered) instead of
+    hanging, and new requests stop going remote."""
+    from dynamo_tpu.llm.disagg.worker import DisaggDecodeWorker
+
+    worker = DisaggDecodeWorker.__new__(DisaggDecodeWorker)
+    worker._pending = {}
+    worker._covered = {}
+    worker.draining = False
+    fut = asyncio.get_running_loop().create_future()
+    worker._pending["t1"] = fut
+    await worker.drain(timeout=0.1)
+    assert worker.draining is True
+    assert fut.done() and fut.result() == 0
+    assert not worker._pending
+
+
+@pytest.mark.asyncio
+async def test_planner_service_dry_run_never_actuates():
+    """End-to-end tick loop: dry-run counts suppressed actions; live mode
+    hits the actuator — over identical signals."""
+    from dynamo_tpu.planner import pmetrics
+    from dynamo_tpu.planner.service import Planner
+
+    class StaticCollector:
+        def __init__(self):
+            self.snaps = iter(
+                [snap(n_prefill=1, queue=20)] * 6
+            )
+
+        async def snapshot(self):
+            return next(self.snaps)
+
+    for dry in (True, False):
+        pmetrics.metrics.reset()
+        rec = RecordingActuator()
+        planner = Planner(
+            StaticCollector(), engine(), rec, dry_run=dry
+        )
+        for _ in range(4):
+            await planner.tick()
+        if dry:
+            assert rec.applied == []
+            assert pmetrics.metrics.dry_run_suppressed_total > 0
+        else:
+            assert rec.applied, "live planner never actuated"
+            assert pmetrics.metrics.actuations_total > 0
+    pmetrics.metrics.reset()
+
+
+def test_planner_metrics_render():
+    from dynamo_tpu.planner.pmetrics import PlannerMetrics
+
+    m = PlannerMetrics()
+    m.record_decision(
+        Decision(tick=1, actions=[scale_prefill(1, 3, "r")],
+                 pressures={PREFILL: 1.5, DECODE: 0.2})
+    )
+    text = m.render()
+    assert 'dynamo_tpu_planner_decisions_total{kind="scale_prefill"} 1' in text
+    assert 'dynamo_tpu_planner_pool_target{pool="prefill"} 3' in text
+    assert 'dynamo_tpu_planner_pressure{pool="prefill"} 1.5' in text
+
+
+# -------------------------------------------------------------- edge gauges
+
+
+def test_edge_rolling_percentile_gauges():
+    """Satellite: the HTTP edge exports rolling TTFT/ITL p50/p95 gauges
+    (the planner's SLO input), fed by InflightGuard.on_token."""
+    import time as _time
+
+    from dynamo_tpu.llm.metrics import Metrics
+
+    m = Metrics()
+    guard = m.guard("m1", "chat_completions", "stream")
+    guard._start = _time.monotonic() - 0.5  # pretend TTFT was 500ms
+    guard.on_token()
+    guard._last_token_t = _time.monotonic() - 0.02  # 20ms ITL
+    guard.on_token()
+    guard.finish("success")
+
+    snap_ = m.edge_slo_snapshot()
+    assert 400.0 < snap_["ttft_p95_ms"] < 700.0
+    assert 10.0 < snap_["itl_p95_ms"] < 60.0
+    text = m.render().decode()
+    assert "dynamo_tpu_http_service_ttft_p95_seconds" in text
+    assert "dynamo_tpu_http_service_itl_p50_seconds" in text

@@ -7,6 +7,9 @@ cd "$(dirname "$0")/.."
 echo "== dynalint (async-safety & JAX invariants) =="
 python -m tools.dynalint dynamo_tpu --json
 
+echo "== planner sim smoke (closed-loop acceptance, no TPU) =="
+env JAX_PLATFORMS=cpu python -m dynamo_tpu.planner sim --smoke
+
 echo "== tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
