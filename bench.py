@@ -119,6 +119,154 @@ async def _run(engine, isl: int, osl: int, n: int, vocab: int):
     return sum(counts)
 
 
+def _spec_prompts(kind: str, isl: int, n: int, vocab: int):
+    """Speculation-mode workloads.  ``repetitive``: short-period templated
+    prompts (period-8 pattern per request) — greedy decode of such traffic
+    degenerates into loops the n-gram proposer mines; ``random``: the
+    default pseudo-random prompts with per-request jittered ISL — no
+    exploitable structure, the non-regression side of the claim."""
+    prompts = []
+    for i in range(n):
+        if kind == "repetitive":
+            pattern = [(i * 131 + j * 17 + 3) % vocab for j in range(8)]
+            prompts.append((pattern * ((isl + 7) // 8))[:isl])
+        else:
+            isl_i = max(8, isl // 2 + (i * 2654435761) % isl)  # random ISL
+            prompts.append(
+                [(i * 7919 + j * 104729 + 13) % vocab for j in range(isl_i)]
+            )
+    return prompts
+
+
+async def _spec_run(engine, prompts, osl: int, temperature: float):
+    """Run one speculation-mode pass; returns (tokens, wall_s, streams)."""
+    from dynamo_tpu.llm.protocols import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context, collect
+
+    async def one(i: int, prompt):
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            stop_conditions=StopConditions(max_tokens=osl, ignore_eos=True),
+            sampling_options=SamplingOptions(
+                temperature=temperature, seed=i * 7 + 1
+            ),
+        )
+        stream = await engine.generate(Context(req.to_dict()))
+        items = await collect(stream)
+        return [t for it in items for t in it["token_ids"]]
+
+    t0 = time.perf_counter()
+    streams = await asyncio.gather(
+        *[one(i, p) for i, p in enumerate(prompts)]
+    )
+    dt = time.perf_counter() - t0
+    return sum(len(s) for s in streams), dt, streams
+
+
+def _spec_bench(cfg, model_cfg) -> None:
+    """BENCH_SPEC=1: measure draft-free speculative decoding.
+
+    Two workloads (repetitive templated prompts under greedy; random
+    prompts under seeded temperature sampling), each run spec-off then
+    spec-on with a fresh engine at otherwise identical config.  Asserts
+    token-identical streams between the modes (the exact-stream acceptance
+    claim, ON HARDWARE), then prints one JSON line: the repetitive-workload
+    speedup as the headline, the random-workload ratio (non-regression
+    bar: >= 0.97), and the acceptance-rate / tokens-per-dispatch gauges.
+    Env: BENCH_SPEC_ISL / BENCH_SPEC_OSL / BENCH_SPEC_REQUESTS /
+    BENCH_SPEC_K."""
+    import dataclasses
+
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.llm.metrics import spec_metrics
+
+    isl = int(os.environ.get("BENCH_SPEC_ISL", "128"))
+    osl = int(os.environ.get("BENCH_SPEC_OSL", "64"))
+    # Low-concurrency default: speculation trades batch rows for per-seq
+    # speed (each draft token is an extra row of the unified step), so its
+    # regime is requests << max_batch — at saturation the fused pipeline
+    # is already optimal and the engine correctly stands down.
+    n = int(
+        os.environ.get("BENCH_SPEC_REQUESTS", str(max(2, cfg.max_batch // 8)))
+    )
+    k = int(os.environ.get("BENCH_SPEC_K", "8"))
+    vocab = model_cfg.vocab_size
+    results: dict = {}
+    streams: dict = {}
+    async def one_mode(mode: str) -> None:
+        # One asyncio.run per engine: its queues/events bind to the loop.
+        cfg_m = dataclasses.replace(
+            cfg, spec_decode={"enable": mode == "on", "k": k}
+        )
+        engine = TpuEngine(cfg_m)
+        engine.warmup()
+        try:
+            for kind, temp in (("repetitive", 0.0), ("random", 0.7)):
+                spec_metrics.reset()
+                prompts = _spec_prompts(kind, isl, n, vocab)
+                # Warm pass (host paths + prefix-cache state parity), then
+                # the timed pass.
+                await _spec_run(engine, prompts, 4, temp)
+                toks, dt, out = await _spec_run(engine, prompts, osl, temp)
+                results[(kind, mode)] = toks / dt
+                streams[(kind, mode)] = out
+                snap = spec_metrics.snapshot()
+                print(
+                    f"bench[spec]: {kind}/{mode} {toks} tokens in {dt:.2f}s "
+                    f"({toks / dt:.1f} tok/s) acceptance="
+                    f"{snap['acceptance_rate']:.3f} tok/dispatch="
+                    f"{snap['tokens_per_dispatch']:.2f} "
+                    f"dispatches={int(snap['dispatches_total'])}",
+                    file=sys.stderr,
+                )
+                if kind == "repetitive":
+                    results[("acceptance", mode)] = snap["acceptance_rate"]
+                    results[("tok_per_dispatch", mode)] = snap[
+                        "tokens_per_dispatch"
+                    ]
+        finally:
+            await engine.close()
+
+    for mode in ("off", "on"):
+        asyncio.run(one_mode(mode))
+    for kind in ("repetitive", "random"):
+        if streams[(kind, "on")] != streams[(kind, "off")]:
+            raise RuntimeError(
+                f"speculation changed the {kind} token streams — the "
+                "exact-stream acceptance invariant is broken"
+            )
+    print("bench[spec]: token streams identical on/off", file=sys.stderr)
+    rep = results[("repetitive", "on")] / results[("repetitive", "off")]
+    rnd = results[("random", "on")] / results[("random", "off")]
+    print(
+        json.dumps(
+            {
+                "metric": "spec_decode_speedup_repetitive",
+                "value": round(rep, 3),
+                "unit": "x",
+                "vs_baseline": round(rep, 3),
+                "random_ratio": round(rnd, 3),
+                "repetitive_tok_s": {
+                    "off": round(results[("repetitive", "off")], 2),
+                    "on": round(results[("repetitive", "on")], 2),
+                },
+                "random_tok_s": {
+                    "off": round(results[("random", "off")], 2),
+                    "on": round(results[("random", "on")], 2),
+                },
+                "acceptance_rate": round(results[("acceptance", "on")], 4),
+                "tokens_per_dispatch": round(
+                    results[("tok_per_dispatch", "on")], 2
+                ),
+            }
+        )
+    )
+
+
 def main() -> None:
     from dynamo_tpu.engine.engine import TpuEngine
     from dynamo_tpu.models import get_config
@@ -149,6 +297,11 @@ def main() -> None:
         f"backend={jax.default_backend()}",
         file=sys.stderr,
     )
+    if os.environ.get("BENCH_SPEC"):
+        # Speculative-decoding mode: repetitive + random workloads, spec
+        # off vs on, stream-identity asserted (see _spec_bench).
+        _spec_bench(cfg, model_cfg)
+        return
     engine = TpuEngine(cfg)
 
     # Pre-compile EVERY dispatchable program (each reachable unified token

@@ -680,6 +680,27 @@ def main(argv: Optional[list] = None) -> None:
         help="decode attention backend",
     )
     p_run.add_argument(
+        "--spec-decode",
+        action="store_true",
+        default=None,
+        dest="spec_decode",
+        help="enable draft-free speculative decoding (n-gram prompt "
+        "lookup, verified in-step; engine/spec.py — token streams are "
+        "identical to non-speculative decoding)",
+    )
+    p_run.add_argument(
+        "--spec-k", type=int, default=None, dest="spec_k",
+        help="max draft tokens per sequence per dispatch",
+    )
+    p_run.add_argument(
+        "--spec-ngram-min", type=int, default=None, dest="spec_ngram_min",
+        help="shortest suffix n-gram tried by the proposer",
+    )
+    p_run.add_argument(
+        "--spec-ngram-max", type=int, default=None, dest="spec_ngram_max",
+        help="longest suffix n-gram tried by the proposer",
+    )
+    p_run.add_argument(
         "--record", default=None,
         help="capture every request/response stream to this JSONL file "
         "(replayable — runtime/recorder.py)",

@@ -2,7 +2,7 @@
 scheduler, and the jitted device step loop (SURVEY.md §7 stage 4 — the piece
 the reference outsources to vLLM/sglang)."""
 
-from .config import EngineConfig  # noqa: F401
+from .config import EngineConfig, SpecDecodeConfig  # noqa: F401
 from .kv_manager import KvBlockManager  # noqa: F401
 from .scheduler import Scheduler, SequenceState  # noqa: F401
 
@@ -73,5 +73,23 @@ def build_tpu_engine(args):
         kv_scale=getattr(args, "kv_scale", 1.0),
         checkpoint_path=getattr(args, "checkpoint", None),
         attn_impl=getattr(args, "attn_impl", "auto"),
+        spec_decode=_spec_decode_section(args),
     )
     return TpuEngine(cfg)
+
+
+def _spec_decode_section(args) -> dict:
+    """Layered spec_decode section: RuntimeConfig (file/DYN_SPEC_DECODE__*
+    env) under explicit --spec-* CLI flags."""
+    from ..runtime.config import RuntimeConfig
+
+    section = dict(RuntimeConfig.from_layers().spec_decode)
+    if getattr(args, "spec_decode", None) is not None:
+        section["enable"] = bool(args.spec_decode)
+    if getattr(args, "spec_k", None) is not None:
+        section["k"] = int(args.spec_k)
+    if getattr(args, "spec_ngram_max", None) is not None:
+        section["ngram_max"] = int(args.spec_ngram_max)
+    if getattr(args, "spec_ngram_min", None) is not None:
+        section["ngram_min"] = int(args.spec_ngram_min)
+    return section

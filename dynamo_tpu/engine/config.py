@@ -24,6 +24,84 @@ def _pow2_buckets(lo: int, hi: int) -> List[int]:
 
 
 @dataclass
+class SpecDecodeConfig:
+    """Draft-free speculative decoding (engine/spec.py).
+
+    The proposer is prompt-lookup (Saxena 2023): the last ``ngram_min..
+    ngram_max`` tokens of a sequence are matched against its own
+    prompt+output history and the continuation of the most recent match is
+    proposed as a draft.  Drafts verify through the EXISTING unified ragged
+    program — one single-token row per draft position, so per-position
+    logits and the per-(seed, step) sampler come for free — and the longest
+    prefix matching the seeded sample stream is accepted (greedy ≡ argmax
+    match; temperature>0 ≡ exactly the tokens non-speculative decoding
+    would have sampled).  Speculation on/off is token-for-token identical.
+    """
+
+    enable: bool = False
+    # Suffix n-gram lengths tried longest-first against the history.
+    ngram_min: int = 2
+    ngram_max: int = 4
+    # Draft-length ceiling per sequence per dispatch (the adaptive
+    # controller moves each sequence's k inside [k_min, k]).
+    k: int = 8
+    k_min: int = 1
+    # EWMA smoothing of per-dispatch acceptance (accepted/drafted).
+    ewma_alpha: float = 0.3
+    # Below this EWMA the sequence's proposer is benched ...
+    accept_floor: float = 0.15
+    # ... until this many more tokens have been committed, then re-probes
+    # at k_min (templated traffic often turns repetitive mid-stream).
+    cooldown_tokens: int = 64
+    # Proposer matching window: only the last ``lookback`` history tokens
+    # are scanned (0 = unlimited).  Bounds per-proposal cost at long
+    # contexts; recent history is where templated repetition lives.
+    lookback: int = 2048
+    # Engagement bar vs the fused pipeline (pure-decode plans): speculate
+    # when the expected committed tokens per round trip reach
+    # ``pipeline_margin * n_decode * decode_steps``.  A verification step
+    # streams the weights ONCE for all its rows where a fused chunk
+    # streams them ``decode_steps`` times, so a verify step costs well
+    # under half a chunk — 0.5 is conservative; raise toward 1.0 to be
+    # stricter about leaving the pipeline.
+    pipeline_margin: float = 0.5
+
+    def __post_init__(self) -> None:
+        if self.ngram_min < 1 or self.ngram_max < self.ngram_min:
+            raise ValueError(
+                f"spec_decode ngram range [{self.ngram_min}, {self.ngram_max}]"
+                " must satisfy 1 <= ngram_min <= ngram_max"
+            )
+        if self.k < 1 or self.k_min < 1 or self.k_min > self.k:
+            raise ValueError(
+                f"spec_decode k range [{self.k_min}, {self.k}] must satisfy"
+                " 1 <= k_min <= k"
+            )
+        if not 0.0 < self.ewma_alpha <= 1.0:
+            raise ValueError("spec_decode ewma_alpha must be in (0, 1]")
+        if self.pipeline_margin <= 0.0:
+            raise ValueError("spec_decode pipeline_margin must be > 0")
+
+    @classmethod
+    def normalize(cls, v: Any) -> "SpecDecodeConfig":
+        """Accept the config section in any layered-config shape: an
+        instance, a dict (file/env layers), a bare bool, or None."""
+        if v is None:
+            return cls()
+        if isinstance(v, cls):
+            return v
+        if isinstance(v, bool):
+            return cls(enable=v)
+        if isinstance(v, dict):
+            known = set(cls.__dataclass_fields__)
+            bad = set(v) - known
+            if bad:
+                raise ValueError(f"unknown spec_decode keys: {sorted(bad)}")
+            return cls(**v)
+        raise ValueError(f"bad spec_decode section: {v!r}")
+
+
+@dataclass
 class EngineConfig:
     model: str = "debug-tiny"
     block_size: int = 16
@@ -105,6 +183,10 @@ class EngineConfig:
     # (ITL p99 1.16s) tok/s; 24 takes near-peak throughput at the best
     # high-K latency.
     prefill_chunks_per_burst: int = 24
+    # Draft-free speculative decoding section (SpecDecodeConfig; accepts a
+    # dict / bool from layered configs).  Engine-level default; requests
+    # opt out per call via sampling_options.spec_decode=false (nvext).
+    spec_decode: Any = None
 
     def __post_init__(self) -> None:
         if not self.batch_buckets:
@@ -115,6 +197,7 @@ class EngineConfig:
             )
         if self.cache_dtype is None:
             self.cache_dtype = self.dtype
+        self.spec_decode = SpecDecodeConfig.normalize(self.spec_decode)
         if self.weight_quant not in (None, "int8"):
             # One check covering every load path (checkpoint / random-init /
             # externally supplied params).

@@ -63,11 +63,13 @@ logger = logging.getLogger(__name__)
 
 from .offload import HostOffloadMixin
 from .pipeline import _FINISHED, DecodePipelineMixin
+from .spec import AcceptanceController, SpecDecodeMixin
 from .transfer import KvTransferMixin, _scales_close, transfer_blocks_device  # noqa: F401 — compat re-export
 
 
 class TpuEngine(
-    KvTransferMixin, HostOffloadMixin, DecodePipelineMixin, AsyncEngine
+    KvTransferMixin, HostOffloadMixin, DecodePipelineMixin, SpecDecodeMixin,
+    AsyncEngine,
 ):
     """Token-in/token-out engine (ExecutionContext equivalent)."""
 
@@ -100,6 +102,12 @@ class TpuEngine(
             enable_prefix_caching=cfg.enable_prefix_caching,
         )
         self.scheduler = Scheduler(cfg, self.kv)
+        # Draft-free speculative decoding (engine/spec.py): None = off.
+        self._spec_ctl = (
+            AcceptanceController(cfg.spec_decode)
+            if cfg.spec_decode.enable
+            else None
+        )
         self._queues: Dict[str, asyncio.Queue] = {}
         self._contexts: Dict[str, Any] = {}
         self._wake = asyncio.Event()
@@ -854,7 +862,24 @@ class TpuEngine(
                 continue
             try:
                 did_work = False
-                if plan.pure_decode and self.cfg.decode_steps > 1:
+                # Speculation first: drafted rows verify multiple tokens
+                # per round trip on the unified ragged program (spec.py);
+                # an empty draft set (proposer misses, adaptive-k benched,
+                # or expected gain below the fused pipeline's) falls
+                # through to the fused paths unchanged.
+                drafts = (
+                    self._spec_propose(plan)
+                    if self._spec_ctl is not None
+                    else {}
+                )
+                if drafts:
+                    await self._run_spec_unified(plan, drafts)
+                    did_work = True
+                if (
+                    not did_work
+                    and plan.pure_decode
+                    and self.cfg.decode_steps > 1
+                ):
                     if self._pending_fetches:
                         # Parked rows must not sit out a whole fused
                         # pipeline run — fold them in first.

@@ -30,12 +30,24 @@ _FINISHED = object()  # queue sentinel (engine.py imports this)
 
 
 class DecodePipelineMixin:
-    def _sampling_arrays(self, seqs: List[SequenceState]) -> SamplingParams:
+    # Numpy fast path for per-chunk token acceptance (_accept_chunk); tests
+    # flip this off to prove equivalence against the scalar loop.
+    _vectorized_accept = True
+
+    def _sampling_arrays(
+        self,
+        seqs: List[SequenceState],
+        step_offsets: Optional[List[int]] = None,
+    ) -> SamplingParams:
         """Build the per-row device sampling state for this step.
 
-        The counts matrix ([S, V], penalties) is the engine's cached
-        all-zeros DEVICE buffer unless some row actually uses a penalty —
-        the common path never pays the [S, V] host→device transfer."""
+        ``seqs`` is one entry per batch ROW (a sequence may own several
+        rows in a speculative verification step; ``step_offsets[i]`` then
+        shifts row i's rng-stream position to the output index it scores —
+        engine/spec.py).  The counts matrix ([S, V], penalties) is the
+        engine's cached all-zeros DEVICE buffer unless some row actually
+        uses a penalty — the common path never pays the [S, V]
+        host→device transfer."""
         S = self.cfg.max_batch
         V = self.model_config.vocab_size
         seeds = np.zeros((S,), np.uint32)
@@ -49,7 +61,9 @@ class DecodePipelineMixin:
         any_pen = False
         for i, seq in enumerate(seqs):
             seeds[i] = seq.sampling_seed
-            steps[i] = seq.num_output_tokens
+            steps[i] = seq.num_output_tokens + (
+                step_offsets[i] if step_offsets is not None else 0
+            )
             temp[i] = seq.sampling_temperature
             topk[i] = seq.sampling_top_k
             topp[i] = seq.sampling_top_p
@@ -241,38 +255,14 @@ class DecodePipelineMixin:
                         int(sampled[i]),
                         logprobs=self._lp_info(seq, i, logp, top_ids, top_lp),
                     )
+            elif kind == "spec":  # speculative verification (engine/spec.py)
+                self._harvest_spec(entry, sampled, logp, top_ids, top_lp)
             else:  # burst
                 members, pos0 = entry[2], entry[3]
-                bs = self.cfg.block_size
                 finished: List[SequenceState] = []
-                for t in range(sampled.shape[0]):
-                    for i, seq in enumerate(members):
-                        seq.awaiting_fetch = False
-                        if seq.finished or pos0[i] < 0:
-                            continue
-                        if seq.num_computed != pos0[i] + t:
-                            continue  # stopped earlier in this burst
-                        if seq.num_computed >= len(seq.block_ids) * bs:
-                            continue  # beyond allocation: never KV-backed
-                        fed = (seq.prompt + seq.output)[seq.num_computed]
-                        if seq.num_computed >= len(seq.prompt):
-                            seq.block_seq.append(fed)
-                        seq.num_computed += 1
-                        self._seal_completed_blocks(seq)
-                        self._accept_token(
-                            seq,
-                            int(sampled[t, i]),
-                            defer_removal=True,
-                            logprobs=self._lp_info(
-                                seq,
-                                i,
-                                None if logp is None else logp[t],
-                                None if top_ids is None else top_ids[t],
-                                None if top_lp is None else top_lp[t],
-                            ),
-                        )
-                        if seq.finished:
-                            finished.append(seq)
+                self._accept_chunk(
+                    members, pos0, sampled, logp, top_ids, top_lp, finished
+                )
                 for seq in finished:
                     self.scheduler.remove(seq)
             if not all_pending:
@@ -455,34 +445,14 @@ class DecodePipelineMixin:
                 # so this wall is dominated by the chunk's device compute.
                 ("decode_wait", time.perf_counter() - t0, n, n * T)
             )
-            for t in range(T):
-                for i, seq in enumerate(members):
-                    if seq.finished or pos0[i] < 0:
-                        continue
-                    if seq.num_computed != pos0[i] + t:
-                        continue  # stopped earlier in this chunk
-                    limit = len(seq.block_ids) * bs
-                    if seq.num_computed >= limit:
-                        continue  # beyond allocation: token was never KV-backed
-                    fed = (seq.prompt + seq.output)[seq.num_computed]
-                    if seq.num_computed >= len(seq.prompt):
-                        seq.block_seq.append(fed)
-                    seq.num_computed += 1
-                    self._seal_completed_blocks(seq)
-                    self._accept_token(
-                        seq,
-                        int(sampled[t, i]),
-                        defer_removal=True,
-                        logprobs=self._lp_info(
-                            seq,
-                            i,
-                            None if logp is None else logp[t],
-                            None if top_ids is None else top_ids[t],
-                            None if top_lp is None else top_lp[t],
-                        ),
-                    )
-                    if seq.finished:
-                        finished_members.append(seq)
+            self._accept_chunk(
+                members, pos0, sampled, logp, top_ids, top_lp, finished_members
+            )
+            if not rebuild and self._spec_session_probe(members):
+                # Output grew repetitive enough that in-step speculation
+                # now beats the fused chunks: drain and let schedule()
+                # re-propose for real (engine/spec.py).
+                rebuild = True
             if want_rebuild():
                 rebuild = True
             if rebuild and not inflight:
@@ -602,6 +572,131 @@ class DecodePipelineMixin:
                 tb.sequence_hash
             ):
                 self._offload_queue.append((seq.block_ids[idx], tb))
+
+    def _accept_chunk(
+        self,
+        members: List[SequenceState],
+        pos0: np.ndarray,
+        sampled: np.ndarray,  # [T, S]
+        logp,
+        top_ids,
+        top_lp,
+        finished: List[SequenceState],
+    ) -> None:
+        """Apply one fused chunk's sampled tokens to ``members``.
+
+        Fast path: a row without logprobs computes its whole accept run
+        with numpy mask math (allocation wall, LENGTH cutoffs, stop
+        tokens under min_new_tokens) and emits ONE multi-token queue item
+        — the scalar ``for t: for seq`` loop was the dominant term of the
+        r5 16% host gap at batch 256.  Rows needing per-token logprob
+        payloads (and engines with ``_vectorized_accept=False``, the
+        test toggle) take the scalar row loop; both paths produce
+        identical streams (tests/test_spec_decode.py asserts it)."""
+        T = int(sampled.shape[0])
+        bs = self.cfg.block_size
+        for i, seq in enumerate(members):
+            seq.awaiting_fetch = False
+            if seq.finished or pos0[i] < 0:
+                continue
+            p0 = int(pos0[i])
+            if seq.num_computed != p0:
+                continue  # stopped/hit the allocation wall in a prior chunk
+            if not self._vectorized_accept or seq.logprobs is not None:
+                self._accept_chunk_row_scalar(
+                    seq, i, p0, sampled, logp, top_ids, top_lp, finished
+                )
+                continue
+            n_cap = min(T, len(seq.block_ids) * bs - p0)
+            if n_cap <= 0:
+                continue  # beyond allocation: tokens were never KV-backed
+            col = np.asarray(sampled[:, i])
+            # LENGTH cutoff: the token that reaches the budget is accepted
+            # (and emitted) with finish_reason length, exactly as
+            # _check_stop does after each append.
+            m_len = self.cfg.max_model_len - seq.total_tokens
+            if seq.max_new_tokens is not None:
+                m_len = min(
+                    m_len, seq.max_new_tokens - seq.num_output_tokens
+                )
+            m_len = max(1, m_len)
+            if m_len <= n_cap:
+                n_acc, reason = m_len, FinishReason.LENGTH
+            else:
+                n_acc, reason = n_cap, None
+            stops = set(seq.stop_token_ids)
+            if not seq.ignore_eos:
+                stops |= set(self.model_config.eos_token_ids)
+            if stops:
+                hit = np.isin(col, np.fromiter(stops, np.int64))
+                if seq.min_new_tokens is not None:
+                    # Token m (1-based) lands at output index n_out + m.
+                    hit &= (
+                        seq.num_output_tokens + 1 + np.arange(T)
+                    ) >= seq.min_new_tokens
+                idx = np.nonzero(hit)[0]
+                if idx.size and int(idx[0]) + 1 <= n_acc:
+                    # STOP wins ties with LENGTH (stop checks run first).
+                    n_acc, reason = int(idx[0]) + 1, FinishReason.STOP
+            # Fed tokens: the committed tail + each previously sampled
+            # token — members are decoding, so all join the hash stream.
+            fed = [(seq.prompt + seq.output)[p0]] + [
+                int(x) for x in col[: n_acc - 1]
+            ]
+            seq.block_seq.extend(fed)
+            seq.num_computed += n_acc
+            self._seal_completed_blocks(seq)
+            toks = [int(x) for x in col[:n_acc]]
+            seq.output.extend(toks)
+            emit = toks[:-1] if reason is FinishReason.STOP else toks
+            queue = self._queues.get(seq.request_id)
+            if queue is not None and emit:
+                queue.put_nowait(LLMEngineOutput.tokens(emit))
+            if reason is not None:
+                seq.finished = True
+                finished.append(seq)
+                self._finish(seq, reason)
+
+    def _accept_chunk_row_scalar(
+        self,
+        seq: SequenceState,
+        i: int,
+        p0: int,
+        sampled: np.ndarray,
+        logp,
+        top_ids,
+        top_lp,
+        finished: List[SequenceState],
+    ) -> None:
+        """Reference per-token accept loop for one row (logprob payloads
+        are per token; also the oracle the vectorized path is tested
+        against)."""
+        bs = self.cfg.block_size
+        for t in range(sampled.shape[0]):
+            if seq.num_computed != p0 + t:
+                continue  # stopped earlier in this chunk
+            if seq.num_computed >= len(seq.block_ids) * bs:
+                continue  # beyond allocation: token was never KV-backed
+            fed = (seq.prompt + seq.output)[seq.num_computed]
+            if seq.num_computed >= len(seq.prompt):
+                seq.block_seq.append(fed)
+            seq.num_computed += 1
+            self._seal_completed_blocks(seq)
+            self._accept_token(
+                seq,
+                int(sampled[t, i]),
+                defer_removal=True,
+                logprobs=self._lp_info(
+                    seq,
+                    i,
+                    None if logp is None else logp[t],
+                    None if top_ids is None else top_ids[t],
+                    None if top_lp is None else top_lp[t],
+                ),
+            )
+            if seq.finished:
+                finished.append(seq)
+                break
 
     def _lp_info(
         self, seq: SequenceState, i: int, logp, top_ids, top_lp

@@ -112,9 +112,11 @@ class KvTransferMixin:
         if n == 0:
             return 0
         blocks = blocks[:n]
-        alloc = self.kv.allocate_sequence(blocks, n)
-        if alloc is None:
-            return 0  # no capacity; caller falls back to local prefill
+        # Validate the payload BEFORE allocating: allocation can LRU-evict
+        # sealed prefix-cache blocks, and an import that is about to be
+        # rejected must never pay that eviction for blocks it frees right
+        # back (the freed blocks return anonymous — the evicted contents
+        # are gone for nothing).
         if int(payload.get("block_size", self.cfg.block_size)) != self.cfg.block_size:
             # Mismatched layouts would seal misaligned KV under valid hashes
             # — refuse and let the caller prefill locally.
@@ -123,7 +125,6 @@ class KvTransferMixin:
                 payload.get("block_size"),
                 self.cfg.block_size,
             )
-            self.kv.free_sequence(alloc[0])
             return 0
         local_scale = self._kv_scale_repr()
         if (
@@ -140,8 +141,10 @@ class KvTransferMixin:
                 payload.get("dtype"), payload.get("kv_scale"),
                 jnp.dtype(self.cfg.cache_dtype), local_scale,
             )
-            self.kv.free_sequence(alloc[0])
             return 0
+        alloc = self.kv.allocate_sequence(blocks, n)
+        if alloc is None:
+            return 0  # no capacity; caller falls back to local prefill
         ids, cached = alloc
         shape = tuple(payload["shape"])
         name = payload["dtype"]

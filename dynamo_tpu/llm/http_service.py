@@ -149,13 +149,17 @@ class HttpService:
 
     async def _metrics(self, request: web.Request) -> web.Response:
         # Planner decisions/state ride along when a planner runs in this
-        # process (module-level singleton, same pattern as resilience).
+        # process (module-level singleton, same pattern as resilience), as
+        # do the engine's speculative-decoding gauges when the engine is
+        # colocated (llm/metrics.py spec_metrics).
         from ..planner.pmetrics import metrics as planner_metrics
+        from .metrics import spec_metrics
 
         body = (
             self.metrics.render()
             + resilience_metrics.render(self._metrics_prefix).encode()
             + planner_metrics.render(self._metrics_prefix).encode()
+            + spec_metrics.render(self._metrics_prefix).encode()
         )
         return web.Response(body=body, content_type="text/plain")
 

@@ -28,6 +28,10 @@ class NvExt(BaseModel):
     use_raw_prompt: Optional[bool] = None
     annotations: Optional[List[str]] = None
     greed_sampling: Optional[bool] = None
+    # Per-request speculative-decoding opt-out (false disables the engine's
+    # draft-free speculation for this request; tokens are identical either
+    # way — the knob shapes latency granularity and enables A/B runs).
+    spec_decode: Optional[bool] = None
 
 
 class ChatMessage(BaseModel):
@@ -80,6 +84,7 @@ class CommonFields(BaseModel):
             frequency_penalty=self.frequency_penalty,
             presence_penalty=self.presence_penalty,
             seed=self.seed,
+            spec_decode=self.nvext.spec_decode if self.nvext else None,
         )
 
 

@@ -69,6 +69,12 @@ class SamplingOptions:
     seed: Optional[int] = None
     # None = no logprobs; 0 = chosen-token only; N = chosen + top-N
     logprobs: Optional[int] = None
+    # Speculative decoding opt-out (nvext.spec_decode): False disables the
+    # engine's draft-free speculation for THIS request; None/True defer to
+    # the engine's spec_decode config.  Output tokens are identical either
+    # way (engine/spec.py exact-stream acceptance) — the knob exists for
+    # latency-shape control and for A/B measurement.
+    spec_decode: Optional[bool] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -79,6 +85,7 @@ class SamplingOptions:
             "presence_penalty": self.presence_penalty,
             "seed": self.seed,
             "logprobs": self.logprobs,
+            "spec_decode": self.spec_decode,
         }
 
     @classmethod
@@ -91,6 +98,7 @@ class SamplingOptions:
             presence_penalty=d.get("presence_penalty"),
             seed=d.get("seed"),
             logprobs=d.get("logprobs"),
+            spec_decode=d.get("spec_decode"),
         )
 
 
@@ -143,6 +151,12 @@ class LLMEngineOutput:
     @staticmethod
     def token(token_id: int) -> Dict[str, Any]:
         return {"token_ids": [token_id], "text": None, "finish_reason": None}
+
+    @staticmethod
+    def tokens(token_ids: List[int]) -> Dict[str, Any]:
+        """Multi-token step output (fused-chunk fast path; consumers
+        iterate ``token_ids``, so granularity is an engine choice)."""
+        return {"token_ids": list(token_ids), "text": None, "finish_reason": None}
 
     @staticmethod
     def finished(reason: FinishReason, usage: Optional[Dict[str, int]] = None) -> Dict[str, Any]:

@@ -14,7 +14,14 @@ format directly:
                  5=UNUSED 6=BYTE)
   TrainerSpec:   field 3 model_type (1=UNIGRAM 2=BPE), fields 40-42,45
                  unk/bos/eos/pad ids
-  NormalizerSpec: field 3 add_dummy_prefix, field 5 escape_whitespaces
+  NormalizerSpec: field 2 precompiled_charsmap, field 3 add_dummy_prefix,
+                 field 5 escape_whitespaces
+
+Models whose NormalizerSpec carries a non-empty ``precompiled_charsmap``
+(an NFKC-style normalization automaton this module does not execute) or
+``escape_whitespaces=false`` (spaces are NOT ▁-escaped) are REFUSED at
+parse time rather than silently mis-tokenized — serving a model through
+the wrong normalizer corrupts every prompt.
 
 Encoding implements both algorithms over the piece vocabulary:
 - **unigram**: Viterbi segmentation maximizing the sum of piece scores;
@@ -76,6 +83,8 @@ class SentencePieceModel:
         self.model_type = 1  # UNIGRAM default
         self.unk_id, self.bos_id, self.eos_id = 0, 1, 2
         self.add_dummy_prefix = True
+        self.escape_whitespaces = True
+        self.precompiled_charsmap = b""
         for field, wire, val, _ in _walk(blob, 0, len(blob)):
             if field == 1 and wire == 2:  # SentencePiece
                 piece, score, typ = "", 0.0, NORMAL
@@ -101,10 +110,30 @@ class SentencePieceModel:
                         self.eos_id = v2
             elif field == 3 and wire == 2:  # NormalizerSpec
                 for f2, w2, v2, _ in _walk(val, 0, len(val)):
-                    if f2 == 3 and w2 == 0:
+                    if f2 == 2 and w2 == 2:
+                        self.precompiled_charsmap = v2
+                    elif f2 == 3 and w2 == 0:
                         self.add_dummy_prefix = bool(v2)
+                    elif f2 == 5 and w2 == 0:
+                        self.escape_whitespaces = bool(v2)
         if not self.pieces:
             raise ValueError("tokenizer.model contains no sentencepiece vocab")
+        if self.precompiled_charsmap:
+            # e.g. T5/ALBERT-style NFKC models.  Tokenizing without running
+            # the automaton silently diverges from the training-time
+            # normalization; refuse rather than serve a wrong tokenizer.
+            raise ValueError(
+                "tokenizer.model carries a non-empty NormalizerSpec."
+                "precompiled_charsmap (normalization automaton) which this "
+                "parser does not execute — refusing to mis-tokenize; use a "
+                "tokenizer.json for this model instead"
+            )
+        if not self.escape_whitespaces:
+            raise ValueError(
+                "tokenizer.model sets NormalizerSpec.escape_whitespaces="
+                "false; this parser assumes ▁-escaped whitespace — "
+                "refusing to mis-tokenize"
+            )
         self.index: Dict[str, int] = {p: i for i, p in enumerate(self.pieces)}
         self._byte_ids: Dict[int, int] = {}
         for i, (p, t) in enumerate(zip(self.pieces, self.types)):
@@ -315,6 +344,8 @@ def build_model_proto(
     unk_id: int = 0,
     bos_id: int = 1,
     eos_id: int = 2,
+    escape_whitespaces: bool = True,
+    precompiled_charsmap: bytes = b"",
 ) -> bytes:
     """Serialize a minimal ModelProto — the test-fixture writer (building a
     real .model without the sentencepiece library), kept next to the parser
@@ -346,5 +377,10 @@ def build_model_proto(
     )
     blob += field(2, 2, varint(len(trainer)) + trainer)
     norm = field(3, 0, varint(1 if add_dummy_prefix else 0))
+    norm += field(5, 0, varint(1 if escape_whitespaces else 0))
+    if precompiled_charsmap:
+        norm += field(
+            2, 2, varint(len(precompiled_charsmap)) + precompiled_charsmap
+        )
     blob += field(3, 2, varint(len(norm)) + norm)
     return blob

@@ -70,7 +70,12 @@ def quantize_array_np(w: np.ndarray, axis: int) -> Tuple[np.ndarray, np.ndarray]
     wf = np.asarray(w, np.float32)
     amax = np.max(np.abs(wf), axis=axis)
     scale = np.maximum(amax / 127.0, 1e-12).astype(np.float32)
-    q = np.rint(wf / np.expand_dims(scale, axis)).astype(np.int8)
+    # Clip before the int8 cast (matching ops/quant_matmul.quantize_rows):
+    # rint(w/s) can land on ±127.0000x in float32 even though |w| <= amax
+    # exactly, and an unclipped cast would wrap +127.x to -128.
+    q = np.clip(
+        np.rint(wf / np.expand_dims(scale, axis)), -127, 127
+    ).astype(np.int8)
     return q, scale
 
 
@@ -116,7 +121,11 @@ def _quantize_jnp(w, axis: int):
     wf = w.astype(jnp.float32)
     amax = jnp.max(jnp.abs(wf), axis=axis)
     scale = jnp.maximum(amax / 127.0, 1e-12).astype(jnp.float32)
-    q = jnp.round(wf / jnp.expand_dims(scale, axis)).astype(jnp.int8)
+    # Same clip-before-cast as quantize_array_np / quantize_rows: float32
+    # round-off at exactly ±127 must not wrap to -128.
+    q = jnp.clip(
+        jnp.round(wf / jnp.expand_dims(scale, axis)), -127, 127
+    ).astype(jnp.int8)
     return q, scale
 
 

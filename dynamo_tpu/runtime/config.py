@@ -93,6 +93,12 @@ class RuntimeConfig:
     # min/max_decode, band_up/band_down, confirm/cooldown ticks).  Nested
     # env works: ``DYN_PLANNER__TTFT_P95_MS=1500``.
     planner: Dict[str, Any] = field(default_factory=dict)
+    # Draft-free speculative decoding defaults (engine/config.py
+    # SpecDecodeConfig keys: enable, ngram_min, ngram_max, k, k_min,
+    # ewma_alpha, accept_floor, cooldown_tokens).  The CLI engine builder
+    # merges this section under any explicit --spec-* flags; nested env
+    # works: ``DYN_SPEC_DECODE__ENABLE=true``, ``DYN_SPEC_DECODE__K=8``.
+    spec_decode: Dict[str, Any] = field(default_factory=dict)
     extra: Dict[str, Any] = field(default_factory=dict)  # unrecognized keys
 
     @classmethod

@@ -353,3 +353,44 @@ async def test_decode_overlaps_chunked_import():
 
     await src.close()
     await dst.close()
+
+
+@pytest.mark.asyncio
+async def test_rejected_import_never_evicts_sealed_blocks():
+    """inject_blocks validates block_size/dtype/kv_scale BEFORE allocating:
+    a rejected import must not LRU-evict sealed prefix-cache blocks for an
+    allocation it frees right back (the evicted contents would be lost for
+    nothing)."""
+    cfg = dict(CFG)
+    cfg["num_blocks"] = 8  # tiny pool: any allocation must evict
+    eng = TpuEngine(EngineConfig(**cfg))
+    donor = TpuEngine(EngineConfig(**CFG))
+    try:
+        resident = list(range(1, 17))  # 4 full blocks sealed + reusable
+        stream = await eng.generate(Context(_req(resident, max_tokens=2)))
+        await collect(stream)
+        hit_before = eng.estimate_prefix_hit(resident)
+        assert hit_before >= 12
+
+        other = list(range(100, 124))  # 6 blocks: import would need eviction
+        stream = await donor.generate(Context(_req(other, max_tokens=2)))
+        await collect(stream)
+        payload = await donor.export_prompt_blocks(other)
+        assert payload is not None
+
+        # Invalid layout: block_size mismatch must reject BEFORE touching
+        # the pool.
+        payload_bad = dict(payload, block_size=8)
+        assert await eng.inject_blocks(other, payload_bad) == 0
+        assert eng.estimate_prefix_hit(resident) == hit_before
+        # Invalid stored representation (dtype) — same guarantee.
+        payload_bad = dict(payload, dtype="int8")
+        assert await eng.inject_blocks(other, payload_bad) == 0
+        assert eng.estimate_prefix_hit(resident) == hit_before
+        # Mismatched kv_scale — same guarantee.
+        payload_bad = dict(payload, kv_scale=123.0)
+        assert await eng.inject_blocks(other, payload_bad) == 0
+        assert eng.estimate_prefix_hit(resident) == hit_before
+    finally:
+        await eng.close()
+        await donor.close()

@@ -269,3 +269,78 @@ def test_cli_tokenizer_flag_routes_model_file(tmp_path):
 
     spec = _tokenizer_spec(Namespace(tokenizer="/x/tokenizer.model"))
     assert spec == {"kind": "sp", "file": "/x/tokenizer.model"}
+
+
+def test_normalizer_precompiled_charsmap_refused():
+    """A non-empty precompiled_charsmap (NFKC automaton) must be refused —
+    tokenizing without running it silently diverges from training."""
+    pieces = [("<unk>", 0.0, UNKNOWN), ("▁a", -1.0, NORMAL)]
+    blob = build_model_proto(pieces, precompiled_charsmap=b"\x01\x02\x03")
+    with pytest.raises(ValueError, match="precompiled_charsmap"):
+        SentencePieceModel(blob)
+    # An ABSENT / empty charsmap stays accepted (identity normalizers).
+    SentencePieceModel(build_model_proto(pieces))
+
+
+def test_normalizer_unescaped_whitespace_refused():
+    pieces = [("<unk>", 0.0, UNKNOWN), ("▁a", -1.0, NORMAL)]
+    blob = build_model_proto(pieces, escape_whitespaces=False)
+    with pytest.raises(ValueError, match="escape_whitespaces"):
+        SentencePieceModel(blob)
+    m = SentencePieceModel(build_model_proto(pieces, escape_whitespaces=True))
+    assert m.escape_whitespaces
+
+
+def test_parity_against_real_sentencepiece(tmp_path):
+    """Train a real model with the sentencepiece library and assert our
+    parser encodes/decodes identically (skipped when the library is not
+    installed — CI images without it still run the wire-format tests)."""
+    spm = pytest.importorskip("sentencepiece")
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text(
+        "\n".join(
+            [
+                "hello world",
+                "the quick brown fox jumps over the lazy dog",
+                "speculative decoding verifies many tokens per step",
+                "hello speculative world of tokenizers",
+                "paged attention shares prefix blocks across requests",
+            ]
+            * 8
+        )
+    )
+    model_prefix = str(tmp_path / "parity")
+    train_kw = dict(
+        input=str(corpus),
+        vocab_size=64,
+        model_type="unigram",
+        byte_fallback=True,
+        character_coverage=1.0,
+    )
+    spm.SentencePieceTrainer.train(
+        model_prefix=model_prefix,
+        # The default nmt_nfkc normalizer embeds a precompiled_charsmap,
+        # which this parser refuses by design (see below); train the
+        # parity model with the identity normalizer.
+        normalization_rule_name="identity",
+        **train_kw,
+    )
+    # A model trained with the DEFAULT normalizer really does carry the
+    # charsmap — the refusal guard must fire on the real artifact.
+    spm.SentencePieceTrainer.train(
+        model_prefix=model_prefix + "_nfkc", **train_kw
+    )
+    with pytest.raises(ValueError, match="precompiled_charsmap"):
+        SentencePieceModel.from_file(model_prefix + "_nfkc.model")
+    ours = SentencePieceModel.from_file(model_prefix + ".model")
+    ref = spm.SentencePieceProcessor(model_file=model_prefix + ".model")
+    for text in (
+        "hello world",
+        "the quick brown fox",
+        "speculative tokenizers decode",
+        "unseen wörds überall",
+    ):
+        expect = ref.encode(text, out_type=int)
+        got = ours.encode(text)
+        assert got == expect, f"{text!r}: {got} != {expect}"
+        assert ours.decode(got) == ref.decode(expect)

@@ -316,3 +316,26 @@ def test_quantize_dequantize_handle_fused_trees():
     assert float(np.max(np.abs(got - want))) < 0.05 * max(
         1.0, float(np.max(np.abs(want)))
     )
+
+
+def test_quantize_never_wraps_to_minus_128():
+    """round(w/scale) can land on ±127.0000x in float32 even though
+    |w| <= amax exactly; the int8 cast must clip, never wrap (advisor r5:
+    +127.x cast to int8 wraps to -128 — a sign flip on the largest-
+    magnitude channel entries)."""
+    from dynamo_tpu.models.quant import _quantize_jnp, quantize_array_np
+
+    rng = np.random.default_rng(0)
+    # Adversarial tensor: exact ±amax entries in every channel plus values
+    # arbitrarily close to amax from below/above the representable grid.
+    w = rng.standard_normal((8, 64)).astype(np.float32)
+    w[:, 0] = np.abs(w[:, 0].max()) * 3.0
+    w[0, :] = -np.abs(w).max(axis=0)  # exact negative extreme per channel
+    w[1, :] = np.abs(w).max(axis=0) * (1 - 1e-7)  # rounds to 127.00000x
+    for q, s in (quantize_array_np(w, 0), _quantize_jnp(jnp.asarray(w), 0)):
+        q = np.asarray(q)
+        assert q.dtype == np.int8
+        assert q.min() >= -127 and q.max() <= 127
+        # Dequantized extremes keep their SIGN (the wrap victim test).
+        deq = q.astype(np.float32) * np.asarray(s)[None, :]
+        assert np.all(np.sign(deq[1, :]) == np.sign(w[1, :]))
