@@ -251,50 +251,91 @@ async def _run(args) -> None:
     elif inp.startswith("dyn://"):
         if not args.hub:
             raise SystemExit("worker mode requires --hub HOST:PORT")
-        runtime = await DistributedRuntime.connect(args.hub)
-        ns, comp, ep = parse_endpoint_path(inp)
-        endpoint = runtime.namespace(ns).component(comp).endpoint(ep)
-
         role = getattr(args, "disagg", None)
         if role and not hasattr(engine, "inject_blocks"):
             raise SystemExit(
                 f"--disagg {role} requires the native TPU engine (out=tpu), "
                 f"not out={args.out}"
             )
-        served_engine = engine
-        cleanups = []
-
+        runtime = await DistributedRuntime.connect(args.hub)
+        ns, comp, ep = parse_endpoint_path(inp)
+        endpoint = runtime.namespace(ns).component(comp).endpoint(ep)
+        roles = WorkerRoles(args, runtime, endpoint, engine, _tokenizer_spec(args))
         if role == "prefill":
-            # Dedicated prefill worker: drains the queue; serves no
-            # endpoint.  It still registers a lease-bound heartbeat under
-            # its endpoint path (metadata role=prefill) so the planner's
-            # SignalCollector sees prefill-pool membership and its death
-            # is observable — nothing routes to this path.
-            from .llm.disagg import PrefillQueue, PrefillWorkerLoop
+            await roles.start_prefill()
+        else:
+            await roles.start_decode(disagg=role == "decode")
+        flipper = None
+        if role in ("decode", "prefill"):
+            # Planner role flips (planner/actuate.py LocalActuator →
+            # planner/roles/{worker_id}) work BOTH directions on the same
+            # resident engine: decode→prefill migrates live sequences out
+            # then starts a queue-drain loop; prefill→decode finishes the
+            # in-flight queue item then brings up the full decode surface
+            # (kv_import endpoint included).
+            from .planner.actuate import RoleFlipWatcher
 
-            ploop = await PrefillWorkerLoop(
-                engine, PrefillQueue(runtime.hub, args.model)
-            ).start()
-            cleanups.append(ploop.stop)
-            await runtime.register_key(
-                endpoint.instance_key(runtime.worker_id),
-                {
-                    "address": "",
-                    "path": endpoint.path,
-                    "worker_id": runtime.worker_id,
-                    "metadata": {"role": "prefill"},
+            async def _switch_decode() -> None:
+                await roles.start_decode(disagg=True)
+
+            flipper = await RoleFlipWatcher(
+                runtime.hub,
+                runtime.worker_id,
+                role,
+                drain={
+                    "decode": roles.stop_decode,
+                    "prefill": roles.stop_prefill,
                 },
-            )
-            print(f"prefill worker draining queue for {args.model!r}", flush=True)
-            try:
-                await _wait_forever()
-            finally:
-                for fn in cleanups:
-                    await fn()
-                await runtime.close()
-            return
+                switch={
+                    "prefill": roles.start_prefill,
+                    "decode": _switch_decode,
+                },
+            ).start()
+        print(
+            f"worker serving {inp} (model {args.model!r}"
+            + (f", disagg={role}" if role else "")
+            + ")",
+            flush=True,
+        )
+        try:
+            await _wait_forever()
+        finally:
+            if flipper is not None:
+                await flipper.stop()
+            await roles.shutdown()
+            await runtime.close()
+    else:
+        raise SystemExit(f"unknown in= input: {inp!r}")
 
-        if role == "decode":
+
+class WorkerRoles:
+    """Role lifecycle for one dyn:// worker: start/stop the decode and
+    prefill roles on a single resident engine (weights never reload across
+    flips).  The decode role's stop hook drains via LIVE MIGRATION first
+    (llm/migration): sequences move to a peer in O(KV transfer) instead of
+    being waited out in O(sequence length), which is what makes planner
+    scale-down/flip actuation cheap."""
+
+    def __init__(self, args, runtime, endpoint, engine, tokenizer_spec):
+        self.args = args
+        self.runtime = runtime
+        self.endpoint = endpoint
+        self.engine = engine
+        self.tokenizer_spec = tokenizer_spec
+        self._handles: dict = {}
+        # The decode role's MigratableWorker (None while in prefill role).
+        self.migratable = None
+
+    # -- decode role --------------------------------------------------------
+
+    async def start_decode(self, disagg: bool) -> None:
+        args, runtime, endpoint, engine = (
+            self.args, self.runtime, self.endpoint, self.engine,
+        )
+        h: dict = {"serveds": []}
+        served_engine = engine
+        metadata: dict = {"role": "decode"} if disagg else {}
+        if disagg:
             from .llm.disagg import (
                 KV_IMPORT_ENDPOINT,
                 DisaggConfig,
@@ -311,7 +352,7 @@ async def _run(args) -> None:
                     max_local_prefill_length=args.max_local_prefill,
                 ),
             ).watch_config(runtime.hub)
-            cleanups.append(disagg_router.stop)
+            h["router"] = disagg_router
             worker = DisaggDecodeWorker(
                 engine,
                 PrefillQueue(runtime.hub, args.model),
@@ -319,89 +360,156 @@ async def _run(args) -> None:
                 import_address=server.address,
                 import_path=import_ep.path,
             )
-            await import_ep.serve_endpoint(worker.kv_import_handler)
+            h["serveds"].append(
+                await import_ep.serve_endpoint(worker.kv_import_handler)
+            )
             stats_ep = endpoint.component.endpoint("disagg_stats")
-            await stats_ep.serve_endpoint(worker.stats_handler)
+            h["serveds"].append(
+                await stats_ep.serve_endpoint(worker.stats_handler)
+            )
+            h["disagg"] = worker
             served_engine = worker
+        if hasattr(engine, "inject_blocks"):  # native TPU engine
+            # Live-migration surface: peers (and the planner's drain path)
+            # move running sequences here preemption-free.  The instance
+            # metadata advertises the capability so target discovery
+            # (llm/migration/coordinator.py) finds this worker.
+            from .llm.migration import (
+                MIGRATE_IN_ENDPOINT,
+                MIGRATE_OUT_ENDPOINT,
+                MigratableWorker,
+            )
 
-        served = await endpoint.serve_endpoint(
-            served_engine,
-            metadata={"role": role} if role else None,
+            mig = MigratableWorker(engine, serve=served_engine)
+            mig_in = endpoint.component.endpoint(MIGRATE_IN_ENDPOINT)
+            mig_out = endpoint.component.endpoint(MIGRATE_OUT_ENDPOINT)
+            h["serveds"].append(
+                await mig_in.serve_endpoint(mig.migrate_in_handler)
+            )
+            h["serveds"].append(
+                await mig_out.serve_endpoint(mig.migrate_out_handler)
+            )
+            metadata["migrate"] = {
+                "import_path": mig_in.path,
+                "out_path": mig_out.path,
+                "generate_path": endpoint.path,
+            }
+            served_engine = mig
+            h["mig"] = mig
+            self.migratable = mig
+        h["serveds"].append(
+            await endpoint.serve_endpoint(
+                served_engine, metadata=metadata or None
+            )
         )
-
-        if role == "decode":
-            # Planner role flips (planner/actuate.py LocalActuator →
-            # planner/roles/{worker_id}): a decode worker can be flipped
-            # into the prefill pool — drain pending transfers, stop
-            # serving + deregister the model entry, start a queue-drain
-            # loop on the same engine (weights stay resident).
-            from .llm.disagg import PrefillQueue as _PQ
-            from .llm.disagg import PrefillWorkerLoop as _PWL
-            from .planner.actuate import RoleFlipWatcher
-
-            _decode_worker = served_engine
-
-            async def _drain_decode() -> None:
-                await _decode_worker.drain(timeout=10.0)
-                await served.stop()
-                await runtime.unregister_key(
-                    f"models/{args.model}/{runtime.worker_id}"
-                )
-
-            async def _switch_prefill() -> None:
-                ploop = await _PWL(engine, _PQ(runtime.hub, args.model)).start()
-                cleanups.append(ploop.stop)
-                await runtime.register_key(
-                    endpoint.instance_key(runtime.worker_id),
-                    {
-                        "address": "",
-                        "path": endpoint.path,
-                        "worker_id": runtime.worker_id,
-                        "metadata": {"role": "prefill"},
-                    },
-                )
-
-            flipper = await RoleFlipWatcher(
-                runtime.hub,
-                runtime.worker_id,
-                "decode",
-                drain={"decode": _drain_decode},
-                switch={"prefill": _switch_prefill},
-            ).start()
-            cleanups.append(flipper.stop)
+        h["metadata"] = metadata
         kv_block_size = 16
         if hasattr(engine, "set_event_callback"):  # native TPU engine
-            from .llm.kv_router.publisher import KvEventPublisher, KvMetricsPublisher
+            from .llm.kv_router.publisher import (
+                KvEventPublisher,
+                KvMetricsPublisher,
+            )
 
             kv_block_size = engine.cfg.block_size
             engine.set_event_callback(
                 KvEventPublisher(endpoint.component, runtime.worker_id)
             )
-            metrics_pub = await KvMetricsPublisher(
+            h["metrics_pub"] = await KvMetricsPublisher(
                 endpoint.component, runtime.worker_id, engine.metrics
             ).start()
-            cleanups.append(metrics_pub.stop)
         await register_model(
             runtime,
             args.model,
             endpoint.path,
-            tokenizer=_tokenizer_spec(args),
+            tokenizer=self.tokenizer_spec,
             kv_block_size=kv_block_size,
         )
-        print(
-            f"worker serving {inp} (model {args.model!r}"
-            + (f", disagg={role}" if role else "")
-            + ")",
-            flush=True,
+        self._handles["decode"] = h
+
+    async def stop_decode(self) -> None:
+        h = self._handles.pop("decode", None)
+        if h is None:
+            return
+        if h.get("mig") is not None:
+            # De-advertise the migrate capability FIRST: target discovery
+            # filters on instance metadata, so two concurrently-draining
+            # workers must stop seeing each other as receivers before
+            # either starts pushing KV (mutual migration would cut both
+            # streams over into workers about to stop).
+            from .llm.migration import drain_via_migration
+
+            try:
+                md = {
+                    k: v
+                    for k, v in (h.get("metadata") or {}).items()
+                    if k != "migrate"
+                }
+                await self.endpoint.update_metadata(md)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — best effort; drain anyway
+                logger.warning("could not de-advertise migrate capability",
+                               exc_info=True)
+            # Drain via migration: live sequences hand off to a peer in
+            # O(transfer); anything that could not move (no peer, rollback)
+            # simply keeps decoding here until it finishes.
+            await drain_via_migration(
+                h["mig"],
+                self.runtime.hub,
+                self.endpoint.instance_prefix,
+                self.runtime.worker_id,
+            )
+        if h.get("disagg") is not None:
+            await h["disagg"].drain(timeout=10.0)
+        for served in reversed(h["serveds"]):
+            await served.stop()
+        if h.get("metrics_pub") is not None:
+            await h["metrics_pub"].stop()
+        if h.get("router") is not None:
+            await h["router"].stop()
+        await self.runtime.unregister_key(
+            f"models/{self.args.model}/{self.runtime.worker_id}"
         )
-        try:
-            await _wait_forever()
-        finally:
-            for fn in cleanups:
-                await fn()
-            await runtime.close()
-    else:
-        raise SystemExit(f"unknown in= input: {inp!r}")
+        self.migratable = None
+
+    # -- prefill role -------------------------------------------------------
+
+    async def start_prefill(self) -> None:
+        # Dedicated prefill worker: drains the queue; serves no endpoint.
+        # It still registers a lease-bound heartbeat under its endpoint
+        # path (metadata role=prefill) so the planner's SignalCollector
+        # sees prefill-pool membership and its death is observable —
+        # nothing routes to this path.
+        from .llm.disagg import PrefillQueue, PrefillWorkerLoop
+
+        ploop = await PrefillWorkerLoop(
+            self.engine, PrefillQueue(self.runtime.hub, self.args.model)
+        ).start()
+        await self.runtime.register_key(
+            self.endpoint.instance_key(self.runtime.worker_id),
+            {
+                "address": "",
+                "path": self.endpoint.path,
+                "worker_id": self.runtime.worker_id,
+                "metadata": {"role": "prefill"},
+            },
+        )
+        self._handles["prefill"] = {"ploop": ploop}
+
+    async def stop_prefill(self) -> None:
+        h = self._handles.pop("prefill", None)
+        if h is None:
+            return
+        # Finish the in-flight queue item (bounded), then stop pulling;
+        # a cancel that lands mid-dequeue requeues at-least-once.
+        await h["ploop"].drain(timeout=10.0)
+        await self.runtime.unregister_key(
+            self.endpoint.instance_key(self.runtime.worker_id)
+        )
+
+    async def shutdown(self) -> None:
+        await self.stop_decode()
+        await self.stop_prefill()
 
 
 async def _run_model_cmd(args) -> None:

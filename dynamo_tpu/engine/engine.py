@@ -61,6 +61,7 @@ logger = logging.getLogger(__name__)
 
 
 
+from .migrate import MigrationMixin
 from .offload import HostOffloadMixin
 from .pipeline import _FINISHED, DecodePipelineMixin
 from .spec import AcceptanceController, SpecDecodeMixin
@@ -69,7 +70,7 @@ from .transfer import KvTransferMixin, _scales_close, transfer_blocks_device  # 
 
 class TpuEngine(
     KvTransferMixin, HostOffloadMixin, DecodePipelineMixin, SpecDecodeMixin,
-    AsyncEngine,
+    MigrationMixin, AsyncEngine,
 ):
     """Token-in/token-out engine (ExecutionContext equivalent)."""
 
@@ -153,6 +154,10 @@ class TpuEngine(
         # burst on the tunneled chip — together over half of
         # mid-concurrency wall time.
         self._pending_fetches: List[Tuple] = []
+        # Request ids with fused-pipeline dispatches potentially in flight
+        # (set for the duration of each _decode_pipeline run); live
+        # migration's freeze waits until its sequence leaves this set.
+        self._pipeline_members: set = set()
 
         # --- device state -------------------------------------------------
         mesh_cfg = MeshConfig(dp=cfg.dp, tp=cfg.tp, ep=cfg.ep, sp=cfg.sp)
@@ -917,15 +922,24 @@ class TpuEngine(
                             >= self.cfg.prefill_chunks_per_burst
                         ):
                             self._chunks_since_burst = 0
-                            if not await self._decode_burst(
-                                [s for s, _, _ in decode_items]
+                            # Replan against freezes/finishes that landed
+                            # DURING the awaited prefill step: a frozen
+                            # (mid-migration) row advanced here would emit
+                            # tokens its cutover snapshot lacks.
+                            burst_items = [
+                                it
+                                for it in decode_items
+                                if not it[0].finished and not it[0].frozen
+                            ]
+                            if burst_items and not await self._decode_burst(
+                                [s for s, _, _ in burst_items]
                             ):
                                 # No KV headroom for a whole burst: the
                                 # 1-token slots are already allocated.
                                 self.step_trace.append(
-                                    ("burst_fallback", 0.0, len(decode_items), 0)
+                                    ("burst_fallback", 0.0, len(burst_items), 0)
                                 )
-                                await self._run_unified(StepPlan(decode_items))
+                                await self._run_unified(StepPlan(burst_items))
                         did_work = True
                 if not did_work:
                     # Not enough KV headroom for a fused window (or not a
@@ -951,6 +965,7 @@ class TpuEngine(
 
     def _fail_all(self) -> None:
         self._pending_fetches.clear()  # drop in-flight token fetches
+        self._pipeline_members = set()
         for seq in list(self.scheduler.running) + list(self.scheduler.waiting):
             seq.awaiting_fetch = False
             self.scheduler.remove(seq)

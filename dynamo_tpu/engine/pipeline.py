@@ -74,9 +74,15 @@ class DecodePipelineMixin:
         if any_pen:
             counts_np = np.zeros((S, V), np.int16)
             for i, seq in enumerate(seqs):
-                out = np.asarray(seq.output, np.int64)
-                if out.size:
-                    np.add.at(counts_np[i], out % V, 1)
+                # Generated tokens since the ORIGINAL prompt: preemption and
+                # migration-resume fold output into ``prompt``, and counting
+                # ``output`` alone would silently drop the folded tokens'
+                # penalty contributions exactly when a request resumes.
+                gen = np.asarray(
+                    (seq.prompt + seq.output)[seq.orig_prompt_len :], np.int64
+                )
+                if gen.size:
+                    np.add.at(counts_np[i], gen % V, 1)
             if self._rep_sharding is not None:
                 counts = self._prep(counts_np)
             else:
@@ -153,6 +159,18 @@ class DecodePipelineMixin:
         else:
             rb_d, samp_d = rb, samp
         step = self._step_fn
+        # Park rows BEFORE the first suspension point, not after the
+        # dispatch: from here to the harvest this coroutine yields, and
+        # anything polling quiescence (freeze_sequence, engine/migrate.py)
+        # must see these rows as having a token en route — marking after
+        # the await left a window where a migration snapshot missed the
+        # in-flight token and the client received it twice.  (Rows of OLD
+        # pending fetches are disjoint from this plan's rows — the
+        # scheduler never plans a parked row — so the harvests below can't
+        # clear these marks early.)
+        for seq, start, n in plan.items:
+            if not seq.finished and start + n >= len(seq.prompt):
+                seq.awaiting_fetch = True
         while self._pending_fetches and self._pending_fetches[0][1].done():
             await self._harvest_pending()  # free: task already complete
 
@@ -194,6 +212,7 @@ class DecodePipelineMixin:
         pending_rows: List[Tuple[SequenceState, int]] = []
         for i, (seq, start, n) in enumerate(plan.items):
             if seq.finished:
+                seq.awaiting_fetch = False  # pre-marked above; never parked
                 continue
             if start >= len(seq.prompt):
                 # Decode row: the fed token joins the hash stream.
@@ -201,8 +220,9 @@ class DecodePipelineMixin:
             seq.num_computed = start + n
             self._seal_completed_blocks(seq)
             if not seq.in_prefill:
-                # This row's sampled token is in flight; park the row until
-                # a harvest point applies it.
+                # This row's sampled token is in flight (pre-marked before
+                # the dispatch); park the row until a harvest point applies
+                # it.
                 seq.awaiting_fetch = True
                 pending_rows.append((seq, i))
         if pending_rows:
@@ -282,6 +302,9 @@ class DecodePipelineMixin:
         bs = cfg.block_size
         S, T = cfg.max_batch, cfg.decode_steps
         n = len(members)
+        # Visible to freeze_sequence (engine/migrate.py): a member may have
+        # fused chunks in flight until this pipeline run drains and returns.
+        self._pipeline_members = {s.request_id for s in members}
 
         tok0 = np.zeros((S,), np.int32)
         pos_disp = np.full((S,), -1, np.int32)  # dispatch frontier (-1 = pad)
@@ -321,7 +344,7 @@ class DecodePipelineMixin:
             return (
                 self._closed
                 or self.scheduler.admission_ready()
-                or any(s.finished for s in members)
+                or any(s.finished or s.frozen for s in members)
                 or any(
                     (c := self._contexts.get(s.request_id)) is not None
                     and c.is_stopped
@@ -460,6 +483,7 @@ class DecodePipelineMixin:
             await asyncio.sleep(0)  # let ingress/egress run between chunks
 
         # Drained: now it is safe to release finished members' blocks.
+        self._pipeline_members = set()
         for seq in finished_members:
             self.scheduler.remove(seq)
         return dispatched_any
@@ -480,7 +504,7 @@ class DecodePipelineMixin:
         tables = np.zeros((S, cfg.max_blocks_per_seq), np.int32)
         limits = np.zeros((S,), np.int32)
         for i, seq in enumerate(members):
-            if seq.finished:
+            if seq.finished or seq.frozen:
                 return False  # membership changed under us: replan
             if not self.scheduler._ensure_slot(seq, lookahead=T):
                 return False
@@ -491,6 +515,12 @@ class DecodePipelineMixin:
             limits[i] = min(
                 len(seq.block_ids) * bs, cfg.max_blocks_per_seq * bs
             )
+        # Park BEFORE the first suspension point (see _run_unified):
+        # quiescence pollers must count the burst's in-flight tokens from
+        # the moment this coroutine can yield, not from when the dispatch
+        # returns.
+        for seq in members:
+            seq.awaiting_fetch = True
         while self._pending_fetches and self._pending_fetches[0][1].done():
             await self._harvest_pending()  # free: task already complete
         samp = self._sampling_arrays(members)
@@ -538,8 +568,6 @@ class DecodePipelineMixin:
         self.step_trace.append(
             ("decode_burst", time.perf_counter() - t0, n, n * T)
         )
-        for seq in members:
-            seq.awaiting_fetch = True
         self._stash_fetch("burst", outs, need_lp, members, pos0)
         return True
 

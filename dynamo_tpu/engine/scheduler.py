@@ -58,6 +58,11 @@ class SequenceState:
     # deferred first-token fetch): the scheduler must not plan the row
     # until the engine harvests it (engine.py _harvest_pending).
     awaiting_fetch: bool = False
+    # Live-migration freeze (engine/migrate.py): the sequence keeps its KV
+    # blocks and queue but is never planned, never a preemption victim, and
+    # blocks no one — the brief final-delta window of a migration, ended by
+    # cutover (finish_migrated) or rollback (unfreeze_sequence).
+    frozen: bool = False
     # Original request prompt length.  Preemption folds generated tokens into
     # ``prompt`` for recompute, so stop checks and usage must count output as
     # total_tokens - orig_prompt_len, never len(output).
@@ -115,7 +120,21 @@ class SequenceState:
         cls, request_id: str, pre: PreprocessedRequest, cfg: EngineConfig
     ) -> "SequenceState":
         samp, stop = pre.sampling_options, pre.stop_conditions
-        return cls(
+        # Live-migration resume (llm/migration): the prompt is the original
+        # prompt PLUS every token already emitted elsewhere; orig_prompt_len
+        # restores the rng-stream position (sampler steps count from it) and
+        # the stop/usage accounting, so the continued stream is
+        # token-identical to the never-migrated run.
+        resume = pre.annotations.get("resume") or {}
+        orig_len = 0
+        if isinstance(resume, dict):
+            try:
+                v = int(resume.get("orig_prompt_len", 0))
+            except (TypeError, ValueError):
+                v = 0
+            if 0 < v <= len(pre.token_ids):
+                orig_len = v
+        seq = cls(
             request_id=request_id,
             prompt=list(pre.token_ids),
             block_seq=TokenBlockSequence(block_size=cfg.block_size),
@@ -140,7 +159,19 @@ class SequenceState:
             stop_token_ids=frozenset(stop.stop_token_ids or ()),
             ignore_eos=bool(stop.ignore_eos),
             spec_enabled=getattr(samp, "spec_decode", None) is not False,
+            orig_prompt_len=orig_len,
         )
+        spec = resume.get("spec") if isinstance(resume, dict) else None
+        if isinstance(spec, dict):
+            # Speculation controller state travels with the sequence — the
+            # acceptance history is a property of the traffic, not of which
+            # worker holds the KV (same rationale as surviving preemption).
+            seq.spec_k = int(spec.get("k", seq.spec_k))
+            seq.spec_ewma = float(spec.get("ewma", seq.spec_ewma))
+            seq.spec_bench_until = int(spec.get("bench_until", seq.spec_bench_until))
+            seq.spec_next_try = int(spec.get("next_try", seq.spec_next_try))
+            seq.spec_miss = int(spec.get("miss", seq.spec_miss))
+        return seq
 
 
 @dataclass
@@ -172,7 +203,11 @@ class Scheduler:
     def add(self, seq: SequenceState) -> None:
         # Trim the generation budget to the context limit rather than reject;
         # over-long prompts are rejected by the engine before reaching us.
-        room = self.cfg.max_model_len - len(seq.prompt)
+        # The budget counts from the ORIGINAL prompt (orig_prompt_len ==
+        # len(prompt) for fresh requests): a migrated resume folds emitted
+        # tokens into the prompt, and trimming against the folded length
+        # would silently shrink the remaining budget by the emitted count.
+        room = self.cfg.max_model_len - seq.orig_prompt_len
         if seq.max_new_tokens is None or seq.max_new_tokens > room:
             seq.max_new_tokens = room
         seq.enqueue_t = time.perf_counter()
@@ -214,7 +249,10 @@ class Scheduler:
         for seq in [
             s
             for s in self.running
-            if not s.in_prefill and not s.finished and not s.awaiting_fetch
+            if not s.in_prefill
+            and not s.finished
+            and not s.awaiting_fetch
+            and not s.frozen
         ]:
             if seq not in self.running:
                 continue  # preempted as a victim below
@@ -222,13 +260,16 @@ class Scheduler:
             while not ok:
                 # Rows parked on an in-flight token fetch are not victims:
                 # preempting one would fold/rewind state the engine's
-                # harvest is about to append a token to.
+                # harvest is about to append a token to.  Frozen rows are
+                # not victims either: preemption frees exactly the KV
+                # blocks a migration is transferring.
                 victims = [
                     s
                     for s in self.running
                     if s is not seq
                     and id(s) not in scheduled
                     and not s.awaiting_fetch
+                    and not s.frozen
                 ]
                 if not victims:
                     break
@@ -250,7 +291,7 @@ class Scheduler:
         for seq in self.running:
             if budget <= 0 or len(items) >= self.cfg.max_batch:
                 break
-            if seq.in_prefill and not seq.finished:
+            if seq.in_prefill and not seq.finished and not seq.frozen:
                 chunk = min(budget, len(seq.prompt) - seq.num_computed)
                 items.append((seq, seq.num_computed, chunk))
                 budget -= chunk
@@ -268,6 +309,13 @@ class Scheduler:
                 admission_blocked = True
                 break
             seq = self.waiting[0]
+            if seq.frozen:
+                # A preempted sequence frozen mid-migration must not be
+                # admitted and recomputed — a sampled token the snapshot
+                # lacks would reach the client twice after the splice.
+                # Freezes are sub-second; treat the head as blocked.
+                admission_blocked = True
+                break
             if not self._try_admit(seq):
                 own_pins = len(seq.pin_ids or [])
                 if not self.running and self.kv.active_blocks <= own_pins:
@@ -295,7 +343,9 @@ class Scheduler:
         pure = (
             (not self.waiting or admission_blocked)
             and all(n == 1 for _, _, n in items)
-            and not any(s.in_prefill for s in self.running)
+            and not any(
+                s.in_prefill and not s.frozen for s in self.running
+            )
         )
         return StepPlan(items, pure_decode=pure)
 
@@ -309,6 +359,8 @@ class Scheduler:
         if len(self.running) >= self.cfg.max_batch:
             return False
         seq = self.waiting[0]
+        if seq.frozen:
+            return False  # mid-migration: schedule() will not admit it
         prompt_blocks = (len(seq.prompt) + self.cfg.block_size) // self.cfg.block_size
         if prompt_blocks <= self.kv.free_blocks:
             return True  # fits even with zero prefix hits: skip the hashing

@@ -378,6 +378,11 @@ class SpecDecodeMixin:
                 row_seqs.append(seq)
                 offsets.append(0)
                 plain_rows.append((seq, start, n, row))
+                if start + n >= len(seq.prompt):
+                    # Parked BEFORE the dispatch, like drafted rows above:
+                    # quiescence pollers (freeze_sequence) must see the
+                    # in-flight token from commit time (engine/migrate.py).
+                    seq.awaiting_fetch = True
                 row += 1
         cu[row + 1 :] = at
         T = cfg.bucket_tokens(at)
@@ -435,6 +440,7 @@ class SpecDecodeMixin:
         first_rows: List[Tuple[SequenceState, int]] = []
         for seq, start, n, r in plain_rows:
             if seq.finished:
+                seq.awaiting_fetch = False  # pre-marked; never parked
                 continue
             if start >= len(seq.prompt):
                 # Decode row: the fed token joins the hash stream.

@@ -142,19 +142,33 @@ class KvTransferMixin:
                 jnp.dtype(self.cfg.cache_dtype), local_scale,
             )
             return 0
-        alloc = self.kv.allocate_sequence(blocks, n)
-        if alloc is None:
-            return 0  # no capacity; caller falls back to local prefill
-        ids, cached = alloc
+        # Parse/validate the payload ARRAYS before allocating too: a
+        # malformed payload (truncated bytes, inconsistent shape) raising
+        # after allocate_sequence would leak the freshly-taken blocks AND
+        # may already have LRU-evicted sealed contents to take them.
         shape = tuple(payload["shape"])
         name = payload["dtype"]
         dt = jnp.dtype(name)  # ml_dtypes registers bf16/fp8 names
-        k = np.frombuffer(payload["k"], dtype=dt).reshape(shape)[:, :n]
-        v = np.frombuffer(payload["v"], dtype=dt).reshape(shape)[:, :n]
+        try:
+            k = np.frombuffer(payload["k"], dtype=dt).reshape(shape)[:, :n]
+            v = np.frombuffer(payload["v"], dtype=dt).reshape(shape)[:, :n]
+        except ValueError:
+            logger.warning("rejecting KV import: malformed payload arrays")
+            return 0
+        if shape[1] < n:
+            logger.warning(
+                "rejecting KV import: payload carries %d pages for n_blocks "
+                "%d", shape[1], n,
+            )
+            return 0
         # Interleave back to combined pages [L, n, ps, 2KV, hd] (K even).
         comb = np.stack([k, v], axis=4).reshape(
             k.shape[0], n, k.shape[2], 2 * k.shape[3], k.shape[4]
         )
+        alloc = self.kv.allocate_sequence(blocks, n)
+        if alloc is None:
+            return 0  # no capacity; caller falls back to local prefill
+        ids, cached = alloc
         # Pad the page count to a power-of-two bucket so _inject_fn compiles
         # once per bucket, not once per distinct imported prompt length.
         pad = 1 << max(0, (n - 1).bit_length())
@@ -163,22 +177,30 @@ class KvTransferMixin:
         comb_p = np.zeros(comb.shape[:1] + (pad,) + comb.shape[2:], comb.dtype)
         comb_p[:, :n] = comb
 
-        async with self._device_lock:
-            # Lock-HOLD wall only (t0 inside the lock — queueing behind a
-            # decode chunk is the scheduler working as intended, not import
-            # cost): the decode/transfer-overlap contract is that an import
-            # never blocks decode longer than ONE chunk's scatter
-            # (tests/test_disagg.py overlap test reads this).
-            t0 = time.perf_counter()
-            # Publish under the device lock (broadcast order == enqueue
-            # order; see _run_unified).
-            if self._publisher is not None:
-                await self._publisher.publish("inject", (page_ids, comb_p))
-            # to_thread: compile/execute must not stall the engine loop.
-            self.cache = await asyncio.to_thread(
-                self._inject_fn, self.cache, *self._prep((page_ids, comb_p))
-            )
-            hold = time.perf_counter() - t0
+        try:
+            async with self._device_lock:
+                # Lock-HOLD wall only (t0 inside the lock — queueing behind a
+                # decode chunk is the scheduler working as intended, not import
+                # cost): the decode/transfer-overlap contract is that an import
+                # never blocks decode longer than ONE chunk's scatter
+                # (tests/test_disagg.py overlap test reads this).
+                t0 = time.perf_counter()
+                # Publish under the device lock (broadcast order == enqueue
+                # order; see _run_unified).
+                if self._publisher is not None:
+                    await self._publisher.publish("inject", (page_ids, comb_p))
+                # to_thread: compile/execute must not stall the engine loop.
+                self.cache = await asyncio.to_thread(
+                    self._inject_fn, self.cache, *self._prep((page_ids, comb_p))
+                )
+                hold = time.perf_counter() - t0
+        except BaseException:
+            # Mid-transfer failure: the blocks were never sealed — return
+            # them to the pool instead of leaking them as allocated-forever
+            # scratch, then surface the error (the sender retries/drops and
+            # the decode side's timeout falls back to local prefill).
+            self.kv.free_sequence(ids)
+            raise
         self.step_trace.append(("inject", hold, n, 0))
         for bid, tb in zip(ids, blocks):
             self.kv.seal_block(bid, tb)
@@ -201,6 +223,25 @@ class KvTransferMixin:
         n = min(n, len(blocks))
         if n == 0:
             return 0
+        # Validate config/capacity BEFORE allocating (mirror of the host
+        # path's fix): a mismatched layout would seal wrong KV under valid
+        # hashes, and a doomed allocation must never LRU-evict sealed
+        # contents it immediately frees back.  transfer_blocks_device checks
+        # these on the source side too, but this entry point is public
+        # (disagg transfer_direct) and must be safe on its own.
+        if (
+            pages_dev.ndim != 5
+            or pages_dev.shape[0] != self.cache.pages.shape[0]
+            or pages_dev.shape[1] < n
+            or pages_dev.shape[2:] != self.cache.pages.shape[2:]
+            or pages_dev.dtype != self.cache.pages.dtype
+        ):
+            logger.warning(
+                "rejecting device KV import: pages %s/%s vs local cache %s/%s",
+                getattr(pages_dev, "shape", None), pages_dev.dtype,
+                self.cache.pages.shape, self.cache.pages.dtype,
+            )
+            return 0
         alloc = self.kv.allocate_sequence(blocks[:n], n)
         if alloc is None:
             return 0
@@ -208,12 +249,16 @@ class KvTransferMixin:
         pad = pages_dev.shape[1]
         page_ids = np.full((pad,), self.cfg.num_blocks, np.int32)  # OOB pad
         page_ids[:n] = ids
-        async with self._device_lock:
-            t0 = time.perf_counter()  # lock HOLD, not wait (see inject_blocks)
-            self.cache = await asyncio.to_thread(
-                self._inject_fn, self.cache, page_ids, pages_dev
-            )
-            hold = time.perf_counter() - t0
+        try:
+            async with self._device_lock:
+                t0 = time.perf_counter()  # lock HOLD, not wait (see inject_blocks)
+                self.cache = await asyncio.to_thread(
+                    self._inject_fn, self.cache, page_ids, pages_dev
+                )
+                hold = time.perf_counter() - t0
+        except BaseException:
+            self.kv.free_sequence(ids)  # roll back: blocks never sealed
+            raise
         self.step_trace.append(("inject", hold, n, 0))
         for bid, tb in zip(ids, blocks[:n]):
             self.kv.seal_block(bid, tb)

@@ -58,6 +58,27 @@ class Decoder:
         """Engine ended the stream: flush detok + jail."""
         return self._stream.flush() + self._release_jail()
 
+    # -- migration (llm/migration SequenceSnapshot.detok) -------------------
+    #
+    # The routed client splices migrated streams BELOW this operator, so in
+    # the normal path Decoder state never moves.  An edge that itself hands
+    # a stream to another frontend (or replays a recorded one) snapshots
+    # here instead: the detok byte-stream state is reconstructed by
+    # replaying the generated token ids (decode_stream is deterministic),
+    # and the jail/counters restore exactly.
+
+    def state_dict(self) -> dict:
+        return {"generated": self._generated, "jail": self._jail}
+
+    def load_state(self, state: dict, token_ids=()) -> None:
+        """Restore from ``state_dict()`` output; ``token_ids`` replays the
+        already-generated tokens through a FRESH detok stream (emitted text
+        is discarded — it was already delivered)."""
+        for tok in token_ids:
+            self._stream.step(tok)
+        self._generated = int(state.get("generated", 0))
+        self._jail = str(state.get("jail", ""))
+
     # -- stop strings -------------------------------------------------------
 
     def _eval_stop_strings(self, new_text: str) -> Tuple[str, bool]:

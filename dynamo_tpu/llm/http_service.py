@@ -153,13 +153,14 @@ class HttpService:
         # do the engine's speculative-decoding gauges when the engine is
         # colocated (llm/metrics.py spec_metrics).
         from ..planner.pmetrics import metrics as planner_metrics
-        from .metrics import spec_metrics
+        from .metrics import migration_metrics, spec_metrics
 
         body = (
             self.metrics.render()
             + resilience_metrics.render(self._metrics_prefix).encode()
             + planner_metrics.render(self._metrics_prefix).encode()
             + spec_metrics.render(self._metrics_prefix).encode()
+            + migration_metrics.render(self._metrics_prefix).encode()
         )
         return web.Response(body=body, content_type="text/plain")
 

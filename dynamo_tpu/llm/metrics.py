@@ -247,6 +247,76 @@ class SpecDecodeMetrics:
 spec_metrics = SpecDecodeMetrics()
 
 
+class MigrationMetrics:
+    """Live-sequence-migration counters (llm/migration).
+
+    Module-level singleton rendered as Prometheus text and appended to the
+    ``/metrics`` exposition (same pattern as ``spec_metrics``): the worker
+    process updates plain attributes; no registry dependency."""
+
+    def __init__(self):
+        self.started_total = 0       # migrate_out attempts begun
+        self.completed_total = 0     # cutovers that landed
+        self.rolled_back_total = 0   # phase-2 failures (source kept authority)
+        self.aborted_total = 0       # phase-1 aborts (seq finished / target cold)
+        self.migrated_in_total = 0   # commits accepted on the target side
+        self.blocks_total = 0        # KV blocks pushed (phase 1 + final delta)
+        self.bytes_total = 0         # payload bytes pushed
+        self.cutover_pause_ms = RollingWindow(maxlen=512)  # freeze→cutover wall
+
+    def reset(self) -> None:
+        self.__init__()
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "started_total": float(self.started_total),
+            "completed_total": float(self.completed_total),
+            "rolled_back_total": float(self.rolled_back_total),
+            "aborted_total": float(self.aborted_total),
+            "migrated_in_total": float(self.migrated_in_total),
+            "blocks_total": float(self.blocks_total),
+            "bytes_total": float(self.bytes_total),
+            "cutover_pause_ms_p50": self.cutover_pause_ms.percentile(0.5),
+            "cutover_pause_ms_p95": self.cutover_pause_ms.percentile(0.95),
+        }
+
+    def render(self, prefix: str = "dynamo_tpu") -> str:
+        ns = f"{prefix}_migration"
+        lines = []
+
+        def emit(name: str, kind: str, help_: str, value) -> None:
+            lines.append(f"# HELP {ns}_{name} {help_}")
+            lines.append(f"# TYPE {ns}_{name} {kind}")
+            lines.append(f"{ns}_{name} {value}")
+
+        emit("started_total", "counter",
+             "Live migrations begun (source side)", self.started_total)
+        emit("completed_total", "counter",
+             "Live migrations cut over successfully", self.completed_total)
+        emit("rolled_back_total", "counter",
+             "Migrations rolled back in the final-delta phase "
+             "(source stayed authoritative)", self.rolled_back_total)
+        emit("aborted_total", "counter",
+             "Migrations abandoned in the copy phase", self.aborted_total)
+        emit("migrated_in_total", "counter",
+             "Migration commits accepted (target side)",
+             self.migrated_in_total)
+        emit("kv_blocks_total", "counter",
+             "KV blocks pushed by migrations", self.blocks_total)
+        emit("kv_bytes_total", "counter",
+             "KV payload bytes pushed by migrations", self.bytes_total)
+        emit("cutover_pause_ms_p50", "gauge",
+             "Rolling p50 of the freeze-to-cutover pause",
+             round(self.cutover_pause_ms.percentile(0.5), 3))
+        emit("cutover_pause_ms_p95", "gauge",
+             "Rolling p95 of the freeze-to-cutover pause",
+             round(self.cutover_pause_ms.percentile(0.95), 3))
+        return "\n".join(lines) + "\n"
+
+
+migration_metrics = MigrationMetrics()
+
+
 class InflightGuard:
     """Tracks one request: inflight gauge, duration, TTFT, ITL, final status.
 

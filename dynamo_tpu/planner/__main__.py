@@ -6,6 +6,8 @@
   planner sim [--trace poisson|burst|ramp | --trace-file F.jsonl]
               [--rate 2.0] [--duration 120] [--seed 7] [--dry-run]
               [--out report.jsonl] [--smoke]
+  planner supervise --hub H:P --spawn-decode CMD [--spawn-prefill CMD]
+              [--resync 5.0]   # enact planner/targets/* without kube
 
 SLO targets and policy bounds come from the layered config's ``planner``
 section (runtime/config.py: ``DYN_PLANNER__TTFT_P95_MS=1500`` etc.),
@@ -17,6 +19,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import signal
 import sys
 from typing import Optional
 
@@ -71,7 +74,7 @@ async def _run(args) -> None:
         flush=True,
     )
     try:
-        await asyncio.Event().wait()
+        await _wait_for_signal()
     finally:
         await http.stop()
         await planner.stop()
@@ -79,6 +82,52 @@ async def _run(args) -> None:
         if args.kube:
             await actuator.kube.close()
         await runtime.close()
+
+
+async def _supervise(args) -> None:
+    from ..runtime.transports.hub import HubClient
+    from .supervisor import ProcessWorkerPool, Supervisor
+
+    templates = {}
+    if args.spawn_decode:
+        templates["decode"] = args.spawn_decode
+    if args.spawn_prefill:
+        templates["prefill"] = args.spawn_prefill
+    if not templates:
+        raise SystemExit("supervise needs --spawn-decode and/or --spawn-prefill")
+    pool = ProcessWorkerPool(templates, term_grace_s=args.term_grace_s)
+    hub = await HubClient(args.hub).connect()
+    sup = await Supervisor(
+        hub, pool.spawn, pool.stop,
+        pools=sorted(templates), resync_s=args.resync,
+    ).start()
+    print(
+        f"supervisor enacting {sorted(templates)} targets from the hub "
+        "(SIGTERM stops workers — they migrate sequences out themselves)",
+        flush=True,
+    )
+    try:
+        await _wait_for_signal()
+    finally:
+        await sup.stop()
+        await sup.shutdown_workers()
+        await hub.close()
+
+
+async def _wait_for_signal() -> None:
+    # SIGTERM must unwind through the finally blocks above — supervise's
+    # shutdown_workers in particular; the default signal action would kill
+    # the process with its worker subprocesses still running, and a
+    # restarted supervisor's empty ledger would spawn a second fleet on
+    # top of the orphans.
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    await stop.wait()
 
 
 def _sim(args) -> int:
@@ -179,11 +228,27 @@ def main(argv: Optional[list] = None) -> int:
     p_sim.add_argument("--verbose", action="store_true")
     _add_slo_flags(p_sim)
 
+    p_sup = sub.add_parser(
+        "supervise",
+        help="hub-native supervisor: spawn/stop local workers to match "
+        "planner/targets/* (non-kube deployments)",
+    )
+    p_sup.add_argument("--hub", required=True)
+    p_sup.add_argument("--spawn-decode", default=None, dest="spawn_decode",
+                       help="shell command that starts one decode worker")
+    p_sup.add_argument("--spawn-prefill", default=None, dest="spawn_prefill",
+                       help="shell command that starts one prefill worker")
+    p_sup.add_argument("--resync", type=float, default=5.0,
+                       help="periodic target-resync interval (s)")
+    p_sup.add_argument("--term-grace-s", type=float, default=15.0,
+                       dest="term_grace_s",
+                       help="SIGTERM→SIGKILL grace for stopped workers")
+
     args = parser.parse_args(argv)
     if args.cmd == "sim":
         return _sim(args)
     try:
-        asyncio.run(_run(args))
+        asyncio.run(_supervise(args) if args.cmd == "supervise" else _run(args))
     except KeyboardInterrupt:
         pass
     return 0

@@ -160,6 +160,12 @@ class LocalActuator(Actuator):
                         "replicas": action.target,
                         "tick": decision.tick,
                         "reason": action.reason,
+                        # Scale-down actuation hint for the supervisor
+                        # (planner/supervisor.py): migrate live sequences
+                        # off the victim before stopping it, so shrink cost
+                        # is KV-transfer time, not sequence time
+                        # (llm/migration; Llumnix).
+                        "drain": "migrate",
                     },
                 )
             elif action.kind == "flip_role":

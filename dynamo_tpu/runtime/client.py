@@ -421,12 +421,17 @@ class Client(AsyncEngine):
         wid, address, stream = await self._acquire(
             request, worker_id, mode, state, deadline
         )
-        if worker_id is not None:
-            # Direct routing (KV router already chose): no failover target.
-            return stream
+        # Every routed stream gets the guard: it consumes live-migration
+        # ``migrated`` markers (splicing the target's continuation into one
+        # client-visible stream) and resumes seeded streams after mid-flight
+        # crashes.  Direct routing (the KV router already chose a worker)
+        # keeps its no-failover contract for pre-first-token failures —
+        # allow_failover gates only those; the migration splice and seeded
+        # resume are deterministic continuations, safe on any instance.
         return ResponseStream(
-            _FirstTokenFailover(self, request, mode, state, deadline,
-                                wid, address, stream),
+            _StreamGuard(self, request, mode, state, deadline,
+                         wid, address, stream,
+                         allow_failover=worker_id is None),
             request.ctx,
         )
 
@@ -441,15 +446,29 @@ class Client(AsyncEngine):
         return await self.generate(request, worker_id=worker_id)
 
 
-class _FirstTokenFailover:
-    """Stream wrapper: transparent failover until the first token lands.
+class _StreamGuard:
+    """Stream wrapper: failover, live-migration splice, seeded resume.
 
-    A worker that accepted the stream prologue can still die before
-    producing a token; until then nothing user-visible has happened, so the
-    request is safely replayable on another instance.  From the first token
-    on, generation is NOT idempotent (tokens already reached the caller) —
-    failures propagate untouched.  The deadline bounds the wait for every
-    item.
+    Three distinct recovery surfaces, in order of when they can fire:
+
+    - **Before the first token** a worker that accepted the prologue can
+      still die; nothing user-visible has happened, so the request is
+      safely replayable on another instance (bounded attempts shared with
+      the connect phase).  Disabled for direct (KV-router-chosen) routing.
+    - **A ``migrated`` item** mid-stream is the source worker's cutover
+      marker (llm/migration): it carries a self-contained resume request
+      plus the target's address.  The guard re-dispatches there (falling
+      back to any instance — the resume request is deterministic) and
+      splices the continuation in; the caller sees one uninterrupted,
+      token-identical stream and never observes the marker.
+    - **After the first token** a crash is recoverable only when replaying
+      cannot change what the caller already saw: requests with an explicit
+      sampling seed are deterministic, so the guard folds the delivered
+      tokens into a resume request (same shape migration uses) and
+      continues on another worker.  Unseeded requests propagate the error
+      untouched, exactly as before.
+
+    The deadline bounds the wait for every item and every re-dispatch.
     """
 
     def __init__(
@@ -462,6 +481,7 @@ class _FirstTokenFailover:
         wid: int,
         address: str,
         stream: ResponseStream,
+        allow_failover: bool = True,
     ):
         self._client = client
         self._request = request
@@ -471,7 +491,15 @@ class _FirstTokenFailover:
         self._wid = wid
         self._address = address
         self._stream = stream
+        self._allow_failover = allow_failover
         self._got_first = False
+        # Resume bookkeeping: the fed-token stream (base prompt + every
+        # delivered token) and the original prompt length.  Only tracked
+        # for token-shaped requests (dict with token_ids) — other payloads
+        # (KV imports, control calls) can't resume and never migrate.
+        self._all_tokens: Optional[List[int]] = None
+        self._orig_prompt_len = 0
+        self._track_request(request.data)
 
     def __aiter__(self):
         return self
@@ -493,38 +521,194 @@ class _FirstTokenFailover:
                 await self.aclose()
                 raise
             except Exception as e:  # noqa: BLE001 — classified below
-                if self._got_first or not _is_retryable(e):
+                if not _is_retryable(e):
                     raise
-                client = self._client
-                client._breaker(self._address).record_failure()
-                client._evict(self._wid)
-                self._state["tried"].add(self._wid)
-                self._state["attempt"] += 1
-                metrics.retries_total += 1
-                metrics.failovers_total += 1
-                if self._state["attempt"] >= client.retry_policy.max_attempts:
-                    metrics.retries_exhausted_total += 1
+                if self._got_first:
+                    if not await self._try_resume(e):
+                        raise
+                    continue
+                if not self._allow_failover:
                     raise
-                logger.warning(
-                    "request %s: worker %s died before first token (%s); "
-                    "failing over (attempt %d/%d)",
-                    self._request.id,
-                    self._wid,
-                    e,
-                    self._state["attempt"],
-                    client.retry_policy.max_attempts,
-                )
-                delay = client.retry_policy.backoff(self._state["attempt"])
-                if self._deadline is not None:
-                    delay = min(delay, max(self._deadline.remaining(), 0.0))
-                if delay > 0:
-                    await asyncio.sleep(delay)
-                self._wid, self._address, self._stream = await client._acquire(
-                    self._request, None, self._mode, self._state, self._deadline
+                self._record_failure()
+                if not await self._budget_ok(e, "died before first token"):
+                    raise
+                self._wid, self._address, self._stream = (
+                    await self._client._acquire(
+                        self._request, None, self._mode, self._state,
+                        self._deadline,
+                    )
                 )
                 continue
+            if isinstance(item, dict) and item.get("migrated"):
+                await self._splice(item["migrated"])
+                continue
             self._got_first = True
+            if self._all_tokens is not None and isinstance(item, dict):
+                self._all_tokens.extend(item.get("token_ids") or ())
             return item
+
+    # -- recovery helpers ---------------------------------------------------
+
+    def _track_request(self, data: Any) -> None:
+        """(Re)anchor resume tracking on a request payload: its token_ids
+        become the fed-stream base, and its ``resume`` annotation (if any)
+        preserves the original prompt length across re-dispatches."""
+        if not isinstance(data, dict) or not isinstance(
+            data.get("token_ids"), list
+        ):
+            return
+        self._all_tokens = list(data["token_ids"])
+        resume = (data.get("annotations") or {}).get("resume") or {}
+        self._orig_prompt_len = int(
+            resume.get("orig_prompt_len")
+            or self._orig_prompt_len
+            or len(self._all_tokens)
+        )
+
+    def _record_failure(self) -> None:
+        client = self._client
+        client._breaker(self._address).record_failure()
+        client._evict(self._wid)
+        self._state["tried"].add(self._wid)
+
+    async def _budget_ok(self, exc: BaseException, what: str) -> bool:
+        """Count one retry against the shared budget; backoff if granted."""
+        client = self._client
+        self._state["attempt"] += 1
+        metrics.retries_total += 1
+        metrics.failovers_total += 1
+        if self._state["attempt"] >= client.retry_policy.max_attempts:
+            metrics.retries_exhausted_total += 1
+            return False
+        logger.warning(
+            "request %s: worker %s %s (%s); failing over (attempt %d/%d)",
+            self._request.id, self._wid, what, exc,
+            self._state["attempt"], client.retry_policy.max_attempts,
+        )
+        delay = client.retry_policy.backoff(self._state["attempt"])
+        if self._deadline is not None:
+            delay = min(delay, max(self._deadline.remaining(), 0.0))
+        if delay > 0:
+            await asyncio.sleep(delay)
+        return True
+
+    def _resume_request(self) -> Optional[Context]:
+        """Self-contained continuation request from delivered tokens, or
+        None when replay could diverge (no explicit seed)."""
+        data = self._request.data if isinstance(self._request.data, dict) else None
+        if data is None or self._all_tokens is None:
+            return None
+        samp = data.get("sampling_options") or {}
+        if samp.get("seed") is None:
+            # An engine-assigned default seed incorporates the worker's own
+            # engine seed — another instance may re-derive differently, so
+            # the continuation is not guaranteed token-identical.  Refuse.
+            return None
+        resume = dict(data)
+        resume["token_ids"] = list(self._all_tokens)
+        ann = dict(data.get("annotations") or {})
+        prev = dict(ann.get("resume") or {})
+        prev["orig_prompt_len"] = self._orig_prompt_len
+        ann["resume"] = prev
+        resume["annotations"] = ann
+        return Context(resume, self._request.ctx)
+
+    async def _try_resume(self, exc: BaseException) -> bool:
+        """Mid-stream crash: continue a seeded stream on another worker."""
+        request = self._resume_request()
+        if request is None:
+            return False
+        self._record_failure()
+        if not await self._budget_ok(exc, "died mid-stream"):
+            return False
+        self._wid, self._address, self._stream = await self._client._acquire(
+            request, None, self._mode, self._state, self._deadline
+        )
+        self._request = request
+        metrics.stream_resumes_total += 1
+        return True
+
+    async def _splice(self, mig: Dict[str, Any]) -> None:
+        """Cutover marker: re-dispatch the resume request to the migration
+        target and continue the stream there.  A dead target is survivable
+        — the resume request is deterministic, so any instance will do."""
+        req_data = mig.get("request") or {}
+        request = Context(req_data, self._request.ctx)
+        client = self._client
+        wid = mig.get("worker_id")
+        try:
+            # The marker is the source stream's last payload by protocol —
+            # release its mux slot before splicing in the continuation.
+            # NOT aclose(): that would stop_generating() the ctx the resume
+            # request shares, cancelling the continuation it sets up.
+            await self._stream._close_inner()
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — source is done with us either way
+            pass
+        target_addr: Optional[str] = None
+        try:
+            info = client._instances.get(wid) if wid is not None else None
+            if info is not None:
+                engine = client._engine_for(wid, info)
+                target_addr = info["address"]
+            elif mig.get("address") and mig.get("path"):
+                # The target may not be in the instance set (e.g. a static
+                # deployment); dial it directly from the marker's address.
+                engine = RemoteEngine(mig["address"], mig["path"])
+                target_addr = mig["address"]
+            else:
+                raise RemoteEngineError("migration target unspecified")
+            if self._deadline is not None:
+                stream = await self._deadline.bound(
+                    engine.generate(request), "migration splice"
+                )
+            else:
+                stream = await engine.generate(request)
+            # Track the TARGET's identity (even when it is not in the
+            # instance set): a later mid-stream failure must evict and
+            # blacklist the worker that actually failed, not the (healthy)
+            # pre-migration source.
+            self._wid, self._address = wid, target_addr
+        except asyncio.CancelledError:
+            raise
+        except DeadlineExceededError:
+            # Budget ran out mid-splice: the request's problem, not the
+            # target's — no breaker poison, and no fallback dispatch (it
+            # would be bounded by the same exhausted deadline).
+            metrics.deadline_exceeded_total += 1
+            raise
+        except Exception as e:  # noqa: BLE001 — classified below
+            if not _is_retryable(e):
+                raise
+            # Blacklist the TARGET before falling back — self._wid/_address
+            # still name the pre-migration source here, and without the
+            # bookkeeping the picker could hand the same dead target
+            # straight back, burning a second attempt from the shared
+            # budget on a known-dead worker.
+            if wid is not None:
+                client._evict(wid)
+                self._state["tried"].add(wid)
+            if target_addr:
+                client._breaker(target_addr).record_failure()
+            logger.warning(
+                "request %s: migration target %s unreachable (%s); "
+                "resuming on any instance", self._request.id, wid, e,
+            )
+            self._wid, self._address, stream = await client._acquire(
+                request, None, self._mode, self._state, self._deadline
+            )
+        self._stream = stream
+        self._request = request
+        # The target's view of the fed stream is authoritative from here.
+        self._track_request(req_data)
+        # The in-flight request is now the self-contained resolved-seed
+        # resume request — safe on ANY instance, so a direct-routed
+        # stream's no-failover contract no longer applies: if the target
+        # dies before its first post-splice token, fail over rather than
+        # kill a request whose source already released the sequence.
+        self._allow_failover = True
+        metrics.migration_splices_total += 1
 
     async def aclose(self) -> None:
         await self._stream.aclose()

@@ -238,18 +238,36 @@ class Endpoint:
             engine = engine_from_generator(engine)
         server = await runtime.service_server()
         server.register(self.path, engine)
-        info = {
-            "address": server.address,
-            "path": self.path,
-            "worker_id": runtime.worker_id,
-            "metadata": metadata or {},
-        }
+        info = self._instance_info(server.address, metadata)
         key = self.instance_key(runtime.worker_id)
         if lease is None:
             await runtime.register_key(key, info)  # self-healing registration
         else:
             await runtime.hub.kv_put(key, info, lease)
         return ServedEndpoint(self, server)
+
+    def _instance_info(
+        self, address: str, metadata: Optional[Dict[str, Any]]
+    ) -> Dict[str, Any]:
+        return {
+            "address": address,
+            "path": self.path,
+            "worker_id": self.runtime.worker_id,
+            "metadata": metadata or {},
+        }
+
+    async def update_metadata(
+        self, metadata: Optional[Dict[str, Any]] = None
+    ) -> None:
+        """Rewrite this worker's live instance registration with new
+        metadata (e.g. de-advertising a capability mid-drain), keeping the
+        record shape in one place."""
+        runtime = self.runtime
+        server = await runtime.service_server()
+        await runtime.register_key(
+            self.instance_key(runtime.worker_id),
+            self._instance_info(server.address, metadata),
+        )
 
     async def client(self, router_mode: RouterMode = RouterMode.ROUND_ROBIN) -> Client:
         client = Client(self.runtime.hub, self.instance_prefix, router_mode=router_mode)

@@ -326,6 +326,12 @@ class ResilienceMetrics:
         self.deadline_exceeded_total = 0
         self.watch_restarts_total = 0
         self.degraded_prefills_total = 0
+        # Live-migration stream splices (client consumed a ``migrated``
+        # marker and re-dispatched to the target worker).
+        self.migration_splices_total = 0
+        # Mid-stream crash recoveries: a seeded request's stream was
+        # reconstructed from delivered tokens and resumed elsewhere.
+        self.stream_resumes_total = 0
         self.admission_shed: Dict[str, int] = {}
         self.breaker_transitions: Dict[Tuple[str, str], int] = {}
         self._breakers: Dict[str, CircuitBreaker] = {}
@@ -367,6 +373,13 @@ class ResilienceMetrics:
         counter("degraded_prefills_total",
                 "Disagg remote prefills degraded to local",
                 self.degraded_prefills_total)
+        counter("migration_splices_total",
+                "Streams spliced to a migration target mid-flight",
+                self.migration_splices_total)
+        counter("stream_resumes_total",
+                "Seeded streams resumed on another worker after a "
+                "mid-stream crash",
+                self.stream_resumes_total)
         lines.append(f"# HELP {ns}_admission_shed_total Requests shed at admission")
         lines.append(f"# TYPE {ns}_admission_shed_total counter")
         for code, n in sorted(self.admission_shed.items()):

@@ -10,6 +10,10 @@ python -m tools.dynalint dynamo_tpu --json
 echo "== planner sim smoke (closed-loop acceptance, no TPU) =="
 env JAX_PLATFORMS=cpu python -m dynamo_tpu.planner sim --smoke
 
+echo "== live-migration suite (exact-stream + drain acceptance) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_migration.py -q -m migration \
+  -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
