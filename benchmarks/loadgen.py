@@ -65,15 +65,23 @@ def _prompt_tokens(i: int, isl: int, vocab: int) -> List[int]:
 
 
 async def _one(session: ClientSession, url: str, model: str, prompt: List[int],
-               osl: int) -> RequestResult:
+               osl: int, adapter: str = None, schema: dict = None) -> RequestResult:
+    # Multi-tenant replay (llm/tenancy): an ``adapter`` trace field routes
+    # the request to that served model name (LoRA); a ``schema`` field adds
+    # an OpenAI response_format constraint (grammar-masked decoding).
     payload = {
-        "model": model,
+        "model": adapter or model,
         "prompt": prompt,
         "stream": True,
         "max_tokens": osl,
         "temperature": 0.0,
         "nvext": {"ignore_eos": True},
     }
+    if schema is not None:
+        payload["response_format"] = {
+            "type": "json_schema",
+            "json_schema": {"name": "trace", "schema": schema},
+        }
     t0 = time.perf_counter()
     ttft = 0.0
     last = t0
@@ -193,7 +201,9 @@ async def _run_trace(url: str, model: str, arrivals, vocab: int) -> dict:
             await asyncio.sleep(delay)
         indexed.append(
             (i, await _one(session, url, model,
-                           _prompt_tokens(i, a.isl, vocab), a.osl))
+                           _prompt_tokens(i, a.isl, vocab), a.osl,
+                           adapter=getattr(a, "adapter", None),
+                           schema=getattr(a, "schema", None)))
         )
 
     async with ClientSession(timeout=timeout) as session:

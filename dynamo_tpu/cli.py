@@ -203,10 +203,26 @@ async def _run(args) -> None:
         engine = RecordingEngine(engine, recorder)
         print(f"recording streams to {args.record}", flush=True)
 
+    # One grammar compile cache for EVERY core-level pipeline on this
+    # tokenizer (base model and adapter aliases alike): constraint →
+    # automaton indexing is the expensive step (llm/tenancy/grammar.py),
+    # and per-pipeline caches would recompile the same schema per name.
+    grammar_compiler = None
+    if level == "core":
+        from .llm.tenancy.grammar import GrammarCompiler
+
+        grammar_compiler = GrammarCompiler(tokenizer)
+
     def _console_pipeline():
         if level == "core":
             return build_pipeline(
-                [OpenAIPreprocessor(tokenizer, args.model), Backend(tokenizer)],
+                [
+                    OpenAIPreprocessor(
+                        tokenizer, args.model,
+                        grammar_compiler=grammar_compiler,
+                    ),
+                    Backend(tokenizer),
+                ],
                 engine,
             )
         return engine
@@ -216,7 +232,33 @@ async def _run(args) -> None:
         pipeline = _console_pipeline()
         service.models.add_chat_model(args.model, pipeline)
         service.models.add_completion_model(args.model, pipeline)
-        print(f"serving {args.model!r} on http://{args.host}:{args.port}", flush=True)
+        # LoRA adapters (llm/tenancy) serve as additional MODEL NAMES on
+        # the same resident engine: each gets its own preprocessor that
+        # stamps the adapter id + KV salt (one grammar compile cache shared
+        # across all of them — same tokenizer).
+        adapters = (
+            engine.adapter_names() if hasattr(engine, "adapter_names") else []
+        )
+        if adapters and level == "core":
+            for name in adapters:
+                apipe = build_pipeline(
+                    [
+                        OpenAIPreprocessor(
+                            tokenizer, name, adapter=name,
+                            grammar_compiler=grammar_compiler,
+                        ),
+                        Backend(tokenizer),
+                    ],
+                    engine,
+                )
+                service.models.add_chat_model(name, apipe)
+                service.models.add_completion_model(name, apipe)
+        print(
+            f"serving {args.model!r}"
+            + (f" + adapters {adapters}" if adapters else "")
+            + f" on http://{args.host}:{args.port}",
+            flush=True,
+        )
         await service.run()
     elif inp == "none":
         # Start the engine with no input surface (reference Input::None,
@@ -424,6 +466,21 @@ class WorkerRoles:
             tokenizer=self.tokenizer_spec,
             kv_block_size=kv_block_size,
         )
+        # LoRA adapters (llm/tenancy) register as additional model names on
+        # the SAME endpoint: the frontend's watcher builds adapter-stamping
+        # pipelines for them, tenant KV salting keeps router overlap exact,
+        # and the engine's served-model allowlist 404s anything else.
+        for adapter in (
+            engine.adapter_names() if hasattr(engine, "adapter_names") else []
+        ):
+            await register_model(
+                runtime,
+                adapter,
+                endpoint.path,
+                tokenizer=self.tokenizer_spec,
+                kv_block_size=kv_block_size,
+                lora={"adapter": adapter, "base": args.model},
+            )
         self._handles["decode"] = h
 
     async def stop_decode(self) -> None:
@@ -470,6 +527,14 @@ class WorkerRoles:
         await self.runtime.unregister_key(
             f"models/{self.args.model}/{self.runtime.worker_id}"
         )
+        for adapter in (
+            self.engine.adapter_names()
+            if hasattr(self.engine, "adapter_names")
+            else []
+        ):
+            await self.runtime.unregister_key(
+                f"models/{adapter}/{self.runtime.worker_id}"
+            )
         self.migratable = None
 
     # -- prefill role -------------------------------------------------------
@@ -807,6 +872,25 @@ def main(argv: Optional[list] = None) -> None:
     p_run.add_argument(
         "--spec-ngram-max", type=int, default=None, dest="spec_ngram_max",
         help="longest suffix n-gram tried by the proposer",
+    )
+    p_run.add_argument(
+        "--lora",
+        action="append",
+        default=None,
+        metavar="NAME=SPEC",
+        help="serve a LoRA adapter under model name NAME (repeatable; "
+        "llm/tenancy).  SPEC is a local PEFT directory, a HF repo id, or "
+        "'random[:seed]' for a synthetic adapter.  Requests select the "
+        "adapter via the OpenAI 'model' field; unknown names 404.",
+    )
+    p_run.add_argument(
+        "--lora-max-adapters", type=int, default=None,
+        dest="lora_max_adapters",
+        help="resident device adapter slots (distinct adapters per batch)",
+    )
+    p_run.add_argument(
+        "--lora-rank", type=int, default=None, dest="lora_rank",
+        help="per-slot rank ceiling (smaller-rank adapters zero-pad up)",
     )
     p_run.add_argument(
         "--record", default=None,

@@ -2,7 +2,7 @@
 scheduler, and the jitted device step loop (SURVEY.md §7 stage 4 — the piece
 the reference outsources to vLLM/sglang)."""
 
-from .config import EngineConfig, SpecDecodeConfig  # noqa: F401
+from .config import EngineConfig, LoraConfig, SpecDecodeConfig  # noqa: F401
 from .kv_manager import KvBlockManager  # noqa: F401
 from .scheduler import Scheduler, SequenceState  # noqa: F401
 
@@ -54,6 +54,7 @@ def build_tpu_engine(args):
             ModelConfig.from_hf_config(cfg_json, name=cfg_json.get("_name", "custom"))
         ).name
 
+    lora_section, lora_adapters = _lora_section(args)
     cfg = EngineConfig(
         model=arch or "debug-tiny",
         block_size=getattr(args, "block_size", 16),
@@ -74,8 +75,11 @@ def build_tpu_engine(args):
         checkpoint_path=getattr(args, "checkpoint", None),
         attn_impl=getattr(args, "attn_impl", "auto"),
         spec_decode=_spec_decode_section(args),
+        lora=lora_section,
     )
-    return TpuEngine(cfg)
+    engine = TpuEngine(cfg)
+    _load_adapters(engine, lora_adapters, getattr(args, "model", None))
+    return engine
 
 
 def _spec_decode_section(args) -> dict:
@@ -93,3 +97,61 @@ def _spec_decode_section(args) -> dict:
     if getattr(args, "spec_ngram_min", None) is not None:
         section["ngram_min"] = int(args.spec_ngram_min)
     return section
+
+
+def _lora_section(args):
+    """Layered multi-LoRA section (llm/tenancy): RuntimeConfig ``lora``
+    (file / DYN_LORA__* env) under explicit --lora* CLI flags.  Returns
+    ``(LoraConfig-kwargs, {name: spec})`` — the adapters map merges the
+    config section's ``adapters`` with every repeatable ``--lora NAME=SPEC``
+    flag, and any adapter at all implies ``enable``."""
+    from ..runtime.config import RuntimeConfig
+
+    section = dict(RuntimeConfig.from_layers().lora)
+    adapters = dict(section.pop("adapters", None) or {})
+    for spec in getattr(args, "lora", None) or []:
+        name, sep, path = spec.partition("=")
+        if not sep or not name or not path:
+            raise SystemExit(f"--lora expects NAME=PATH, got {spec!r}")
+        adapters[name] = path
+    if getattr(args, "lora_max_adapters", None) is not None:
+        section["max_adapters"] = int(args.lora_max_adapters)
+    if getattr(args, "lora_rank", None) is not None:
+        section["rank"] = int(args.lora_rank)
+    if adapters:
+        section["enable"] = True
+    return section, adapters
+
+
+def _load_adapters(engine, adapters: dict, base_model) -> None:
+    """Host-register the configured adapters (no restart needed later —
+    this is just the boot-time convenience path).  ``random[:seed]`` specs
+    build synthetic adapters (tests / loadgen multi-tenant replay); other
+    specs resolve like checkpoints (local dir or HF repo —
+    models/hub.resolve_adapter).  On any LoRA-enabled engine the
+    served-model allowlist is pinned to base+adapters so unknown names 404
+    (llm/tenancy satellite) instead of silently running the base model —
+    also when NO boot adapters exist (register_adapter adds to the pinned
+    set later): without the allowlist the engine's only fallback identity
+    is cfg.model, the ARCHITECTURE name, and a served name that differs
+    from it would 404 all base traffic."""
+    if adapters:
+        from ..llm.tenancy.lora import LoraAdapter, load_lora_adapter
+        from ..models.hub import resolve_adapter
+
+        for name, spec in sorted(adapters.items()):
+            if isinstance(spec, str) and spec.startswith("random"):
+                _, _, seed = spec.partition(":")
+                adapter = LoraAdapter.random(
+                    engine.model_config,
+                    name,
+                    rank=min(4, engine.cfg.lora.rank),
+                    seed=int(seed or 0),
+                )
+            else:
+                adapter = load_lora_adapter(
+                    resolve_adapter(spec), engine.model_config, name=name
+                )
+            engine.register_adapter(adapter)
+    if base_model and (adapters or engine.cfg.lora.enable):
+        engine.set_served_models([base_model, *adapters])

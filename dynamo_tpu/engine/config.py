@@ -102,6 +102,51 @@ class SpecDecodeConfig:
 
 
 @dataclass
+class LoraConfig:
+    """Batched multi-LoRA serving (llm/tenancy/lora.py — S-LoRA).
+
+    ``max_adapters`` resident DEVICE slots of rank ceiling ``rank`` are
+    allocated as fixed-shape banks at engine init, so registering /
+    promoting / evicting adapters never changes a compiled program's shape
+    — hot-swap is a host→device column write.  The host-side registry can
+    hold arbitrarily many adapters; only the resident set is bounded.
+    """
+
+    enable: bool = False
+    # Resident device slots (concurrent distinct adapters in one batch).
+    max_adapters: int = 4
+    # Per-slot rank ceiling; adapters with smaller rank zero-pad up.
+    rank: int = 8
+    # How long acquire() waits for a pinned slot to free before failing
+    # the request (all residents actively serving sequences).
+    promote_timeout_s: float = 30.0
+
+    def __post_init__(self) -> None:
+        if self.max_adapters < 1:
+            raise ValueError("lora max_adapters must be >= 1")
+        if self.rank < 1:
+            raise ValueError("lora rank must be >= 1")
+
+    @classmethod
+    def normalize(cls, v: Any) -> "LoraConfig":
+        """Accept the section in any layered-config shape (see
+        SpecDecodeConfig.normalize)."""
+        if v is None:
+            return cls()
+        if isinstance(v, cls):
+            return v
+        if isinstance(v, bool):
+            return cls(enable=v)
+        if isinstance(v, dict):
+            known = set(cls.__dataclass_fields__)
+            bad = set(v) - known
+            if bad:
+                raise ValueError(f"unknown lora keys: {sorted(bad)}")
+            return cls(**v)
+        raise ValueError(f"bad lora section: {v!r}")
+
+
+@dataclass
 class EngineConfig:
     model: str = "debug-tiny"
     block_size: int = 16
@@ -187,6 +232,10 @@ class EngineConfig:
     # dict / bool from layered configs).  Engine-level default; requests
     # opt out per call via sampling_options.spec_decode=false (nvext).
     spec_decode: Any = None
+    # Batched multi-LoRA section (LoraConfig; accepts dict/bool).  Requests
+    # select an adapter via the OpenAI ``model`` field; rows without one run
+    # the base model unchanged.
+    lora: Any = None
 
     def __post_init__(self) -> None:
         if not self.batch_buckets:
@@ -198,6 +247,7 @@ class EngineConfig:
         if self.cache_dtype is None:
             self.cache_dtype = self.dtype
         self.spec_decode = SpecDecodeConfig.normalize(self.spec_decode)
+        self.lora = LoraConfig.normalize(self.lora)
         if self.weight_quant not in (None, "int8"):
             # One check covering every load path (checkpoint / random-init /
             # externally supplied params).

@@ -158,6 +158,15 @@ class TpuEngine(
         # (set for the duration of each _decode_pipeline run); live
         # migration's freeze waits until its sequence leaves this set.
         self._pipeline_members: set = set()
+        # Multi-tenancy (llm/tenancy): LoRA adapter registry (None = LoRA
+        # disabled), optional served-model allowlist (unknown names →
+        # ModelNotFoundError → 404 at the edge), and the deserialized
+        # grammar-automaton LRU (requests ship automata by content hash).
+        self._lora_registry = None
+        self._served_models: Optional[set] = None
+        from collections import OrderedDict as _OD
+
+        self._grammar_lru: "Any" = _OD()
 
         # --- device state -------------------------------------------------
         mesh_cfg = MeshConfig(dp=cfg.dp, tp=cfg.tp, ep=cfg.ep, sp=cfg.sp)
@@ -204,6 +213,27 @@ class TpuEngine(
             from ..models.quant import fuse_projections
 
             params = fuse_projections(params)
+        if cfg.lora.enable:
+            # Fixed-shape multi-LoRA device banks (llm/tenancy/lora.py):
+            # R resident slots × rank-r A/B factors per attention
+            # projection, zero-initialized (an all-zero slot is exactly the
+            # base model).  Added AFTER quantize/fuse so the base tree is
+            # final — adapters are merge-free and never touch it.  The
+            # leaves live in params["layers"] so the layer scan slices them
+            # per layer like any other stacked weight.
+            if self.mesh is not None:
+                raise ValueError(
+                    "lora.enable requires a single-shard engine in this "
+                    "build (tp/dp/ep/sp == 1): the adapter banks have no "
+                    "PartitionSpecs yet"
+                )
+            from ..llm.tenancy.lora import bank_leaves
+
+            dt = jnp.dtype(cfg.dtype)
+            for name, leaf in bank_leaves(
+                self.model_config, cfg.lora.max_adapters, cfg.lora.rank
+            ).items():
+                params["layers"][name] = jnp.asarray(leaf, dt)
         cache = PagedKVCache.create(
             self.model_config,
             cfg.num_blocks,
@@ -246,11 +276,16 @@ class TpuEngine(
         # sharding).  Arrays fold into the forward algebraically
         # (models/llama.py), so they stay fully traced.
         kv_scale = self.kv_scale
+        # Static LoRA bank geometry (0 = disabled): captured by the jitted
+        # closures, so constrained/LoRA rows run the SAME compiled programs
+        # as base rows — the whole point of the per-row design.
+        lora_rank = cfg.lora.rank if cfg.lora.enable else 0
+        self._lora_rank = lora_rank
 
         def _step(params, cache, rb, samp):
             logits, cache = forward_ragged(
                 params, model_config, rb, cache, attn_impl=attn_impl,
-                mesh=mesh, kv_scale=kv_scale,
+                mesh=mesh, kv_scale=kv_scale, lora_rank=lora_rank,
             )
             out = sample_tokens(
                 logits,
@@ -263,6 +298,8 @@ class TpuEngine(
                 samp.pres_penalty,
                 samp.counts,
                 samp.need_logprobs,
+                samp.mask_words,
+                samp.any_mask,
             )
             return out, cache
 
@@ -302,10 +339,14 @@ class TpuEngine(
                     page_indices=tables,
                     cu_q_lens=cu,
                     num_seqs=num,
+                    # Decode rows: one token per row, so the per-row slots
+                    # (llm/tenancy multi-LoRA) are the per-token slots.
+                    adapter_slots=samp.adapter_slots,
                 )
                 logits, cache = forward_ragged(
                     params, model_config, rb, cache, attn_impl=attn_impl,
                     mesh=mesh, kv_scale=kv_scale, decode=True,
+                    lora_rank=lora_rank,
                 )
                 out = sample_tokens(
                     logits,
@@ -318,6 +359,8 @@ class TpuEngine(
                     samp.pres_penalty,
                     counts,
                     samp.need_logprobs,
+                    samp.mask_words,
+                    samp.any_mask,
                 )
                 nxt = out.tokens
                 counts = counts.at[jnp.arange(S), nxt].add(
@@ -396,13 +439,33 @@ class TpuEngine(
         self._zero_counts = jnp.zeros(
             (S, self.model_config.vocab_size), jnp.int16
         )
+        # Cached all-zeros grammar-mask buffer ([S, ceil(V/32)] packed
+        # bits): rides every unconstrained step cond-skipped, so the
+        # common path pays no H2D for the tenancy machinery.
+        self._mask_w = (self.model_config.vocab_size + 31) // 32
+        self._zero_mask = jnp.zeros((S, self._mask_w), jnp.uint32)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            self._zero_counts = jax.device_put(
-                self._zero_counts, NamedSharding(self.mesh, PartitionSpec())
-            ) if jax.process_count() == 1 else self._prep(
-                np.zeros((S, self.model_config.vocab_size), np.int16)
+            if jax.process_count() == 1:
+                rep = NamedSharding(self.mesh, PartitionSpec())
+                self._zero_counts = jax.device_put(self._zero_counts, rep)
+                self._zero_mask = jax.device_put(self._zero_mask, rep)
+            else:
+                self._zero_counts = self._prep(
+                    np.zeros((S, self.model_config.vocab_size), np.int16)
+                )
+                self._zero_mask = self._prep(
+                    np.zeros((S, self._mask_w), np.uint32)
+                )
+        if cfg.lora.enable:
+            from ..llm.tenancy.lora import AdapterRegistry
+
+            self._lora_registry = AdapterRegistry(
+                cfg.lora.max_adapters,
+                cfg.lora.rank,
+                self._lora_apply,
+                promote_timeout_s=cfg.lora.promote_timeout_s,
             )
 
     def _calibrate_kv_scales(self, params) -> np.ndarray:
@@ -614,6 +677,9 @@ class TpuEngine(
                 page_indices=np.zeros((S, PP), np.int32),
                 cu_q_lens=cu,
                 num_seqs=np.asarray([1], np.int32),
+                adapter_slots=(
+                    np.full((T,), -1, np.int32) if self._lora_rank else None
+                ),
             )
             out, self.cache = self._step_fn(
                 self.params, self.cache, self._prep(rb), self._prep(samp)
@@ -670,6 +736,116 @@ class TpuEngine(
                 t *= 2
         return self.compile_counts()
 
+    # ----------------------------------------------------------- tenancy API
+    def register_adapter(self, adapter) -> None:
+        """Host-register a LoraAdapter (llm/tenancy/lora.py) — no engine
+        restart, no recompile; promotion to a device slot happens lazily on
+        first request."""
+        if self._lora_registry is None:
+            raise RuntimeError(
+                "LoRA serving is disabled (EngineConfig.lora.enable)"
+            )
+        self._lora_registry.register(adapter, self.model_config)
+        if self._served_models is not None:
+            self._served_models.add(adapter.name)
+
+    def unregister_adapter(self, name: str) -> None:
+        if self._lora_registry is not None:
+            self._lora_registry.unregister(name)
+            # Keep the allowlist in lockstep: a name left behind would let
+            # requests for the removed adapter silently run the base model.
+            if self._served_models is not None:
+                self._served_models.discard(name)
+
+    def adapter_names(self) -> List[str]:
+        return self._lora_registry.names() if self._lora_registry else []
+
+    def set_served_models(self, names) -> None:
+        """Optional allowlist of model names this engine serves (base +
+        adapters).  When set, a request naming anything else fails with
+        ModelNotFoundError (the 404 model_not_found body at the edge)
+        instead of silently running the base model."""
+        self._served_models = set(names) if names is not None else None
+
+    async def _lora_apply(self, slot: int, adapter) -> None:
+        """Registry promotion hook: write one slot's (rank-padded) factors
+        into the device banks.  Functional .at[].set under the device lock —
+        in-flight dispatches keep their old param tree; the registry
+        guarantees the slot has no live rows."""
+        from ..llm.tenancy.lora import LORA_TARGETS, padded_factors
+
+        r = self.cfg.lora.rank
+        lo, hi = slot * r, (slot + 1) * r
+
+        def run():
+            layers = self.params["layers"]
+            for tgt in LORA_TARGETS:
+                a, b = padded_factors(adapter, self.model_config, tgt, r)
+                dt = layers[f"lora_a_{tgt}"].dtype
+                layers[f"lora_a_{tgt}"] = (
+                    layers[f"lora_a_{tgt}"].at[:, :, lo:hi].set(jnp.asarray(a, dt))
+                )
+                layers[f"lora_b_{tgt}"] = (
+                    layers[f"lora_b_{tgt}"].at[:, lo:hi, :].set(jnp.asarray(b, dt))
+                )
+
+        async with self._device_lock:
+            await asyncio.to_thread(run)
+
+    def _grammar_automaton(self, g: Dict[str, Any]):
+        """Deserialize (or LRU-hit) a request's token-mask automaton and fix
+        its mask geometry to this engine's vocab/eos."""
+        from ..llm.tenancy.grammar import TokenMaskAutomaton
+
+        key = g.get("hash")
+        automaton = self._grammar_lru.pop(key, None) if key else None
+        if automaton is None:
+            automaton = TokenMaskAutomaton.from_dict(g)
+        self._grammar_lru[automaton.hash] = automaton  # LRU refresh/insert
+        while len(self._grammar_lru) > 32:
+            self._grammar_lru.pop(next(iter(self._grammar_lru)))
+        automaton.set_mask_context(
+            self.model_config.vocab_size, self.model_config.eos_token_ids
+        )
+        return automaton
+
+    def _resolve_adapter(self, pre: PreprocessedRequest) -> Optional[str]:
+        """Adapter name for this request, or None for the base model.
+        Raises ModelNotFoundError for names nobody serves (satellite: never
+        silently fall through to the base model)."""
+        from ..llm.metrics import tenancy_metrics
+        from ..llm.protocols import ModelNotFoundError
+
+        name = pre.annotations.get("adapter")
+        if not isinstance(name, str) or not name:
+            name = None
+        if name is None and pre.model:
+            if self._lora_registry is not None and self._lora_registry.has(
+                pre.model
+            ):
+                name = pre.model
+            elif self._served_models is not None:
+                if pre.model not in self._served_models:
+                    tenancy_metrics.adapter_not_found_total += 1
+                    raise ModelNotFoundError(pre.model)
+            elif (
+                self._lora_registry is not None
+                and pre.model != self.cfg.model
+            ):
+                # LoRA-enabled engines serve many logical models by NAME, so
+                # a name that is neither the base model nor a registered
+                # adapter is a routing mistake — fail it rather than
+                # silently running the base model.  (LoRA-less engines keep
+                # the historical behaviour: the model field is advisory.)
+                tenancy_metrics.adapter_not_found_total += 1
+                raise ModelNotFoundError(pre.model)
+        if name is not None and (
+            self._lora_registry is None or not self._lora_registry.has(name)
+        ):
+            tenancy_metrics.adapter_not_found_total += 1
+            raise ModelNotFoundError(name)
+        return name
+
     # ------------------------------------------------------------ public API
     async def generate(self, request: Context) -> ResponseStream:
         if self._closed:
@@ -680,17 +856,40 @@ class TpuEngine(
                 f"prompt length {len(pre.token_ids)} exceeds max_model_len "
                 f"{self.cfg.max_model_len}"
             )
+        # Multi-tenancy resolution (llm/tenancy) BEFORE admission: the
+        # adapter decides the KV salt, which must root the block-hash chain
+        # from the very first sealed block.
+        adapter = self._resolve_adapter(pre)
+        automaton = None
+        if pre.grammar:
+            from ..llm.metrics import tenancy_metrics
+
+            automaton = self._grammar_automaton(pre.grammar)
+            tenancy_metrics.grammar_requests_total += 1
+        if adapter is not None:
+            from ..llm.tenancy.lora import kv_salt_for_adapter
+
+            pre.annotations.setdefault("kv_salt", kv_salt_for_adapter(adapter))
+        # Tenant salt (llm/tenancy): every pre-admission KV preparation
+        # below hashes with it, so a tenant request can only ever see —
+        # and seal — blocks under its own chain.
+        salt = pre.annotations.get("kv_salt") or None
         self._ensure_loop()
         prepared = 0
         if self.host_kv is not None and len(self.host_kv):
             # Pull any evicted prefix blocks back from host RAM BEFORE
             # admission, so the scheduler sees them as prefix-cache hits
-            # (the reference's restore-ahead-of-prefill TTFT win).
-            prepared += await self._restore_from_host(list(pre.token_ids))
+            # (the reference's restore-ahead-of-prefill TTFT win).  The
+            # host tier indexes blocks by the (salted) hashes they sealed
+            # under, so tenant restores hit exactly their own blocks.
+            prepared += await self._restore_from_host(
+                list(pre.token_ids), salt
+            )
         if (
             self._sp_fn is not None
             and len(pre.token_ids) >= self.cfg.sp_prefill_min
             and jax.process_count() == 1
+            and salt is None
         ):
             # Long prompt: one sequence-parallel whole-prompt pass seals the
             # complete blocks ahead of admission (ring attention over "sp").
@@ -702,6 +901,35 @@ class TpuEngine(
             # plane (the reference's disagg split, docs/architecture.md).
             prepared += await self._sp_prefill(list(pre.token_ids))
         seq = SequenceState.from_request(request.id, pre, self.cfg)
+        if automaton is not None:
+            seq.grammar = automaton
+            # Resumed sequences (llm/migration splice, seeded crash
+            # recovery) fold already-delivered OUTPUT into the prompt: the
+            # automaton state is the start state advanced through those
+            # tokens (every delivered token was mask-admissible, so the
+            # walk only fails on a corrupt resume — a request error).
+            state: Optional[int] = automaton.start
+            for t in seq.prompt[seq.orig_prompt_len:]:
+                state = automaton.advance(state, int(t))
+                if state is None:
+                    raise ValueError(
+                        "resume stream violates its grammar constraint"
+                    )
+            seq.grammar_state = state
+        if adapter is not None:
+            from ..llm.metrics import tenancy_metrics
+            from ..llm.protocols import ModelNotFoundError
+
+            seq.adapter = adapter
+            try:
+                # Resolve to a resident device slot (async H2D promotion,
+                # LRU eviction of idle residents).  The ref pins the slot
+                # until _finish — a running row's slot is never rewritten.
+                seq.adapter_slot = await self._lora_registry.acquire(adapter)
+            except KeyError:
+                tenancy_metrics.adapter_not_found_total += 1
+                raise ModelNotFoundError(adapter) from None
+            tenancy_metrics.adapter_requests_total += 1
         if prepared:
             # PIN the just-sealed prefix until admission: the sealed blocks
             # sit in the reuse pool, where a concurrent request's
@@ -709,7 +937,7 @@ class TpuEngine(
             # matches — silently wasting the whole sp/restore pass.  The
             # scheduler releases the pin when admission lands (or the
             # request is rejected/cancelled).
-            seq.pin_ids = self._pin_prefix(list(pre.token_ids))
+            seq.pin_ids = self._pin_prefix(list(pre.token_ids), salt)
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[request.id] = queue
         self._contexts[request.id] = request.ctx
@@ -779,11 +1007,15 @@ class TpuEngine(
 
 
 
-    def estimate_prefix_hit(self, token_ids: List[int]) -> int:
-        """Tokens of ``token_ids`` already resident locally (router input)."""
+    def estimate_prefix_hit(
+        self, token_ids: List[int], salt: Optional[str] = None
+    ) -> int:
+        """Tokens of ``token_ids`` already resident locally (router input).
+        ``salt`` must match the requesting tenant's (llm/tenancy) or the
+        estimate is structurally zero."""
         from ..tokens import hash_token_blocks
 
-        blocks = hash_token_blocks(token_ids, self.cfg.block_size)
+        blocks = hash_token_blocks(token_ids, self.cfg.block_size, salt)
         return len(self.kv.match_prefix(blocks)) * self.cfg.block_size
 
     # -------------------------------------------------------------- the loop
@@ -914,8 +1146,27 @@ class TpuEngine(
                     prefill_items = [
                         it for it in plan.items if it[1] < len(it[0].prompt)
                     ]
-                    if decode_items and prefill_items:
-                        await self._run_unified(StepPlan(prefill_items))
+                    # Grammar-constrained decode rows (llm/tenancy) never
+                    # burst — their logit mask advances host-side per
+                    # token — so they ride the unified prefill steps
+                    # instead (one token per step, mask rebuilt each time)
+                    # while unconstrained rows keep the fused-burst cadence.
+                    burstable = [
+                        it for it in decode_items if it[0].grammar is None
+                    ]
+                    step_extra = [
+                        it
+                        for it in decode_items
+                        if it[0].grammar is not None
+                    ]
+                    # Without prefill in the plan this branch would starve
+                    # the burstable rows (only the periodic burst advances
+                    # them): fall through to the plain unified step instead,
+                    # which gives EVERY row one token per round trip.
+                    if burstable and prefill_items:
+                        await self._run_unified(
+                            StepPlan(prefill_items + step_extra)
+                        )
                         self._chunks_since_burst += 1
                         if (
                             self._chunks_since_burst
@@ -928,7 +1179,7 @@ class TpuEngine(
                             # tokens its cutover snapshot lacks.
                             burst_items = [
                                 it
-                                for it in decode_items
+                                for it in burstable
                                 if not it[0].finished and not it[0].frozen
                             ]
                             if burst_items and not await self._decode_burst(

@@ -113,6 +113,12 @@ class MigrationMixin:
             deadline_s=(
                 max(deadline.remaining(), 0.0) if deadline is not None else None
             ),
+            # Tenant identity (llm/tenancy): the adapter + KV salt travel
+            # with the sequence; the grammar automaton ships serialized and
+            # the target re-derives its state from the resumed tokens.
+            adapter=seq.adapter,
+            kv_salt=seq.kv_salt,
+            grammar=seq.grammar.to_dict() if seq.grammar is not None else None,
         )
 
     async def freeze_sequence(
@@ -168,6 +174,16 @@ class MigrationMixin:
         if seq is not None:
             seq.finished = True
             seq.frozen = False
+            # Cutover bypasses pipeline._finish, so the adapter-slot ref
+            # (llm/tenancy) must drop here too or a migrated-out LoRA
+            # sequence pins its slot on the source forever.
+            if (
+                self._lora_registry is not None
+                and seq.adapter is not None
+                and not seq.adapter_released
+            ):
+                seq.adapter_released = True
+                self._lora_registry.release(seq.adapter)
             self.scheduler.remove(seq)
         queue = self._queues.get(request_id)
         if queue is not None:

@@ -10,7 +10,7 @@ from __future__ import annotations
 import asyncio
 import time
 import logging
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -96,7 +96,9 @@ class HostOffloadMixin:
                 },
             )
 
-    async def _sp_prefill(self, token_ids: List[int]) -> int:
+    async def _sp_prefill(
+        self, token_ids: List[int], salt: Optional[str] = None
+    ) -> int:
         """Whole-prompt sequence-parallel prefill: compute the prompt's KV in
         one ring-attention pass over the "sp" mesh axis and seal its complete
         blocks into the paged cache (released to the reuse pool), so
@@ -109,7 +111,7 @@ class HostOffloadMixin:
         cfg = self.cfg
         bs = cfg.block_size
         n_complete = len(token_ids) // bs
-        blocks = hash_token_blocks(token_ids, bs)
+        blocks = hash_token_blocks(token_ids, bs, salt)
         resident = len(self.kv.match_prefix(blocks))
         if resident >= n_complete or n_complete == 0:
             return 0
@@ -142,7 +144,7 @@ class HostOffloadMixin:
         if pad != n_new:
             pages = jnp.pad(pages, ((0, 0), (0, pad - n_new), (0, 0), (0, 0), (0, 0)))
         covered = await self.inject_blocks_from_device(
-            token_ids, pages, n_new, start_block=resident
+            token_ids, pages, n_new, start_block=resident, salt=salt
         )
         if covered:
             logger.info(
@@ -151,15 +153,20 @@ class HostOffloadMixin:
             )
         return covered
 
-    async def _restore_from_host(self, token_ids: List[int]) -> int:
+    async def _restore_from_host(
+        self, token_ids: List[int], salt: Optional[str] = None
+    ) -> int:
         """Scatter host-tier blocks beyond the HBM-resident prefix back into
         the device cache (sealed + released to the reuse pool), so admission
-        sees them as ordinary prefix-cache hits.  Returns restored blocks."""
+        sees them as ordinary prefix-cache hits.  Returns restored blocks.
+        ``salt`` (llm/tenancy): the host tier indexes blocks by the SALTED
+        sequence hashes they sealed under, so tenant restores look up with
+        the tenant's salt — and can never resurrect another tenant's KV."""
         if self.host_kv is None:
             return 0
         from ..tokens import hash_token_blocks
 
-        blocks = hash_token_blocks(token_ids, self.cfg.block_size)
+        blocks = hash_token_blocks(token_ids, self.cfg.block_size, salt)
         resident = len(self.kv.match_prefix(blocks))
         run: List[Tuple[Any, np.ndarray]] = []
         for tb in blocks[resident:]:

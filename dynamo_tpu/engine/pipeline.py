@@ -21,6 +21,7 @@ logger = logging.getLogger(__name__)
 
 from collections import deque
 
+from ..llm.metrics import tenancy_metrics
 from ..llm.protocols import FinishReason, LLMEngineOutput
 from ..ops.sampling import SamplingParams
 from .scheduler import SequenceState, StepPlan
@@ -38,6 +39,7 @@ class DecodePipelineMixin:
         self,
         seqs: List[SequenceState],
         step_offsets: Optional[List[int]] = None,
+        grammar_states: Optional[List[Optional[int]]] = None,
     ) -> SamplingParams:
         """Build the per-row device sampling state for this step.
 
@@ -47,7 +49,15 @@ class DecodePipelineMixin:
         engine/spec.py).  The counts matrix ([S, V], penalties) is the
         engine's cached all-zeros DEVICE buffer unless some row actually
         uses a penalty — the common path never pays the [S, V]
-        host→device transfer."""
+        host→device transfer.  Same economy for the grammar mask
+        ([S, ceil(V/32)] packed bits, llm/tenancy): the cached all-zero
+        device buffer rides along (cond-skipped) unless a constrained row
+        is present.  ``grammar_states[i]`` overrides row i's automaton
+        state (spec verification scores draft positions, whose states are
+        the current state advanced through the draft prefix); -1 forces
+        the row unconstrained (positions past an inadmissible draft token
+        — their samples can never commit, but they must not sample from an
+        all-masked distribution)."""
         S = self.cfg.max_batch
         V = self.model_config.vocab_size
         seeds = np.zeros((S,), np.uint32)
@@ -89,6 +99,41 @@ class DecodePipelineMixin:
                 counts = jnp.asarray(counts_np)  # committed, key matches cache
         else:
             counts = self._zero_counts
+
+        # Grammar masks (llm/tenancy/grammar.py): packed admissible-token
+        # bits for constrained rows; unconstrained rows get all-ones.
+        masked_rows = [
+            i
+            for i, seq in enumerate(seqs)
+            if seq.grammar is not None
+            and (grammar_states is None or grammar_states[i] != -1)
+        ]
+        if masked_rows:
+            mw = np.full((S, self._mask_w), 0xFFFFFFFF, np.uint32)
+            for i in masked_rows:
+                seq = seqs[i]
+                state = seq.grammar_state
+                if grammar_states is not None and grammar_states[i] is not None:
+                    state = grammar_states[i]
+                mw[i] = seq.grammar.packed_mask(state)
+            # jnp, not np: device arrays and numpy arrays key DIFFERENT
+            # jit-cache entries, and the warmup/common path dispatches the
+            # cached device zero-mask — same trick as the counts buffer.
+            mask_words: Any = jnp.asarray(mw)
+            any_mask = np.asarray(True)
+            tenancy_metrics.grammar_masked_rows_total += len(masked_rows)
+        else:
+            mask_words = self._zero_mask
+            any_mask = np.asarray(False)
+        # LoRA slots (llm/tenancy/lora.py): per-row resident adapter slot,
+        # -1 = base.  None (absent from the jit treedef) on LoRA-less
+        # engines so their compiled programs are unchanged.
+        if self._lora_registry is not None:
+            aslots: Any = np.full((S,), -1, np.int32)
+            for i, seq in enumerate(seqs):
+                aslots[i] = seq.adapter_slot
+        else:
+            aslots = None
         return SamplingParams(
             seeds=seeds,
             steps=steps,
@@ -99,6 +144,9 @@ class DecodePipelineMixin:
             pres_penalty=ppen,
             counts=counts,
             need_logprobs=np.asarray(need_lp),
+            mask_words=mask_words,
+            any_mask=any_mask,
+            adapter_slots=aslots,
         )
 
     def _tables_row(self, out: np.ndarray, i: int, seq: SequenceState) -> None:
@@ -118,6 +166,11 @@ class DecodePipelineMixin:
         kv_lens = np.zeros((S,), np.int32)
         tables = np.zeros((S, PP), np.int32)
         cu = np.zeros((S + 1,), np.int32)
+        aslots = (
+            np.full((T,), -1, np.int32)
+            if self._lora_registry is not None
+            else None
+        )
         at = 0
         for i, (seq, start, n) in enumerate(items):
             all_toks = seq.prompt + seq.output
@@ -126,6 +179,8 @@ class DecodePipelineMixin:
             pos[at : at + n] = p
             blk = np.asarray(seq.block_ids, np.int32)
             slots[at : at + n] = blk[p // bs] * bs + p % bs
+            if aslots is not None:
+                aslots[at : at + n] = seq.adapter_slot
             self._tables_row(tables, i, seq)
             kv_lens[i] = start + n
             at += n
@@ -139,6 +194,7 @@ class DecodePipelineMixin:
             page_indices=tables,
             cu_q_lens=cu,
             num_seqs=np.asarray([len(items)], np.int32),
+            adapter_slots=aslots,
         )
 
     async def _run_unified(self, plan: StepPlan) -> None:
@@ -506,6 +562,11 @@ class DecodePipelineMixin:
         for i, seq in enumerate(members):
             if seq.finished or seq.frozen:
                 return False  # membership changed under us: replan
+            if seq.grammar is not None:
+                # Constrained rows never burst: their mask advances
+                # host-side per accepted token (callers route them to
+                # unified steps — this is the safety net).
+                return False
             if not self.scheduler._ensure_slot(seq, lookahead=T):
                 return False
             all_toks = seq.prompt + seq.output
@@ -749,10 +810,35 @@ class DecodePipelineMixin:
     ) -> None:
         seq.output.append(token)
         reason = self._check_stop(seq, token)
+        # Grammar advance (llm/tenancy): the automaton state moves per
+        # ACCEPTED token — constrained rows only flow through this accept
+        # path (never the fused-chunk ones), so this is the single place
+        # tenant state advances.
+        emit_with_stop = False
+        violation = False
+        if seq.grammar is not None and reason is not FinishReason.STOP:
+            nxt = seq.grammar.advance(seq.grammar_state, token)
+            if nxt is None:
+                # Defensive — the logit mask makes this unreachable; if it
+                # ever fires, fail the stream rather than emit output that
+                # cannot parse under the schema.
+                tenancy_metrics.grammar_violations_total += 1
+                violation = True
+                reason = reason or FinishReason.ERROR
+            else:
+                seq.grammar_state = nxt
+                if reason is None and seq.grammar.is_terminal(nxt):
+                    # The value is complete and only EOS could follow: this
+                    # token is real content (unlike eos/stop tokens), so it
+                    # is emitted AND the stream finishes.
+                    reason = FinishReason.STOP
+                    emit_with_stop = True
         queue = self._queues.get(seq.request_id)
         # Stop-triggering tokens (eos / stop_token_ids) are not emitted,
         # matching the reference Backend's stop handling (backend.rs:234-423).
-        if queue is not None and reason is not FinishReason.STOP:
+        if queue is not None and not violation and (
+            reason is not FinishReason.STOP or emit_with_stop
+        ):
             item = LLMEngineOutput.token(token)
             if logprobs is not None:
                 item["logprobs"] = logprobs
@@ -765,6 +851,15 @@ class DecodePipelineMixin:
 
     def _check_stop(self, seq: SequenceState, token: int) -> Optional[FinishReason]:
         n_out = seq.num_output_tokens  # survives preemption's prompt-folding
+        if (
+            seq.grammar is not None
+            and token in self.model_config.eos_token_ids
+        ):
+            # Grammar completion ends the stream regardless of ignore_eos /
+            # min_tokens: the mask admits EOS only in accepting states, and
+            # an un-advanceable eos "content" token would wedge the
+            # automaton (eos has no edge).
+            return FinishReason.STOP
         min_ok = seq.min_new_tokens is None or n_out >= seq.min_new_tokens
         if min_ok and token in seq.stop_token_ids:
             return FinishReason.STOP
@@ -781,6 +876,16 @@ class DecodePipelineMixin:
         return None
 
     def _finish(self, seq: SequenceState, reason: FinishReason) -> None:
+        # Drop the adapter-slot pin BEFORE the queue check: every finish
+        # path funnels here (including cancelled/error streams whose queue
+        # is already gone), and a leaked ref would pin the slot forever.
+        if (
+            self._lora_registry is not None
+            and seq.adapter is not None
+            and not seq.adapter_released
+        ):
+            seq.adapter_released = True
+            self._lora_registry.release(seq.adapter)
         queue = self._queues.get(seq.request_id)
         if queue is None:
             return

@@ -20,7 +20,7 @@ import time
 import zlib
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, List, Optional, Sequence, Tuple
+from typing import Any, Deque, List, Optional, Sequence, Tuple
 
 from ..llm.protocols import PreprocessedRequest
 from ..tokens import TokenBlockSequence
@@ -95,6 +95,25 @@ class SequenceState:
     # traffic stops paying the n-gram scan almost immediately.
     spec_next_try: int = 0
     spec_miss: int = 0
+    # --- multi-tenancy (llm/tenancy) ---
+    # Tenant salt mixed into the chained block hashes (tokens.py): equal
+    # token streams from different adapters never share KV — engine
+    # sealing, host offload, transfer plane and kv_router all key on the
+    # salted hashes, so one field isolates every tier.
+    kv_salt: Optional[str] = None
+    # LoRA adapter (None = base model) + its resident device-bank slot.
+    adapter: Optional[str] = None
+    adapter_slot: int = -1
+    # Registry ref dropped (engine _finish is reachable from several paths;
+    # the flag makes the release idempotent).
+    adapter_released: bool = False
+    # Grammar constraint: TokenMaskAutomaton + the sequence's current
+    # state, advanced host-side per ACCEPTED token.  Constrained rows are
+    # excluded from the fused multi-step decode programs (the mask must be
+    # rebuilt between tokens, and fused steps feed tokens forward on
+    # device) — they advance through single unified steps instead.
+    grammar: Any = None
+    grammar_state: int = 0
 
     def __post_init__(self) -> None:
         if self.orig_prompt_len == 0:
@@ -134,10 +153,16 @@ class SequenceState:
                 v = 0
             if 0 < v <= len(pre.token_ids):
                 orig_len = v
+        # Tenant identity (llm/tenancy): the salt roots the block-hash
+        # chain, so it must be fixed before the first block seals.
+        kv_salt = pre.annotations.get("kv_salt") or None
+        if kv_salt is not None and not isinstance(kv_salt, str):
+            kv_salt = str(kv_salt)
         seq = cls(
             request_id=request_id,
             prompt=list(pre.token_ids),
-            block_seq=TokenBlockSequence(block_size=cfg.block_size),
+            block_seq=TokenBlockSequence(block_size=cfg.block_size, salt=kv_salt),
+            kv_salt=kv_salt,
             sampling_temperature=samp.temperature or 0.0,
             sampling_top_k=samp.top_k or 0,
             sampling_top_p=samp.top_p if samp.top_p is not None else 1.0,
@@ -346,6 +371,15 @@ class Scheduler:
             and not any(
                 s.in_prefill and not s.frozen for s in self.running
             )
+            # Grammar-constrained rows bar the fused multi-step programs:
+            # their token mask advances host-side per accepted token, and a
+            # fused chunk feeds sampled tokens forward ON DEVICE.  The
+            # engine's mixed-phase path still bursts the unconstrained rows
+            # (engine.py _run_loop).
+            and not any(
+                s.grammar is not None and not s.finished and not s.frozen
+                for s in self.running
+            )
         )
         return StepPlan(items, pure_decode=pure)
 
@@ -373,7 +407,7 @@ class Scheduler:
 
             cached = (
                 len(seq.prompt),
-                hash_token_blocks(seq.prompt, self.cfg.block_size),
+                hash_token_blocks(seq.prompt, self.cfg.block_size, seq.kv_salt),
             )
             seq._admit_hash_cache = cached
         return self.kv.would_fit(cached[1], prompt_blocks)
@@ -385,7 +419,9 @@ class Scheduler:
         seq.block_seq.extend(seq.prompt)
         alloc = self.kv.allocate_sequence(seq.block_seq.blocks, prompt_blocks)
         if alloc is None:
-            seq.block_seq = TokenBlockSequence(block_size=self.cfg.block_size)
+            seq.block_seq = TokenBlockSequence(
+                block_size=self.cfg.block_size, salt=seq.kv_salt
+            )
             return False
         seq.block_ids, cached_tokens = alloc
         # Admission holds its own references now; the pre-admission pin
@@ -427,7 +463,9 @@ class Scheduler:
         seq.output = []
         seq.num_computed = 0
         seq.num_sealed_blocks = 0
-        seq.block_seq = TokenBlockSequence(block_size=self.cfg.block_size)
+        seq.block_seq = TokenBlockSequence(
+            block_size=self.cfg.block_size, salt=seq.kv_salt
+        )
         # Wait-since-preemption: without this reset, re-admission would
         # record the span since the ORIGINAL enqueue — including time the
         # request spent RUNNING — inflating admission_waits exactly in the

@@ -48,7 +48,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -332,11 +332,13 @@ class SpecDecodeMixin:
         tok_l: List[int] = []
         pos_l: List[int] = []
         slot_l: List[int] = []
+        aslot_l: List[int] = []  # per-token LoRA slot (llm/tenancy)
         kv_lens = np.zeros((S,), np.int32)
         tables = np.zeros((S, PP), np.int32)
         cu = np.zeros((S + 1,), np.int32)
         row_seqs: List[SequenceState] = []
         offsets: List[int] = []
+        gstates: List[Optional[int]] = []
         spec_groups: List[Tuple[SequenceState, int, List[int]]] = []
         plain_rows: List[Tuple[SequenceState, int, int, int]] = []
         at = 0
@@ -351,18 +353,42 @@ class SpecDecodeMixin:
             blk = np.asarray(seq.block_ids, np.int32)
             if d:
                 feed = [all_toks[start]] + list(d)
+                # Grammar × spec (llm/tenancy): the logit mask must hold at
+                # EVERY draft-verify position — row j samples output
+                # position j, whose automaton state is the current state
+                # advanced through draft[0..j-1] (acceptance implies the
+                # committed tokens ARE the draft tokens, so these states
+                # are exact for every committable position).  A draft token
+                # the automaton rejects makes all later states -1 =
+                # unconstrained: their samples can never commit (the
+                # admissible sample at j must differ from the inadmissible
+                # draft[j], so acceptance breaks there), but they must not
+                # draw from an all-masked distribution.
+                st: Optional[int] = (
+                    seq.grammar_state if seq.grammar is not None else None
+                )
+                row_states: List[Optional[int]] = []
+                for dt in d:
+                    row_states.append(st if st is not None else None)
+                    if st is not None and st != -1:
+                        nxt = seq.grammar.advance(st, int(dt))
+                        st = -1 if nxt is None else nxt
+                    # st stays -1 (or None for unconstrained seqs)
+                row_states.append(st)
                 row0 = row
                 for j, t in enumerate(feed):
                     p = start + j
                     tok_l.append(int(t))
                     pos_l.append(p)
                     slot_l.append(int(blk[p // bs]) * bs + p % bs)
+                    aslot_l.append(seq.adapter_slot)
                     self._tables_row(tables, row, seq)
                     kv_lens[row] = p + 1
                     at += 1
                     cu[row + 1] = at
                     row_seqs.append(seq)
                     offsets.append(j)
+                    gstates.append(row_states[j])
                     row += 1
                 seq.awaiting_fetch = True
                 spec_groups.append((seq, row0, list(d)))
@@ -371,12 +397,14 @@ class SpecDecodeMixin:
                 p = np.arange(start, start + n, dtype=np.int32)
                 pos_l.extend(p.tolist())
                 slot_l.extend((blk[p // bs] * bs + p % bs).tolist())
+                aslot_l.extend([seq.adapter_slot] * n)
                 self._tables_row(tables, row, seq)
                 kv_lens[row] = start + n
                 at += n
                 cu[row + 1] = at
                 row_seqs.append(seq)
                 offsets.append(0)
+                gstates.append(None)  # plain row: current automaton state
                 plain_rows.append((seq, start, n, row))
                 if start + n >= len(seq.prompt):
                     # Parked BEFORE the dispatch, like drafted rows above:
@@ -392,6 +420,14 @@ class SpecDecodeMixin:
         pos[:at] = pos_l
         slots = np.full((T,), -1, np.int32)
         slots[:at] = slot_l
+        # LoRA rows in a spec step (llm/tenancy): the verify forward must
+        # apply each row's OWN adapter — and LoRA-less engines must keep
+        # the None leaf so their compiled programs are unchanged.
+        if self._lora_registry is not None:
+            aslots: Any = np.full((T,), -1, np.int32)
+            aslots[:at] = aslot_l
+        else:
+            aslots = None
         rb = RaggedBatch(
             token_ids=tok,
             positions=pos,
@@ -400,8 +436,11 @@ class SpecDecodeMixin:
             page_indices=tables,
             cu_q_lens=cu,
             num_seqs=np.asarray([row], np.int32),
+            adapter_slots=aslots,
         )
-        samp = self._sampling_arrays(row_seqs, step_offsets=offsets)
+        samp = self._sampling_arrays(
+            row_seqs, step_offsets=offsets, grammar_states=gstates
+        )
         need_lp = bool(samp.need_logprobs)
         if self._rep_sharding is not None:
             rb_d, samp_d = self._prep((rb, samp))

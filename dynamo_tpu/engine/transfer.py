@@ -43,7 +43,11 @@ def _scales_close(a, b, rtol: float = 1e-3) -> bool:
 
 class KvTransferMixin:
     async def export_prompt_blocks(
-        self, token_ids: List[int], start_block: int = 0, max_blocks: int = 0
+        self,
+        token_ids: List[int],
+        start_block: int = 0,
+        max_blocks: int = 0,
+        salt: Optional[str] = None,
     ) -> Optional[Dict[str, Any]]:
         """Gather cached KV for ``token_ids``'s complete blocks to host.
 
@@ -52,6 +56,9 @@ class KvTransferMixin:
         transfers its resident prefix; round-2 returned None in that case
         and recomputed everything).  ``max_blocks`` bounds the run (chunked
         transfer).  Returns None when nothing is resident at start_block.
+        ``salt`` is the owning tenant's KV salt (llm/tenancy): tenant
+        blocks seal under salted chained hashes, so an unsalted lookup
+        cannot see them — and can never LEAK them to another tenant.
         """
         from ..tokens import hash_token_blocks
 
@@ -61,7 +68,7 @@ class KvTransferMixin:
             # time so the caller falls back to local prefill instead of
             # hanging on a non-addressable array (ADVICE r3).
             return None
-        blocks = hash_token_blocks(token_ids, self.cfg.block_size)
+        blocks = hash_token_blocks(token_ids, self.cfg.block_size, salt)
         ids: List[int] = []
         for tb in blocks[start_block:]:
             bid = self.kv._by_hash.get(tb.sequence_hash)
@@ -90,7 +97,12 @@ class KvTransferMixin:
             "v": np.ascontiguousarray(v).tobytes(),
         }
 
-    async def inject_blocks(self, token_ids: List[int], payload: Dict[str, Any]) -> int:
+    async def inject_blocks(
+        self,
+        token_ids: List[int],
+        payload: Dict[str, Any],
+        salt: Optional[str] = None,
+    ) -> int:
         """Write transferred KV into this engine's cache as sealed blocks.
 
         ``payload["start_block"]`` supports chunked transfers: chunk k's
@@ -107,7 +119,10 @@ class KvTransferMixin:
         from ..tokens import hash_token_blocks
 
         start = int(payload.get("start_block", 0))
-        blocks = hash_token_blocks(token_ids, self.cfg.block_size)[start:]
+        # Tenant imports (llm/tenancy) seal under the tenant's salted hash
+        # chain — the same identity the exporter read them under, so a
+        # cross-tenant inject structurally cannot produce a matching hash.
+        blocks = hash_token_blocks(token_ids, self.cfg.block_size, salt)[start:]
         n = min(int(payload["n_blocks"]), len(blocks))
         if n == 0:
             return 0
@@ -208,7 +223,12 @@ class KvTransferMixin:
         return n * self.cfg.block_size
 
     async def inject_blocks_from_device(
-        self, token_ids: List[int], pages_dev, n: int, start_block: int = 0
+        self,
+        token_ids: List[int],
+        pages_dev,
+        n: int,
+        start_block: int = 0,
+        salt: Optional[str] = None,
     ) -> int:
         """Seal ``n`` transferred blocks whose pages are ALREADY on device
         (the ICI/device_put fast path — no host staging).  ``pages_dev`` is
@@ -219,7 +239,9 @@ class KvTransferMixin:
             # Device handles can't cross the leader/follower broadcast; the
             # host-staged inject_blocks path handles multi-host transfers.
             return 0
-        blocks = hash_token_blocks(token_ids, self.cfg.block_size)[start_block:]
+        blocks = hash_token_blocks(token_ids, self.cfg.block_size, salt)[
+            start_block:
+        ]
         n = min(n, len(blocks))
         if n == 0:
             return 0
@@ -265,16 +287,18 @@ class KvTransferMixin:
         self.kv.free_sequence(ids)
         return n * self.cfg.block_size
 
-    def _pin_prefix(self, token_ids: List[int]):
+    def _pin_prefix(self, token_ids: List[int], salt: Optional[str] = None):
         """Take references on the resident prefix blocks of ``token_ids``
         (see generate(): keeps pre-admission sp/restore work alive)."""
         from ..tokens import hash_token_blocks
 
         return self.kv.acquire_prefix(
-            hash_token_blocks(token_ids, self.cfg.block_size)
+            hash_token_blocks(token_ids, self.cfg.block_size, salt)
         )
 
-async def transfer_blocks_device(src: TpuEngine, dst: TpuEngine, token_ids) -> int:
+async def transfer_blocks_device(
+    src: TpuEngine, dst: TpuEngine, token_ids, salt: Optional[str] = None
+) -> int:
     """Co-located prefill→decode KV transfer that never stages in host RAM:
     device gather from the source cache → ``jax.device_put`` onto the
     destination's sharding → in-place scatter.  On one chip this is an HBM
@@ -293,7 +317,7 @@ async def transfer_blocks_device(src: TpuEngine, dst: TpuEngine, token_ids) -> i
         src._kv_scale_repr(), dst._kv_scale_repr()
     ):
         return 0  # stored representation differs: host path will also refuse
-    blocks = hash_token_blocks(token_ids, src.cfg.block_size)
+    blocks = hash_token_blocks(token_ids, src.cfg.block_size, salt)
     src_ids: List[int] = []
     for tb in blocks:
         bid = src.kv._by_hash.get(tb.sequence_hash)
@@ -314,4 +338,4 @@ async def transfer_blocks_device(src: TpuEngine, dst: TpuEngine, token_ids) -> i
         )
     elif pages.devices() != dst.cache.pages.devices():
         pages = jax.device_put(pages, next(iter(dst.cache.pages.devices())))
-    return await dst.inject_blocks_from_device(token_ids, pages, n)
+    return await dst.inject_blocks_from_device(token_ids, pages, n, salt=salt)

@@ -96,7 +96,11 @@ class DisaggDecodeWorker(AsyncEngine):
     async def kv_import_handler(self, request: Context) -> AsyncIterator[Dict]:
         data = request.data
         tokens = data["token_ids"]
-        covered = await self.engine.inject_blocks(tokens, data["payload"])
+        # Tenant transfers (llm/tenancy) seal under the tenant's salted hash
+        # chain — same identity the prefill engine sealed them under.
+        covered = await self.engine.inject_blocks(
+            tokens, data["payload"], data.get("salt")
+        )
         self._covered[data["transfer_id"]] = (
             self._covered.get(data["transfer_id"], 0) + covered
         )
@@ -110,14 +114,18 @@ class DisaggDecodeWorker(AsyncEngine):
                 fut.set_result(total)
         yield {"ok": True, "tokens_covered": covered}
 
-    async def transfer_direct(self, transfer_id: str, tokens, src_engine) -> int:
+    async def transfer_direct(
+        self, transfer_id: str, tokens, src_engine, salt=None
+    ) -> int:
         """Same-process fast path: device→device block copy, no host staging
         (engine.transfer_blocks_device).  A zero-block transfer leaves the
         future pending — the sender retries and the decode side's timeout
         fallback covers permanent failure."""
         from ...engine.engine import transfer_blocks_device
 
-        covered = await transfer_blocks_device(src_engine, self.engine, tokens)
+        covered = await transfer_blocks_device(
+            src_engine, self.engine, tokens, salt=salt
+        )
         if covered > 0:
             fut = self._pending.pop(transfer_id, None)
             if fut is not None and not fut.done():
@@ -127,7 +135,11 @@ class DisaggDecodeWorker(AsyncEngine):
     async def generate(self, request: Context) -> ResponseStream:
         pre = PreprocessedRequest.from_dict(request.data)
         tokens = pre.token_ids
-        prefix_hit = self.engine.estimate_prefix_hit(tokens)
+        # Tenant requests (llm/tenancy) seal KV under a salted hash chain:
+        # estimate with the same salt or the local-hit count is fiction.
+        prefix_hit = self.engine.estimate_prefix_hit(
+            tokens, (pre.annotations or {}).get("kv_salt")
+        )
         # Cheap local length test first; the queue-depth RPC to the hub only
         # runs for prompts that are candidates for remote prefill.
         remote = (
@@ -149,7 +161,9 @@ class DisaggDecodeWorker(AsyncEngine):
                 remote = self.router.prefill_remote(len(tokens), prefix_hit, qsize)
         if remote:
             await self._remote_prefill(
-                tokens, deadline=getattr(request.ctx, "deadline", None)
+                tokens,
+                deadline=getattr(request.ctx, "deadline", None),
+                annotations=pre.annotations,
             )
         else:
             self.local_prefills += 1
@@ -177,18 +191,24 @@ class DisaggDecodeWorker(AsyncEngine):
 
         _metrics.degraded_prefills_total += 1
 
-    async def _remote_prefill(self, tokens, deadline=None) -> None:
+    async def _remote_prefill(self, tokens, deadline=None, annotations=None) -> None:
         transfer_id = uuid.uuid4().hex
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[transfer_id] = fut
+        item = {
+            "transfer_id": transfer_id,
+            "token_ids": list(tokens),
+            "reply": {"address": self.import_address, "path": self.import_path},
+        }
+        if annotations:
+            # Tenant identity (llm/tenancy): the prefill worker must run the
+            # prompt under the same adapter + KV salt or the transferred
+            # blocks would be wrong (adapter) or unaddressable (salt).
+            # Omitted when empty so pre-tenancy queue consumers see the old
+            # item shape.
+            item["annotations"] = dict(annotations)
         try:
-            await self.queue.enqueue(
-                {
-                    "transfer_id": transfer_id,
-                    "token_ids": list(tokens),
-                    "reply": {"address": self.import_address, "path": self.import_path},
-                }
-            )
+            await self.queue.enqueue(item)
         except asyncio.CancelledError:
             raise
         except Exception:  # noqa: BLE001 — hub/queue unreachable
@@ -340,7 +360,12 @@ class PrefillWorkerLoop:
 
     async def _handle(self, item: Dict[str, Any]) -> None:
         tokens = item["token_ids"]
-        pre = PreprocessedRequest(token_ids=list(tokens))
+        # Tenant items (llm/tenancy) carry the request annotations: the
+        # prefill runs under the same adapter (correct KV contents) and
+        # seals under the same salted hash chain (addressable transfer).
+        annotations = dict(item.get("annotations") or {})
+        salt = annotations.get("kv_salt")
+        pre = PreprocessedRequest(token_ids=list(tokens), annotations=annotations)
         pre.stop_conditions.max_tokens = 1
         pre.stop_conditions.ignore_eos = True
         # Run the prompt through the engine: prefix caching retains the KV
@@ -353,7 +378,7 @@ class PrefillWorkerLoop:
         worker = self.direct.get(reply["address"])
         if worker is not None:
             covered = await worker.transfer_direct(
-                item["transfer_id"], tokens, self.engine
+                item["transfer_id"], tokens, self.engine, salt=salt
             )
             if covered == 0:
                 raise RuntimeError("direct transfer moved no blocks")
@@ -367,7 +392,7 @@ class PrefillWorkerLoop:
         while True:
             chunk = self.chunk_for(dest)
             payload = await self.engine.export_prompt_blocks(
-                tokens, start_block=start, max_blocks=chunk
+                tokens, start_block=start, max_blocks=chunk, salt=salt
             )
             if payload is None:
                 if start == 0:
@@ -384,6 +409,7 @@ class PrefillWorkerLoop:
                             "token_ids": list(tokens),
                             "payload": {"n_blocks": 0},
                             "last": True,
+                            **({"salt": salt} if salt else {}),
                         }
                     )
                 )
@@ -400,6 +426,7 @@ class PrefillWorkerLoop:
                         "token_ids": list(tokens),
                         "payload": payload,
                         "last": last,
+                        **({"salt": salt} if salt else {}),
                     }
                 )
             )

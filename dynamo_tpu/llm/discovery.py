@@ -14,6 +14,7 @@ one model; the engine is added on the first entry, removed with the last.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 from typing import Any, Dict, Optional
 
@@ -89,8 +90,14 @@ async def register_model(
     lease: Optional[int] = None,
     kv_block_size: int = 16,
     static: bool = False,  # no lease: survives the registrar (llmctl mode)
+    lora: Optional[Dict[str, Any]] = None,  # adapter entry: {"adapter", "base"}
 ) -> str:
-    """Worker-side model registration (reference: llmctl + ModelEntry)."""
+    """Worker-side model registration (reference: llmctl + ModelEntry).
+
+    ``lora`` marks the entry as a LoRA adapter alias (llm/tenancy): the
+    frontend's ModelWatcher builds its pipeline with an adapter-stamping
+    preprocessor, so requests naming this model route to the base engine
+    with tenant identity (adapter id + KV salt) attached."""
     key = f"{MODEL_PREFIX}{name}/{runtime.worker_id}"
     entry = {
         "name": name,
@@ -100,6 +107,8 @@ async def register_model(
         # Routers must hash with the engine's block size or overlap is zero.
         "kv_block_size": kv_block_size,
     }
+    if lora:
+        entry["lora"] = dict(lora)
     if static:
         await runtime.hub.kv_put(key, entry)  # persistent, no liveness tie
         return key
@@ -124,6 +133,12 @@ class ModelWatcher:
         self.router_mode = router_mode
         self._refcount: Dict[str, int] = {}
         self._clients: Dict[str, Any] = {}
+        # One grammar compile cache per tokenizer spec (llm/tenancy):
+        # constraint→automaton indexing costs seconds on big vocabularies,
+        # and the base model plus its adapter aliases share a tokenizer —
+        # per-pipeline caches would recompile the same schema per served
+        # name and lose the warm cache on every watch rebuild.
+        self._grammar_compilers: Dict[str, Any] = {}
         self._router_cores: Dict[str, Any] = {}
         self._task: Optional[asyncio.Task] = None
         self._watcher = None
@@ -183,8 +198,27 @@ class ModelWatcher:
             self._router_cores[name] = core
             sink = KvPushRouter(core)
         tokenizer = make_tokenizer(entry.get("tokenizer"))
+        # Adapter-alias entries (llm/tenancy): the preprocessor stamps the
+        # adapter id + KV salt so the engine (and the KV router above, when
+        # router_mode == KV) resolves tenant identity per request.
+        adapter = (entry.get("lora") or {}).get("adapter")
+        tok_key = json.dumps(entry.get("tokenizer"), sort_keys=True)
+        compiler = self._grammar_compilers.get(tok_key)
+        if compiler is None:
+            from .tenancy.grammar import GrammarCompiler
+
+            compiler = self._grammar_compilers[tok_key] = GrammarCompiler(
+                tokenizer
+            )
         pipeline = build_pipeline(
-            [OpenAIPreprocessor(tokenizer, name), Backend(tokenizer)], sink
+            [
+                OpenAIPreprocessor(
+                    tokenizer, name, adapter=adapter,
+                    grammar_compiler=compiler,
+                ),
+                Backend(tokenizer),
+            ],
+            sink,
         )
         model_type = entry.get("model_type", "both")
         if model_type in ("chat", "both"):

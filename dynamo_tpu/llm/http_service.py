@@ -22,7 +22,7 @@ from typing import Any, Dict, Optional
 
 from aiohttp import web
 
-from ..runtime.client import NoInstancesError
+from ..runtime.client import NoInstancesError, RemoteEngineError
 from ..runtime.engine import AsyncEngine, Context
 from ..runtime.resilience import (
     AdmissionController,
@@ -33,6 +33,8 @@ from ..runtime.resilience import (
 from ..runtime.resilience import metrics as resilience_metrics
 from .metrics import Metrics, Status
 from .openai import SSE_DONE, aggregate_chunks, sse_encode
+from .protocols import ModelNotFoundError
+from .tenancy.lora import AdapterCapacityError
 
 logger = logging.getLogger(__name__)
 
@@ -153,7 +155,7 @@ class HttpService:
         # do the engine's speculative-decoding gauges when the engine is
         # colocated (llm/metrics.py spec_metrics).
         from ..planner.pmetrics import metrics as planner_metrics
-        from .metrics import migration_metrics, spec_metrics
+        from .metrics import migration_metrics, spec_metrics, tenancy_metrics
 
         body = (
             self.metrics.render()
@@ -161,6 +163,7 @@ class HttpService:
             + planner_metrics.render(self._metrics_prefix).encode()
             + spec_metrics.render(self._metrics_prefix).encode()
             + migration_metrics.render(self._metrics_prefix).encode()
+            + tenancy_metrics.render(self._metrics_prefix).encode()
         )
         return web.Response(body=body, content_type="text/plain")
 
@@ -196,7 +199,7 @@ class HttpService:
         )
         if engine is None:
             self.metrics.requests_total.labels(model, endpoint, "stream", Status.REJECTED).inc()
-            return _error_response(404, f"model {model!r} not found")
+            return _model_not_found(model)
 
         # Admission control guards everything that costs engine work; cheap
         # 400/404s above never consume a slot.
@@ -245,6 +248,29 @@ class HttpService:
             ctx.ctx.deadline = Deadline.after(deadline_s)
         try:
             stream = await engine.generate(ctx)
+        except ModelNotFoundError as e:
+            # Engine-level rejection (llm/tenancy): the edge routed by name,
+            # but the engine serves a model/adapter allowlist — an unknown
+            # name 404s instead of silently running the base model.
+            guard.finish(Status.REJECTED)
+            return _model_not_found(e.model, rid=ctx.id)
+        except AdapterCapacityError as e:
+            # Transient: every resident LoRA slot is pinned by running
+            # sequences — back off and retry, don't treat as server sickness.
+            guard.finish(Status.REJECTED)
+            return _error_response(503, str(e), rid=ctx.id, retry_after_s=1.0)
+        except RemoteEngineError as e:
+            if e.kind == ModelNotFoundError.error_kind:
+                guard.finish(Status.REJECTED)
+                return _model_not_found(model, rid=ctx.id)
+            if e.kind == AdapterCapacityError.error_kind:
+                guard.finish(Status.REJECTED)
+                return _error_response(
+                    503, str(e), rid=ctx.id, retry_after_s=1.0
+                )
+            guard.finish(Status.ERROR)
+            logger.exception("engine rejected request")
+            return _error_response(500, str(e), rid=ctx.id)
         except ValueError as e:
             # Request-shape errors (bad sampling params, oversize prompt)
             # are the client's fault: 400, not 500.  Logged with traceback:
@@ -414,20 +440,38 @@ def _error_response(
     message: str,
     rid: Optional[str] = None,
     retry_after_s: Optional[float] = None,
+    code: Optional[Any] = None,
+    param: Optional[str] = None,
 ) -> web.Response:
     headers = {}
     if rid:
         headers["x-request-id"] = rid
     if retry_after_s is not None:
         headers["Retry-After"] = str(max(1, int(retry_after_s)))
+    error: Dict[str, Any] = {
+        "message": message,
+        "type": _ERROR_TYPES.get(status, "invalid_request_error"),
+        # OpenAI uses string codes ("model_not_found"); the numeric status
+        # stays the default for errors without one (established behaviour).
+        "code": status if code is None else code,
+    }
+    if param is not None:
+        error["param"] = param
     return web.json_response(
-        {
-            "error": {
-                "message": message,
-                "type": _ERROR_TYPES.get(status, "invalid_request_error"),
-                "code": status,
-            }
-        },
+        {"error": error},
         status=status,
         headers=headers or None,
+    )
+
+
+def _model_not_found(model: str, rid: Optional[str] = None) -> web.Response:
+    """The OpenAI ``model_not_found`` 404 body (llm/tenancy satellite: a
+    request naming an unregistered model/adapter must fail loudly, never
+    silently fall through to the base model)."""
+    return _error_response(
+        404,
+        f"The model {model!r} does not exist or is not served here",
+        rid=rid,
+        code="model_not_found",
+        param="model",
     )

@@ -127,9 +127,16 @@ class KvIndexer:
     def remove_worker(self, worker: WorkerId) -> None:
         self._index.remove_worker(worker)
 
-    def find_matches(self, token_ids: Sequence[int]) -> OverlapScores:
+    def find_matches(
+        self, token_ids: Sequence[int], salt: Optional[str] = None
+    ) -> OverlapScores:
+        """``salt`` is the requesting tenant's KV salt (llm/tenancy —
+        ``annotations.kv_salt``): engines seal tenant blocks under salted
+        chained hashes, so an unsalted lookup for a tenant request (or vice
+        versa) scores structurally zero overlap — exactly the isolation the
+        salt exists to provide."""
         return self.find_matches_for_hashes(
-            fast_sequence_hashes(token_ids, self.block_size)
+            fast_sequence_hashes(token_ids, self.block_size, salt)
         )
 
     def find_matches_for_hashes(self, seq_hashes: Sequence[int]) -> OverlapScores:
@@ -171,8 +178,10 @@ class KvIndexerSharded:
         for shard in self._shards:
             shard.remove_worker(worker)
 
-    def find_matches(self, token_ids: Sequence[int]) -> OverlapScores:
-        hashes = fast_sequence_hashes(token_ids, self.block_size)
+    def find_matches(
+        self, token_ids: Sequence[int], salt: Optional[str] = None
+    ) -> OverlapScores:
+        hashes = fast_sequence_hashes(token_ids, self.block_size, salt)
         scores: Dict[WorkerId, int] = {}
         active: Optional[Set[WorkerId]] = None
         for i, h in enumerate(hashes):

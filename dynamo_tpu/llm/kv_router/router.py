@@ -110,14 +110,18 @@ class KvRouterCore:
             self.aggregator.remove_worker(gone)
         self._known_workers = live
 
-    def select(self, token_ids) -> Tuple[Optional[WorkerId], int]:
-        """(best worker, overlap_blocks); None if no instances."""
+    def select(
+        self, token_ids, salt: Optional[str] = None
+    ) -> Tuple[Optional[WorkerId], int]:
+        """(best worker, overlap_blocks); None if no instances.  ``salt``
+        is the tenant KV salt (llm/tenancy) — overlap hashing must match
+        the engine's salted sealing or scores diverge from cache state."""
         live = set(self.client.instance_ids)
         if live != self._known_workers:
             self._prune_dead_workers(live)
         if not live:
             return None, 0
-        overlap = self.indexer.find_matches(token_ids)
+        overlap = self.indexer.find_matches(token_ids, salt)
         workers = self.aggregator.endpoints(sorted(live))
         winner = self.scheduler.schedule(len(token_ids), overlap, workers)
         return winner, overlap.scores.get(winner, 0) if winner is not None else 0
@@ -131,7 +135,9 @@ class KvRouter(AsyncEngine):
 
     async def generate(self, request: Context) -> ResponseStream:
         token_ids = request.data["token_ids"]
-        worker_id, overlap = self.core.select(token_ids)
+        worker_id, overlap = self.core.select(
+            token_ids, request.data.get("kv_salt")
+        )
 
         async def gen() -> AsyncIterator[Dict[str, Any]]:
             yield {"worker_id": worker_id, "overlap_blocks": overlap}
@@ -151,7 +157,13 @@ class KvPushRouter(AsyncEngine):
 
     async def generate(self, request: Context) -> ResponseStream:
         token_ids = request.data.get("token_ids") or []
-        worker_id, overlap = self.core.select(token_ids)
+        # Tenant requests (llm/tenancy) carry their KV salt in annotations;
+        # the engine seals their blocks under the same salt, so routing
+        # overlap only means anything when hashed identically.
+        annotations = request.data.get("annotations") or {}
+        worker_id, overlap = self.core.select(
+            token_ids, annotations.get("kv_salt")
+        )
         if worker_id is None:
             return await self.core.client.generate(request)
         return await self.core.client.generate(request, worker_id=worker_id)

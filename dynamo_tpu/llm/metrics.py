@@ -317,6 +317,72 @@ class MigrationMetrics:
 migration_metrics = MigrationMetrics()
 
 
+class TenancyMetrics:
+    """Multi-tenancy counters (llm/tenancy): grammar-constrained decoding +
+    batched multi-LoRA.  Module-level singleton rendered as Prometheus text
+    and appended to ``/metrics`` (same pattern as ``spec_metrics``)."""
+
+    def __init__(self):
+        # structured output
+        self.grammar_requests_total = 0   # requests carrying a constraint
+        self.grammar_compiles_total = 0   # automaton compiles (cache misses)
+        self.grammar_cache_hits_total = 0
+        self.grammar_masked_rows_total = 0  # device rows sampled under a mask
+        self.grammar_violations_total = 0   # defensive: inadmissible accepts
+        # multi-LoRA
+        self.adapters_registered = 0      # gauge: host-pool size
+        self.adapter_promotions = 0       # host→device slot writes
+        self.adapter_evictions = 0        # resident slots reclaimed
+        self.adapter_requests_total = 0   # requests routed to an adapter
+        self.adapter_not_found_total = 0  # unknown-model rejections
+
+    def reset(self) -> None:
+        self.__init__()
+
+    def snapshot(self) -> Dict[str, float]:
+        return {k: float(v) for k, v in vars(self).items()}
+
+    def render(self, prefix: str = "dynamo_tpu") -> str:
+        ns = f"{prefix}_tenancy"
+        lines = []
+
+        def emit(name: str, kind: str, help_: str, value) -> None:
+            lines.append(f"# HELP {ns}_{name} {help_}")
+            lines.append(f"# TYPE {ns}_{name} {kind}")
+            lines.append(f"{ns}_{name} {value}")
+
+        emit("grammar_requests_total", "counter",
+             "Requests with a structured-output constraint",
+             self.grammar_requests_total)
+        emit("grammar_compiles_total", "counter",
+             "Token-mask automaton compiles (cache misses)",
+             self.grammar_compiles_total)
+        emit("grammar_cache_hits_total", "counter",
+             "Constraint compile-cache hits", self.grammar_cache_hits_total)
+        emit("grammar_masked_rows_total", "counter",
+             "Device rows sampled under a grammar mask",
+             self.grammar_masked_rows_total)
+        emit("grammar_violations_total", "counter",
+             "Accepted tokens the mask should have forbidden (defensive; "
+             "always 0)", self.grammar_violations_total)
+        emit("lora_adapters_registered", "gauge",
+             "Adapters in the host pool", self.adapters_registered)
+        emit("lora_promotions_total", "counter",
+             "Adapter host-to-device slot promotions", self.adapter_promotions)
+        emit("lora_evictions_total", "counter",
+             "Resident adapter slots reclaimed", self.adapter_evictions)
+        emit("lora_requests_total", "counter",
+             "Requests served through a LoRA adapter",
+             self.adapter_requests_total)
+        emit("lora_model_not_found_total", "counter",
+             "Requests naming an unregistered model/adapter",
+             self.adapter_not_found_total)
+        return "\n".join(lines) + "\n"
+
+
+tenancy_metrics = TenancyMetrics()
+
+
 class InflightGuard:
     """Tracks one request: inflight gauge, duration, TTFT, ITL, final status.
 

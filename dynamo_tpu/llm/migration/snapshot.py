@@ -51,6 +51,18 @@ class SequenceSnapshot:
     # normal routed-client path — token ids below the Backend operator are
     # what migrate, so edge detok state never moves).
     detok: Optional[Dict[str, Any]] = None
+    # Tenant identity (llm/tenancy): the LoRA adapter serving this
+    # sequence and the KV salt its blocks seal under — the target must
+    # resume under the SAME adapter (correct forward) and salt
+    # (addressable KV), or the stream silently changes tenants.
+    adapter: Optional[str] = None
+    kv_salt: Optional[str] = None
+    # Structured-output constraint: the serialized TokenMaskAutomaton.
+    # The automaton STATE does not travel — the target re-derives it by
+    # advancing from the start state through the resumed output tokens
+    # (every delivered token was mask-admissible, so the walk cannot
+    # fail on an honest snapshot).
+    grammar: Optional[Dict[str, Any]] = None
     version: int = SNAPSHOT_VERSION
 
     @property
@@ -69,6 +81,9 @@ class SequenceSnapshot:
             "spec": dict(self.spec),
             "deadline_s": self.deadline_s,
             "detok": self.detok,
+            "adapter": self.adapter,
+            "kv_salt": self.kv_salt,
+            "grammar": self.grammar,
         }
 
     @classmethod
@@ -82,6 +97,9 @@ class SequenceSnapshot:
             spec=dict(d.get("spec") or {}),
             deadline_s=d.get("deadline_s"),
             detok=d.get("detok"),
+            adapter=d.get("adapter"),
+            kv_salt=d.get("kv_salt"),
+            grammar=d.get("grammar"),
             version=int(d.get("version", SNAPSHOT_VERSION)),
         )
 
@@ -118,6 +136,13 @@ class SequenceSnapshot:
                 "resume": {
                     "orig_prompt_len": self.orig_prompt_len,
                     "spec": dict(self.spec),
-                }
+                },
+                # Tenant identity (llm/tenancy): adapter + salt resume on
+                # the target exactly as the source served them.  Keys are
+                # omitted for base traffic so pre-tenancy consumers see
+                # the old annotation shape.
+                **({"adapter": self.adapter} if self.adapter else {}),
+                **({"kv_salt": self.kv_salt} if self.kv_salt else {}),
             },
+            **({"grammar": dict(self.grammar)} if self.grammar else {}),
         }

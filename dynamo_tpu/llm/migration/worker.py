@@ -88,6 +88,10 @@ class MigratableWorker(AsyncEngine):
     async def _migrate_in(self, data: Dict[str, Any]) -> Dict[str, Any]:
         kind = data.get("kind", "blocks")
         tokens = list(data["token_ids"])
+        # Tenant sequences (llm/tenancy) seal KV under a salted hash chain;
+        # the source ships the salt so injected blocks land under the same
+        # identity the resume request will look them up with.
+        salt = data.get("salt")
         cfg = self.engine.cfg
         if int(data.get("block_size", cfg.block_size)) != cfg.block_size:
             return {
@@ -97,7 +101,7 @@ class MigratableWorker(AsyncEngine):
             }
         if kind == "blocks":
             payload = data["payload"]
-            covered = await self.engine.inject_blocks(tokens, payload)
+            covered = await self.engine.inject_blocks(tokens, payload, salt)
             if covered == 0 and int(payload.get("n_blocks", 0)) > 0:
                 # inject_blocks validated and refused (stored-representation
                 # or capacity mismatch): tell the source now, not at commit.
@@ -115,14 +119,14 @@ class MigratableWorker(AsyncEngine):
             covered = 0
             payload = data.get("payload")
             if payload is not None:
-                covered = await self.engine.inject_blocks(tokens, payload)
+                covered = await self.engine.inject_blocks(tokens, payload, salt)
                 if covered == 0 and int(payload.get("n_blocks", 0)) > 0:
                     return {"ok": False, "error": "final-delta import rejected"}
             metrics.migrated_in_total += 1
             return {
                 "ok": True,
                 "tokens_covered": covered,
-                "prefix_hit": self.engine.estimate_prefix_hit(tokens),
+                "prefix_hit": self.engine.estimate_prefix_hit(tokens, salt),
             }
         return {"ok": False, "error": f"unknown migrate_in kind {kind!r}"}
 
@@ -162,14 +166,20 @@ class MigratableWorker(AsyncEngine):
         metrics.started_total += 1
         cursor = 0  # complete blocks already pushed
         # -- phase 1: copy while decoding --------------------------------
+        salt = None
         for _ in range(self.max_copy_rounds):
             tokens = engine.sequence_tokens(request_id)
             seq = engine.find_sequence(request_id)
             if tokens is None or seq is None or seq.finished:
                 metrics.aborted_total += 1
                 return False  # finished/cancelled under us: nothing to move
+            # Tenant sequences (llm/tenancy) seal KV under a salted hash
+            # chain: export with the same salt and ship it with every
+            # payload so the target seals under the identity the resume
+            # request will look blocks up with.
+            salt = seq.kv_salt
             try:
-                shipped = await self._push_blocks(target, tokens, cursor)
+                shipped = await self._push_blocks(target, tokens, cursor, salt)
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -200,7 +210,7 @@ class MigratableWorker(AsyncEngine):
             if snap is None:
                 raise RuntimeError("sequence vanished after freeze")
             tokens = snap.token_ids
-            cursor += await self._push_blocks(target, tokens, cursor)
+            cursor += await self._push_blocks(target, tokens, cursor, salt)
             # The commit carries only what the target validates against:
             # the decode state itself rides the cutover marker (the client
             # re-dispatches snap.to_resume_request()), so shipping the
@@ -213,6 +223,7 @@ class MigratableWorker(AsyncEngine):
                     "token_ids": tokens,
                     "block_size": bs,
                     "payload": None,
+                    **({"salt": salt} if salt else {}),
                 },
             )
             if not resp.get("ok"):
@@ -254,15 +265,22 @@ class MigratableWorker(AsyncEngine):
 
     # ------------------------------------------------------------ transport
     async def _push_blocks(
-        self, target: Dict[str, Any], tokens: List[int], cursor: int
+        self,
+        target: Dict[str, Any],
+        tokens: List[int],
+        cursor: int,
+        salt: Optional[str] = None,
     ) -> int:
         """Export sealed blocks from ``cursor`` and push them; returns the
-        number of complete blocks shipped.  Raises on a target refusal."""
+        number of complete blocks shipped.  Raises on a target refusal.
+        ``salt`` is the owning tenant's KV salt (llm/tenancy) — the export
+        lookup and the target's sealing must both use it."""
         bs = self.engine.cfg.block_size
         sent = 0
         while True:
             payload = await self.engine.export_prompt_blocks(
-                tokens, start_block=cursor + sent, max_blocks=self.chunk_blocks
+                tokens, start_block=cursor + sent, max_blocks=self.chunk_blocks,
+                salt=salt,
             )
             if payload is None:
                 return sent
@@ -278,6 +296,7 @@ class MigratableWorker(AsyncEngine):
                     "token_ids": tokens[:cover],
                     "block_size": bs,
                     "payload": payload,
+                    **({"salt": salt} if salt else {}),
                 },
             )
             if not resp.get("ok"):

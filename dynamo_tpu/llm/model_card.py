@@ -71,6 +71,20 @@ class ModelDeploymentCard:
                 card.prompt_template = tc["chat_template"]
         return card
 
+    @classmethod
+    def for_adapter(
+        cls, base: "ModelDeploymentCard", adapter: str
+    ) -> "ModelDeploymentCard":
+        """Card for a LoRA adapter served as its own model name
+        (llm/tenancy): everything a frontend needs is the BASE model's
+        (tokenizer, template, context length) — the card only differs in
+        name and in ``extra["lora"]`` recording the adapter→base link."""
+        card = cls.from_dict(base.to_dict())
+        card.name = adapter
+        card.extra = dict(base.extra)
+        card.extra["lora"] = {"adapter": adapter, "base": base.name}
+        return card
+
     # ------------------------------------------------------------- publishing
     def key(self) -> str:
         return f"{MDC_PREFIX}{self.name}"

@@ -32,6 +32,10 @@ class NvExt(BaseModel):
     # draft-free speculation for this request; tokens are identical either
     # way — the knob shapes latency granularity and enables A/B runs).
     spec_decode: Optional[bool] = None
+    # Structured-output constraint (llm/tenancy/grammar.py): a regex string
+    # (restricted syntax) or a JSON-schema dict.  Wins over the standard
+    # ``response_format`` field when both are set.
+    grammar: Optional[Union[str, Dict[str, Any]]] = None
 
 
 class ChatMessage(BaseModel):
@@ -64,6 +68,11 @@ class CommonFields(BaseModel):
     stop: Optional[Union[str, List[str]]] = None
     n: int = 1
     nvext: Optional[NvExt] = None
+    # Structured output (OpenAI shape): {"type": "text" | "json_object" |
+    # "json_schema", "json_schema": {"name": ..., "schema": {...}}}.
+    # Compiled to a token-mask automaton at the preprocessor
+    # (llm/tenancy/grammar.py) and enforced as a per-row logit mask.
+    response_format: Optional[Dict[str, Any]] = None
 
     def stop_conditions(self) -> StopConditions:
         stop = self.stop

@@ -18,25 +18,99 @@ from .openai import ChatCompletionRequest, CompletionRequest, DeltaGenerator
 from .protocols import PreprocessedRequest
 from .tokenizer import BaseTokenizer
 
+# Sentinel for preprocess(grammar=...): distinguishes "compile it for me"
+# (the default, sync callers) from an explicitly precompiled value — which
+# may legitimately be None (generate() compiles off-loop first).
+_UNSET = object()
+
 
 class OpenAIPreprocessor(Operator):
-    """Chat/completions requests → token-level requests → OpenAI chunks."""
+    """Chat/completions requests → token-level requests → OpenAI chunks.
 
-    def __init__(self, tokenizer: BaseTokenizer, model_name: str = ""):
+    Multi-tenancy (llm/tenancy): this operator is the structured-output
+    compile point — it is the only pipeline layer holding the tokenizer, so
+    ``response_format`` / ``nvext.grammar`` constraints compile HERE into a
+    serializable token-mask automaton that rides the PreprocessedRequest
+    (engines just walk integers).  When the pipeline fronts a LoRA adapter
+    (``adapter=`` — one pipeline per served name under discovery), every
+    request is stamped with the adapter id and its KV-hash salt so the
+    KV-aware router and the engine agree on tenant cache identity.
+    """
+
+    def __init__(
+        self,
+        tokenizer: BaseTokenizer,
+        model_name: str = "",
+        adapter: Optional[str] = None,
+        grammar_compiler=None,
+    ):
         self._tokenizer = tokenizer
         self.model_name = model_name
+        self.adapter = adapter
+        # Shared across the sibling per-adapter preprocessors of one
+        # tokenizer when the caller passes it (cli http mode); lazily
+        # created otherwise.  Compilation is the expensive step (vocab
+        # indexing), so the cache matters for agent/tool-calling traffic.
+        self._grammar_compiler = grammar_compiler
+
+    def _constraint_spec(self, oai) -> Optional[dict]:
+        from .tenancy.grammar import constraint_spec
+
+        return constraint_spec(
+            getattr(oai, "response_format", None),
+            oai.nvext.grammar if oai.nvext else None,
+        )
+
+    def _compile_grammar(self, oai) -> Optional[dict]:
+        """Constraint spec → serialized automaton dict (None when the
+        request is unconstrained).  GrammarError (bad schema/regex) is a
+        ValueError: the HTTP edge maps it to 400."""
+        from .metrics import tenancy_metrics
+        from .tenancy.grammar import GrammarCompiler
+
+        spec = self._constraint_spec(oai)
+        if spec is None:
+            return None
+        if self._grammar_compiler is None:
+            self._grammar_compiler = GrammarCompiler(self._tokenizer)
+        before = self._grammar_compiler.compiles
+        automaton = self._grammar_compiler.compile(spec)
+        if self._grammar_compiler.compiles > before:
+            tenancy_metrics.grammar_compiles_total += 1
+        else:
+            tenancy_metrics.grammar_cache_hits_total += 1
+        return automaton.to_dict()
+
+    async def _compile_grammar_async(self, oai) -> Optional[dict]:
+        """Off-loop grammar compile: a cache miss indexes the whole
+        vocabulary (seconds on large vocabs) and must not stall every
+        concurrent stream on this process's event loop."""
+        if self._constraint_spec(oai) is None:
+            return None
+        import asyncio
+
+        return await asyncio.to_thread(self._compile_grammar, oai)
 
     # -- forward ------------------------------------------------------------
 
-    def preprocess(
-        self, oai: Union[ChatCompletionRequest, CompletionRequest, Dict[str, Any]]
-    ) -> PreprocessedRequest:
+    @staticmethod
+    def _parse(
+        oai: Union[ChatCompletionRequest, CompletionRequest, Dict[str, Any]]
+    ) -> Union[ChatCompletionRequest, CompletionRequest]:
         if isinstance(oai, dict):
-            oai = (
+            return (
                 ChatCompletionRequest.model_validate(oai)
                 if "messages" in oai
                 else CompletionRequest.model_validate(oai)
             )
+        return oai
+
+    def preprocess(
+        self,
+        oai: Union[ChatCompletionRequest, CompletionRequest, Dict[str, Any]],
+        grammar: Any = _UNSET,
+    ) -> PreprocessedRequest:
+        oai = self._parse(oai)
         if isinstance(oai, ChatCompletionRequest):
             if oai.nvext and oai.nvext.use_raw_prompt and len(oai.messages) == 1:
                 prompt = oai.messages[0].text()
@@ -64,12 +138,22 @@ class OpenAIPreprocessor(Operator):
                 annotations["formatted_prompt"] = prompt
             if "token_ids" in oai.nvext.annotations:
                 annotations["token_ids"] = token_ids
+        if self.adapter:
+            from .tenancy.lora import kv_salt_for_adapter
+
+            # Tenant identity rides the request: the engine resolves the
+            # adapter to a device slot, and the KV router salts its overlap
+            # hashing with the same value the engine seals blocks under —
+            # set HERE so routing happens before any engine is chosen.
+            annotations["adapter"] = self.adapter
+            annotations["kv_salt"] = kv_salt_for_adapter(self.adapter)
         return PreprocessedRequest(
             token_ids=token_ids,
             stop_conditions=oai.stop_conditions(),
             sampling_options=oai.sampling_options(),
             model=oai.model,
             annotations=annotations,
+            grammar=self._compile_grammar(oai) if grammar is _UNSET else grammar,
         )
 
     # -- the operator -------------------------------------------------------
@@ -77,13 +161,23 @@ class OpenAIPreprocessor(Operator):
     async def generate(self, request: Context, next: AsyncEngine) -> ResponseStream:
         raw = request.data
         chat = "messages" in raw if isinstance(raw, dict) else True
-        pre = self.preprocess(raw)
+        oai = self._parse(raw)
+        pre = self.preprocess(oai, grammar=await self._compile_grammar_async(oai))
         model = pre.model or self.model_name
         n = int(raw.get("n") or 1) if isinstance(raw, dict) else 1
+        # Only user-REQUESTED debug annotations (nvext.annotations) echo as
+        # the SSE ``annotation`` event; internal routing identity
+        # (llm/tenancy adapter/kv_salt, migration resume) stays off the
+        # client wire.
+        echo = {
+            k: v
+            for k, v in pre.annotations.items()
+            if k in ("formatted_prompt", "token_ids")
+        }
         if n <= 1:
             stream = await next.generate(request.transfer(pre.to_dict()))
             return ResponseStream(
-                self._to_chunks(stream, model, chat, request.id, pre.annotations),
+                self._to_chunks(stream, model, chat, request.id, echo),
                 request.ctx,
             )
         # n > 1: one engine request per choice — the prefix cache shares the
@@ -107,9 +201,7 @@ class OpenAIPreprocessor(Operator):
                 await next.generate(Context(pre_i.to_dict(), child))
             )
         return ResponseStream(
-            self._merge_choices(
-                streams, model, chat, request.id, pre.annotations
-            ),
+            self._merge_choices(streams, model, chat, request.id, echo),
             request.ctx,
         )
 

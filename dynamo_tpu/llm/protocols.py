@@ -17,6 +17,22 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 
+class ModelNotFoundError(LookupError):
+    """Request named a model/adapter nobody serves.  Raised by engines
+    (TpuEngine._resolve_adapter) and mapped to the OpenAI 404
+    ``model_not_found`` error body at the HTTP edge — never silently
+    falling through to the base model (llm/tenancy)."""
+
+    # Wire tag: the service transport ships this in its error prologue so
+    # remote callers (runtime/client.py RemoteEngineError.kind) can map the
+    # failure back to a 404 without importing this module.
+    error_kind = "model_not_found"
+
+    def __init__(self, model: str):
+        super().__init__(f"model {model!r} not found")
+        self.model = model
+
+
 class FinishReason(str, enum.Enum):
     STOP = "stop"  # hit eos or a stop sequence
     LENGTH = "length"  # hit max_tokens
@@ -116,15 +132,25 @@ class PreprocessedRequest:
     sampling_options: SamplingOptions = field(default_factory=SamplingOptions)
     model: Optional[str] = None
     annotations: Dict[str, Any] = field(default_factory=dict)
+    # Structured-output constraint (llm/tenancy/grammar.py): the serialized
+    # TokenMaskAutomaton dict compiled by the PREPROCESSOR (the only layer
+    # holding the tokenizer); engines deserialize by content hash and apply
+    # it as a per-row logit mask.  None = unconstrained.
+    grammar: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "token_ids": self.token_ids,
             "stop_conditions": self.stop_conditions.to_dict(),
             "sampling_options": self.sampling_options.to_dict(),
             "model": self.model,
             "annotations": self.annotations,
         }
+        if self.grammar is not None:
+            # Omitted when absent: pre-tenancy consumers (recorded streams,
+            # older workers) never see the key.
+            out["grammar"] = self.grammar
+        return out
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "PreprocessedRequest":
@@ -134,6 +160,7 @@ class PreprocessedRequest:
             sampling_options=SamplingOptions.from_dict(d.get("sampling_options") or {}),
             model=d.get("model"),
             annotations=dict(d.get("annotations") or {}),
+            grammar=d.get("grammar"),
         )
 
 

@@ -101,6 +101,50 @@ def resolve_model(spec: str, revision: Optional[str] = None) -> str:
         ) from e
 
 
+# A LoRA adapter directory's serving artifacts (llm/tenancy/lora.py —
+# PEFT layout): the factor tensors + the rank/alpha config.
+_ADAPTER_PATTERNS = [
+    "adapter_model.safetensors",
+    "adapter_config.json",
+]
+
+
+def resolve_adapter(spec: str) -> str:
+    """Resolve a LoRA adapter spec to a local PEFT directory, mirroring
+    ``resolve_model``: an existing directory passes through; anything else
+    is a HF repo id snapshot-downloaded (adapter artifacts only), with the
+    same pre-staged offline cache fallback under ``cache_dir()``."""
+    if os.path.isdir(spec):
+        return spec
+    staged = os.path.join(cache_dir(), spec.replace("/", "--"))
+    if os.path.isdir(staged) and os.path.exists(
+        os.path.join(staged, "adapter_model.safetensors")
+    ):
+        return staged
+    if "/" not in spec:
+        raise FileNotFoundError(
+            f"adapter {spec!r} is neither a local directory nor a HF repo "
+            f"id (org/name); pre-stage PEFT artifacts "
+            f"({', '.join(_ADAPTER_PATTERNS)}) at {staged}"
+        )
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as e:  # pragma: no cover - hub is in the image
+        raise FileNotFoundError(
+            f"adapter {spec!r} needs huggingface_hub to download; "
+            f"pre-stage the PEFT artifacts at {staged}"
+        ) from e
+    logger.info("downloading adapter %s", spec)
+    try:
+        return snapshot_download(repo_id=spec, allow_patterns=_ADAPTER_PATTERNS)
+    except Exception as e:
+        raise FileNotFoundError(
+            f"could not download adapter {spec!r} ({type(e).__name__}: {e});"
+            f" in an offline deployment pre-stage "
+            f"({', '.join(_ADAPTER_PATTERNS)}) at {staged}"
+        ) from e
+
+
 def tokenizer_spec(path: str) -> Optional[dict]:
     """Tokenizer spec dict (llm/discovery.make_tokenizer input) for a
     resolved checkpoint directory, or None if it ships no tokenizer."""

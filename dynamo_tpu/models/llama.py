@@ -139,6 +139,10 @@ class RaggedBatch(NamedTuple):
     page_indices: jnp.ndarray  # [S, pages_per_seq] int32
     cu_q_lens: jnp.ndarray  # [S+1] int32
     num_seqs: jnp.ndarray  # [1] int32
+    # Batched multi-LoRA (llm/tenancy): per-token resident adapter slot
+    # (-1 = base model).  None on LoRA-less engines — a None leaf vanishes
+    # from the jit treedef, so existing programs are byte-identical.
+    adapter_slots: Any = None  # [T] int32 | None
 
 
 def _dtype(config: ModelConfig):
@@ -219,6 +223,10 @@ def forward_ragged(
     # pallas kernel's native k_scale/v_scale only accepts static floats).
     kv_scale=None,
     decode: bool = False,  # static: every row is a single-token decode row
+    # Static per-slot rank of the LoRA device bank (llm/tenancy/lora.py);
+    # 0 = no LoRA.  Active only when BOTH the params tree carries bank
+    # leaves and the batch carries adapter_slots.
+    lora_rank: int = 0,
 ) -> Tuple[jnp.ndarray, PagedKVCache]:
     """Unified mixed prefill+decode forward over a flat ragged token run.
 
@@ -302,6 +310,31 @@ def forward_ragged(
 
     h = embed_lookup(params, rb.token_ids, _dtype(config))  # [T, D]
 
+    # Batched segmented multi-LoRA (S-LoRA on TPU; llm/tenancy/lora.py):
+    # all resident adapters' A/B factors live concatenated along a R*r rank
+    # axis, and a per-token segment mask zeroes every adapter's columns but
+    # the token's own — two dense matmuls serve rows from many adapters in
+    # ONE forward, with exact per-row isolation and no gather/scatter.
+    # Merge-free: the (possibly int8-quantized) base weights are untouched.
+    lora_mask = None
+    if (
+        lora_rank > 0
+        and rb.adapter_slots is not None
+        and "lora_a_wq" in params["layers"]
+    ):
+        Rr = params["layers"]["lora_a_wq"].shape[-1]
+        seg = jnp.arange(Rr, dtype=jnp.int32) // lora_rank  # column → slot
+        lora_mask = (
+            rb.adapter_slots[:, None] == seg[None, :]
+        ).astype(_dtype(config))  # [T, R*r]; slot -1 (base) matches nothing
+
+    def lora_delta(x_in, lp, name):
+        a = lp.get("lora_a_" + name)
+        if lora_mask is None or a is None:
+            return None
+        xa = (x_in @ a) * lora_mask  # [T, R*r], own-adapter columns only
+        return (xa @ lp["lora_b_" + name]).astype(x_in.dtype)
+
     # The page slab rides the layer scan as a CARRY over a flat
     # layer-merged view [L*P, ps, 2KV, hd]; each layer scatters its rows at
     # a layer offset and attention gathers via offset page indices.  Making
@@ -315,6 +348,15 @@ def forward_ragged(
         lp, l = xs
         x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
         q, k, v = qkv_proj(x, lp, H * hd, KV * hd)
+        if lora_mask is not None:
+            dq, dk, dv = (
+                lora_delta(x, lp, "wq"),
+                lora_delta(x, lp, "wk"),
+                lora_delta(x, lp, "wv"),
+            )
+            q = q if dq is None else q + dq
+            k = k if dk is None else k + dk
+            v = v if dv is None else v + dv
         q = q.reshape(T, H, hd)
         k = k.reshape(T, KV, hd)
         v = v.reshape(T, KV, hd)
@@ -333,7 +375,12 @@ def forward_ragged(
             q, k, v, s_l, pages, slots_l, rb.kv_lens,
             tables_l, rb.cu_q_lens, rb.num_seqs,
         )
-        h = h + linear(attn.reshape(T, H * hd), lp, "wo")
+        attn_flat = attn.reshape(T, H * hd)
+        o = linear(attn_flat, lp, "wo")
+        if lora_mask is not None:
+            do = lora_delta(attn_flat, lp, "wo")
+            o = o if do is None else o + do
+        h = h + o
         x = rms_norm(h, lp["mlp_norm"], config.rms_norm_eps)
         if config.is_moe:
             h = h + moe_mlp(x[None], lp, config)[0]

@@ -105,6 +105,15 @@ def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
             out[name], out[name + "_scale"] = q, s
     layers: Dict[str, Any] = {}
     for name, leaf in params["layers"].items():
+        if name.startswith("lora_"):
+            # Multi-LoRA device banks (llm/tenancy/lora.py) stay in float:
+            # adapters are merge-free deltas applied AROUND the (possibly
+            # int8) base projections, so quantizing them would re-calibrate
+            # nothing and lose the low-rank factors' dynamic range — and
+            # slots are rewritten at promotion time, which would invalidate
+            # any per-slot scale immediately.
+            layers[name] = leaf
+            continue
         axis = _LAYER_QUANT_AXES.get(name)
         if axis is None:
             layers[name] = leaf

@@ -23,7 +23,7 @@ reproducible regardless of how it was batched or preempted.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -44,7 +44,12 @@ class SampleOut(NamedTuple):
 
 
 class SamplingParams(NamedTuple):
-    """Per-row sampling state for one device step (host-built)."""
+    """Per-row sampling state for one device step (host-built).
+
+    Trailing fields default to None so pre-tenancy constructors keep
+    working; None leaves vanish from the jit treedef, so engines that never
+    use grammar masks / LoRA compile the exact same programs as before.
+    """
 
     seeds: object  # [B] uint32
     steps: object  # [B] int32 — output-token index (rng stream position)
@@ -55,6 +60,16 @@ class SamplingParams(NamedTuple):
     pres_penalty: object  # [B] f32
     counts: object  # [B, V] int16 output-token histogram
     need_logprobs: object  # [] bool
+    # Grammar-constrained decoding (llm/tenancy/grammar.py): packed
+    # admissible-token bitmask per row ([B, ceil(V/32)] uint32; bit i of
+    # word i//32 = token i admissible) + an any-rows-masked scalar that
+    # cond-skips the unpack entirely on unconstrained steps.
+    mask_words: object = None  # [B, W] uint32 | None
+    any_mask: object = None  # [] bool | None
+    # Batched multi-LoRA (llm/tenancy/lora.py): per-row resident adapter
+    # slot (-1 = base model), consumed by the fused decode program's
+    # RaggedBatch construction (models/llama.py adapter_slots).
+    adapter_slots: object = None  # [B] int32 | None
 
 
 def _filtered_logits(
@@ -108,8 +123,20 @@ def sample_tokens(
     pres_penalty: jnp.ndarray,  # [B] f32; 0 → disabled
     counts: jnp.ndarray,  # [B, V] int16 output-token counts (penalties)
     need_logprobs: jnp.ndarray,  # [] bool — any row wants logprobs
+    mask_words: Optional[jnp.ndarray] = None,  # [B, ceil(V/32)] uint32
+    any_mask: Optional[jnp.ndarray] = None,  # [] bool — any row masked
 ) -> SampleOut:
-    """Sample one token per row; optionally raw logprobs of the choice."""
+    """Sample one token per row; optionally raw logprobs of the choice.
+
+    ``mask_words`` (grammar-constrained decoding) is a packed per-row
+    admissible-token bitmask: inadmissible logits drop to NEG_INF BEFORE
+    temperature/top-k/top-p, so greedy and seeded sampling both draw from
+    exactly the admissible distribution (per-(seed, step) determinism is
+    untouched — same key, same step, masked logits).  Rows whose mask is
+    all-ones are unconstrained; the whole unpack is cond-skipped when
+    ``any_mask`` is false.  Reported logprobs stay the RAW model
+    distribution (OpenAI semantics), pre-penalty and pre-mask.
+    """
     B, V = logits.shape
 
     def penalized() -> jnp.ndarray:
@@ -120,6 +147,17 @@ def sample_tokens(
 
     any_pen = jnp.any((freq_penalty != 0.0) | (pres_penalty != 0.0))
     eff = lax.cond(any_pen, penalized, lambda: logits)
+
+    if mask_words is not None and any_mask is not None:
+
+        def masked() -> jnp.ndarray:
+            # [B, W] uint32 → [B, W, 32] bits → [B, W*32] → [:, :V]
+            shifts = jnp.arange(32, dtype=jnp.uint32)
+            bits = (mask_words[:, :, None] >> shifts[None, None, :]) & jnp.uint32(1)
+            admissible = bits.reshape(B, -1)[:, :V] != 0
+            return jnp.where(admissible, eff, NEG_INF)
+
+        eff = lax.cond(jnp.asarray(any_mask, jnp.bool_), masked, lambda: eff)
 
     greedy = jnp.argmax(eff, axis=-1).astype(jnp.int32)
     temp = jnp.maximum(temperature, 1e-6)[:, None]

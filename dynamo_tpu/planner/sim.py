@@ -34,9 +34,20 @@ class Arrival:
     t: float
     isl: int = 3000
     osl: int = 150
+    # Multi-tenant replay (llm/tenancy): route this request to a LoRA
+    # adapter (the OpenAI ``model`` field) and/or constrain it with a JSON
+    # schema (``response_format``).  Optional — single-tenant traces and
+    # pre-tenancy consumers never see the keys.
+    adapter: Optional[str] = None
+    schema: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"t": round(self.t, 6), "isl": self.isl, "osl": self.osl}
+        out: Dict[str, Any] = {"t": round(self.t, 6), "isl": self.isl, "osl": self.osl}
+        if self.adapter is not None:
+            out["adapter"] = self.adapter
+        if self.schema is not None:
+            out["schema"] = self.schema
+        return out
 
 
 def gen_trace(
@@ -102,6 +113,8 @@ def read_trace(path: str) -> List[Arrival]:
                     t=float(d["t"]),
                     isl=int(d.get("isl", 3000)),
                     osl=int(d.get("osl", 150)),
+                    adapter=d.get("adapter"),
+                    schema=d.get("schema"),
                 )
             )
     out.sort(key=lambda a: a.t)

@@ -99,6 +99,12 @@ class RuntimeConfig:
     # merges this section under any explicit --spec-* flags; nested env
     # works: ``DYN_SPEC_DECODE__ENABLE=true``, ``DYN_SPEC_DECODE__K=8``.
     spec_decode: Dict[str, Any] = field(default_factory=dict)
+    # Batched multi-LoRA defaults (engine/config.py LoraConfig keys:
+    # enable, max_adapters, rank, promote_timeout_s) plus an optional
+    # ``adapters`` map {name: path-or-repo-or-"random[:seed]"} loaded at
+    # engine start.  CLI --lora* flags win per key; nested env works:
+    # ``DYN_LORA__ENABLE=true``, ``DYN_LORA__MAX_ADAPTERS=8``.
+    lora: Dict[str, Any] = field(default_factory=dict)
     extra: Dict[str, Any] = field(default_factory=dict)  # unrecognized keys
 
     @classmethod

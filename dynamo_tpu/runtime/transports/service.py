@@ -41,11 +41,19 @@ class RemoteEngineError(RuntimeError):
     params, oversized prompt) — the Client's failover loop only ever retries
     the former; replaying a deterministic request error across every worker
     would just multiply the damage.
+
+    ``kind`` echoes the server prologue's error kind ("request" /
+    "internal" / an application tag like "model_not_found") so edges can
+    map specific remote failures to specific HTTP statuses without parsing
+    message text.
     """
 
-    def __init__(self, message: str, retryable: bool = True):
+    def __init__(
+        self, message: str, retryable: bool = True, kind: Optional[str] = None
+    ):
         super().__init__(message)
         self.retryable = retryable
+        self.kind = kind
 
 
 class ServiceServer:
@@ -140,8 +148,11 @@ class ServiceServer:
                     raise
                 except Exception as e:  # noqa: BLE001 — remote boundary
                     # Request-shape errors are the caller's fault — tag them
-                    # non-retryable so failover doesn't replay them.
-                    kind = (
+                    # non-retryable so failover doesn't replay them.  An
+                    # exception carrying its own ``error_kind`` (e.g.
+                    # ModelNotFoundError → "model_not_found") ships that tag
+                    # verbatim so the HTTP edge can map it to a status.
+                    kind = getattr(e, "error_kind", None) or (
                         "request"
                         if isinstance(e, (ValueError, TypeError, KeyError))
                         else "internal"
@@ -353,9 +364,11 @@ class RemoteEngine(AsyncEngine):
             if not prologue.get("ok"):
                 raise RemoteEngineError(
                     prologue.get("error", "remote engine error"),
-                    # Application errors (bad request shape) must not be
-                    # replayed on other workers; transport/worker sickness may.
-                    retryable=prologue.get("kind") != "request",
+                    # Application errors (bad request shape, unknown
+                    # model/adapter) must not be replayed on other workers;
+                    # transport/worker sickness may.
+                    retryable=prologue.get("kind") in (None, "internal", "endpoint"),
+                    kind=prologue.get("kind"),
                 )
         except BaseException:
             conn.release(sid)

@@ -754,7 +754,7 @@ async def test_http_no_instances_maps_to_503():
 
 
 class _FakeDisaggEngine:
-    def estimate_prefix_hit(self, tokens):
+    def estimate_prefix_hit(self, tokens, salt=None):
         return 0
 
     async def generate(self, request):

@@ -14,6 +14,10 @@ echo "== live-migration suite (exact-stream + drain acceptance) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_migration.py -q -m migration \
   -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== tenancy suite (structured output + multi-LoRA correctness gates) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q -m tenancy \
+  -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
