@@ -1,0 +1,2 @@
+"""Benchmark harnesses: loadgen (open/closed-loop HTTP load) and goodput
+(the trace-driven chaos ladder).  Importable so tests can drive rungs."""

@@ -1,0 +1,734 @@
+"""Chaos ladder: fleet goodput-under-SLO with escalating fault injection.
+
+The ROADMAP's fleet-scale goodput proof: replay a seeded arrival trace
+against a real multi-worker deployment (tiny native engines over the full
+hub + service + migration planes, planner signals live, health watchdog
+armed) while the fault ladder escalates L0→L4, and report DistServe-style
+goodput — the fraction of requests that complete AND meet their TTFT/ITL
+SLO — plus the dropped-stream count per rung.  Zero dropped streams is the
+acceptance bar: every fault in the ladder is one the resilience stack
+claims to survive (seeded resume, migration splice, hub session resume),
+so a drop is a regression, not noise.
+
+Ladder rungs (fault fractions are of the trace duration):
+
+====  =======================================================================
+L0    no faults — the baseline every other rung is scored against
+L1    ``worker_crash`` mid-trace (transport aborted, lease revoked; live
+      streams resume seeded on surviving workers)
+L2    L1 + ``slow_stream`` straggler window + REAL hub kill/restart during
+      the burst (snapshot restore, client session resume, watch re-arm)
+L3    L2 + ``kv_pressure`` window (admission squeeze → queue growth)
+L4    L3 + ``watch_error``/``error_prologue``/``delay`` storm + a second
+      worker crash — the everything-at-once rung
+====  =======================================================================
+
+Determinism: the trace, every request's sampling seed, and the fault
+schedule derive from ``--seed``.  Wall-clock latencies (and therefore the
+strict goodput number) carry scheduler noise, so the report separates a
+``deterministic`` core — per-request outcome, token count, and the hash of
+the exact token stream — which is byte-stable across runs of the same seed
+and is what the regression test compares.  Because every request is
+seeded, completed token streams must ALSO be identical across rungs: L0 is
+the unmigrated/unfaulted control, and ``--check`` verifies byte-identity
+for every resumed/spliced stream on the higher rungs.
+
+Usage:
+    JAX_PLATFORMS=cpu python benchmarks/goodput.py --levels 0,1,2 --seed 7 \
+        [--json out.json] [--check] [--fault-matrix tools_fault_matrix.json]
+
+``--check`` exits nonzero unless: every rung has 0 dropped streams, L2
+goodput >= 0.85 x L0 goodput, and all completed streams are token-identical
+to the L0 control.  tools/ci.sh runs exactly that as the standing L2 gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import json
+import logging
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+logger = logging.getLogger("goodput")
+
+REPORT_SCHEMA = "dynamo-tpu-goodput-v1"
+
+# Engine geometry for the CPU ladder: small enough to compile fast, big
+# enough that 3 workers x max_batch rows exercise real batching/preemption.
+ENGINE_CFG = dict(
+    model="debug-tiny",
+    block_size=4,
+    num_blocks=96,
+    max_batch=4,
+    max_model_len=256,
+    prefill_chunk=32,
+    dtype="float32",
+    decode_steps=2,
+    pipeline_depth=2,
+)
+
+NAMESPACE = "chaos"
+COMPONENT = "fleet"
+
+
+def _prompt_tokens(i: int, isl: int, vocab: int = 251) -> List[int]:
+    # Distinct per request (defeats prefix caching, like random ISL corpora).
+    return [(i * 7919 + j * 104729 + 11) % (vocab - 2) + 1 for j in range(isl)]
+
+
+def _pct(xs: List[float], p: float) -> Optional[float]:
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * p))]
+
+
+# --------------------------------------------------------------------------
+# Fault schedule
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault: ``kind`` at ``at`` (fraction of the trace
+    duration), optionally held until ``until``; ``worker`` indexes the
+    fleet; ``level`` feeds delay_s/magnitude; ``count`` caps firings."""
+
+    kind: str
+    at: float
+    until: Optional[float] = None
+    worker: Optional[int] = None
+    level: float = 0.0
+    count: Optional[int] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"kind": self.kind, "at": self.at}
+        if self.until is not None:
+            out["until"] = self.until
+        if self.worker is not None:
+            out["worker"] = self.worker
+        if self.level:
+            out["level"] = self.level
+        if self.count is not None:
+            out["count"] = self.count
+        return out
+
+
+def ladder_rungs() -> List[Dict[str, Any]]:
+    """The canonical L0–L4 ladder (docs/chaos.md documents each rung)."""
+    crash1 = FaultEvent("worker_crash", at=0.35, worker=1, count=1)
+    slow = FaultEvent("slow_stream", at=0.15, until=0.55, worker=0, level=0.12)
+    outage = FaultEvent("hub_outage", at=0.40, until=0.52)
+    pressure = FaultEvent("kv_pressure", at=0.50, until=0.80, level=0.6)
+    storm = [
+        FaultEvent("watch_error", at=0.25, count=2),
+        FaultEvent("error_prologue", at=0.45, count=2),
+        FaultEvent("delay", at=0.60, until=0.75, level=0.2),
+        FaultEvent("worker_crash", at=0.70, worker=2, count=1),
+    ]
+    return [
+        {"level": 0, "name": "L0-baseline", "events": []},
+        {"level": 1, "name": "L1-worker-crash", "events": [crash1]},
+        {"level": 2, "name": "L2-crash+straggler+hub-restart",
+         "events": [slow, crash1, outage]},
+        {"level": 3, "name": "L3-kv-pressure",
+         "events": [slow, crash1, outage, pressure]},
+        {"level": 4, "name": "L4-storm",
+         "events": [slow, crash1, outage, pressure, *storm]},
+    ]
+
+
+# --------------------------------------------------------------------------
+# Fleet
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _Worker:
+    runtime: Any
+    engine: Any
+    mig: Any
+    address: str
+    closed: bool = False
+
+
+class ChaosFleet:
+    """One rung's deployment: persistent hub + N migration-capable workers
+    (cli worker-mode wiring over shared prewarmed engines) + routed client
+    + planner signal plane + health watchdog."""
+
+    def __init__(self, engines: List[Any], persist_path: str,
+                 watchdog: bool = True):
+        self.engines = engines
+        self.persist_path = persist_path
+        self.enable_watchdog = watchdog
+        self.hub = None
+        self.hub_port: Optional[int] = None
+        self.workers: List[_Worker] = []
+        self.client = None
+        self.client_rt = None
+        self.collector = None
+        self.planner = None
+        self.watchdog = None
+        self._pubs: List[Any] = []
+
+    @property
+    def instance_prefix(self) -> str:
+        return f"instances/{NAMESPACE}/{COMPONENT}/gen/"
+
+    async def start(self) -> "ChaosFleet":
+        from dynamo_tpu.runtime import HubServer
+
+        self.hub = await HubServer(
+            persist_path=self.persist_path, persist_interval_s=0.2
+        ).start()
+        self.hub_port = self.hub.port
+        for engine in self.engines:
+            self.workers.append(await self._spawn_worker(engine))
+        await self._start_client_plane()
+        return self
+
+    async def _spawn_worker(self, engine) -> _Worker:
+        from dynamo_tpu.llm.kv_router.publisher import KvMetricsPublisher
+        from dynamo_tpu.llm.migration import (
+            MIGRATE_IN_ENDPOINT,
+            MIGRATE_OUT_ENDPOINT,
+            MigratableWorker,
+        )
+        from dynamo_tpu.runtime import DistributedRuntime
+
+        rt = await DistributedRuntime.connect(
+            self.hub.address, lease_ttl=1.5
+        )
+        mig = MigratableWorker(engine, chunk_blocks=4)
+        component = rt.namespace(NAMESPACE).component(COMPONENT)
+        gen_ep = component.endpoint("gen")
+        in_ep = component.endpoint(MIGRATE_IN_ENDPOINT)
+        out_ep = component.endpoint(MIGRATE_OUT_ENDPOINT)
+        server = await rt.service_server()
+        await in_ep.serve_endpoint(mig.migrate_in_handler)
+        await out_ep.serve_endpoint(mig.migrate_out_handler)
+        await gen_ep.serve_endpoint(
+            mig,
+            metadata={
+                "role": "decode",
+                "migrate": {
+                    "import_path": in_ep.path,
+                    "out_path": out_ep.path,
+                    "generate_path": gen_ep.path,
+                },
+            },
+        )
+        try:
+            self._pubs.append(
+                await KvMetricsPublisher(
+                    component, rt.worker_id, engine.metrics
+                ).start()
+            )
+        except Exception:  # noqa: BLE001 — signal plane is optional here
+            logger.warning("metrics publisher failed to start", exc_info=True)
+        worker = _Worker(rt, engine, mig, server.address)
+
+        async def die():
+            # worker_crash fired: finish the death the way SIGKILL would —
+            # the lease goes with the runtime, so discovery sees the corpse.
+            if not worker.closed:
+                worker.closed = True
+                await rt.close()
+
+        server.on_crash = die
+        return worker
+
+    async def _start_client_plane(self) -> None:
+        from dynamo_tpu.planner.policy import DecisionEngine
+        from dynamo_tpu.planner.service import Planner
+        from dynamo_tpu.planner.signals import SignalCollector
+        from dynamo_tpu.runtime import Client, DistributedRuntime, RetryPolicy
+        from dynamo_tpu.runtime.health import HealthConfig, HealthWatchdog
+
+        self.client_rt = await DistributedRuntime.connect(
+            self.hub.address, lease_ttl=1.5
+        )
+        self.client = Client(
+            self.client_rt.hub,
+            self.instance_prefix,
+            # Attempts sized so the empty-pool wait after a hub restart
+            # (watch resync lands before workers re-register) spans the
+            # full re-registration window.
+            retry_policy=RetryPolicy(
+                max_attempts=8, base_delay_s=0.1, max_delay_s=1.0
+            ),
+            breaker_reset_s=0.5,
+        )
+        await self.client.start()
+        await self.client.wait_for_instances(10)
+        component = self.client_rt.namespace(NAMESPACE).component(COMPONENT)
+        self.collector = await SignalCollector(
+            component, stale_after_s=5.0
+        ).start()
+        # Planner live in dry-run: its sensing/decision loop runs under
+        # chaos (the point), but the smoke fleet is not actuatable.
+        self.planner = await Planner(
+            self.collector, DecisionEngine(), interval_s=0.5, dry_run=True
+        ).start()
+        if self.enable_watchdog:
+            self.watchdog = await HealthWatchdog(
+                self.client_rt.hub,
+                self.instance_prefix,
+                config=HealthConfig(
+                    probe_interval_s=0.3,
+                    probe_timeout_s=0.6,
+                    quarantine_after=3,
+                    straggler_factor=4.0,
+                    straggler_min_ms=100.0,
+                    straggler_min_samples=4,
+                    straggler_streak=2,
+                    eject_grace_s=2.0,
+                ),
+            ).start()
+
+    # -- hub outage (the REAL kind: kill + restart from snapshot) ----------
+
+    async def kill_hub(self) -> None:
+        if self.hub is not None:
+            await self.hub.close()
+            self.hub = None
+
+    async def restart_hub(self) -> None:
+        from dynamo_tpu.runtime import HubServer
+
+        self.hub = await HubServer(
+            port=self.hub_port,
+            persist_path=self.persist_path,
+            persist_interval_s=0.2,
+        ).start()
+
+    # -- teardown ----------------------------------------------------------
+
+    async def close(self) -> None:
+        for obj in (self.watchdog, self.planner, self.collector):
+            if obj is not None:
+                await obj.stop()
+        for pub in self._pubs:
+            try:
+                await pub.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        if self.client is not None:
+            await self.client.close()
+        if self.client_rt is not None:
+            await self.client_rt.close()
+        for worker in self.workers:
+            if not worker.closed:
+                worker.closed = True
+                try:
+                    await worker.runtime.close()
+                except Exception:  # noqa: BLE001 — crashed mid-rung
+                    pass
+        if self.hub is not None:
+            await self.hub.close()
+        # Engines outlive the fleet (shared across rungs): wait for any
+        # sequences orphaned by a crash to cancel out.
+        deadline = time.monotonic() + 5.0
+        for engine in self.engines:
+            while engine.live_request_ids() and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+
+
+# --------------------------------------------------------------------------
+# Trace replay
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Outcome:
+    i: int
+    status: str = "pending"  # ok | dropped
+    tokens: int = 0
+    token_hash: str = ""
+    error: str = ""
+    ttft_ms: Optional[float] = None
+    itl_ms: List[float] = field(default_factory=list)
+
+
+def _request_dict(i: int, isl: int, osl: int, seed: int) -> Dict[str, Any]:
+    from dynamo_tpu.llm.protocols import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    return PreprocessedRequest(
+        token_ids=_prompt_tokens(i, isl),
+        stop_conditions=StopConditions(max_tokens=osl, ignore_eos=True),
+        sampling_options=SamplingOptions(
+            temperature=0.8, seed=seed * 100003 + i
+        ),
+    ).to_dict()
+
+
+async def prewarm_engine(engine, seed: int = 0) -> None:
+    """Pay the XLA compiles + KV export/inject path up front so rung (and
+    test) timings measure serving, not first-call compilation."""
+    from dynamo_tpu.runtime.engine import Context, collect
+
+    warm = _request_dict(10_000, 16, 4, seed)
+    await collect(await engine.generate(Context(dict(warm))))
+    payload = await engine.export_prompt_blocks(list(warm["token_ids"]))
+    if payload is not None:
+        await engine.inject_blocks(list(warm["token_ids"]), payload)
+
+
+async def _one_request(client, i: int, isl: int, osl: int, seed: int) -> Outcome:
+    from dynamo_tpu.runtime.engine import Context
+
+    out = Outcome(i=i)
+    tokens: List[int] = []
+    t0 = time.monotonic()
+    last = None
+    try:
+        stream = await client.generate(Context(_request_dict(i, isl, osl, seed)))
+        async for item in stream:
+            now = time.monotonic()
+            got = item.get("token_ids") or ()
+            if got:
+                if out.ttft_ms is None:
+                    out.ttft_ms = (now - t0) * 1e3
+                elif last is not None:
+                    out.itl_ms.append((now - last) * 1e3)
+                last = now
+                tokens.extend(int(t) for t in got)
+    except asyncio.CancelledError:
+        raise
+    except Exception as e:  # noqa: BLE001 — a dropped stream IS the datum
+        out.status = "dropped"
+        out.error = type(e).__name__
+        return out
+    out.status = "ok"
+    out.tokens = len(tokens)
+    out.token_hash = hashlib.sha256(
+        json.dumps(tokens).encode()
+    ).hexdigest()[:16]
+    return out
+
+
+async def _drive_fault(fleet: ChaosFleet, ev: FaultEvent, duration: float) -> None:
+    from dynamo_tpu.runtime import faults
+
+    await asyncio.sleep(ev.at * duration)
+    if ev.kind == "hub_outage":
+        logger.warning("[fault] hub kill (restart in %.1fs)",
+                       ((ev.until or ev.at) - ev.at) * duration)
+        await fleet.kill_hub()
+        await asyncio.sleep(max(((ev.until or ev.at) - ev.at) * duration, 0.1))
+        await fleet.restart_hub()
+        logger.warning("[fault] hub restarted")
+        return
+    match = "*"
+    if ev.worker is not None and ev.worker < len(fleet.workers):
+        match = fleet.workers[ev.worker].address
+    faults.arm(
+        ev.kind,
+        match=match,
+        count=ev.count,
+        delay_s=ev.level or 0.05,
+    )
+    if ev.until is not None:
+        await asyncio.sleep((ev.until - ev.at) * duration)
+        faults.disarm(ev.kind, match if match != "*" else None)
+
+
+async def run_rung(
+    engines: List[Any],
+    rung: Dict[str, Any],
+    *,
+    seed: int,
+    rate: float,
+    duration: float,
+    isl: int,
+    osl: int,
+    persist_path: str,
+    slo_ttft_s: float,
+    slo_itl_s: float,
+    watchdog: bool = True,
+) -> Dict[str, Any]:
+    from dynamo_tpu.planner.sim import gen_trace
+    from dynamo_tpu.runtime import faults
+    from dynamo_tpu.runtime.health import health_metrics, worker_latency
+    from dynamo_tpu.runtime.resilience import metrics as res
+
+    faults.reset()
+    worker_latency.reset()
+    trace = gen_trace(
+        "burst", rate=rate, duration_s=duration, seed=seed, isl=isl, osl=osl
+    )
+    before = {
+        "reconnects": res.hub_reconnects_total,
+        "sessions_resumed": res.hub_sessions_resumed_total,
+        "requeued": res.hub_requeued_items_total,
+        "stream_resumes": res.stream_resumes_total,
+        "migration_splices": res.migration_splices_total,
+        "failovers": res.failovers_total,
+        "quarantines": health_metrics.quarantines_total,
+        "ejections": health_metrics.ejections_total,
+    }
+    fleet = await ChaosFleet(
+        engines, persist_path, watchdog=watchdog
+    ).start()
+    t_start = time.monotonic()
+    fault_tasks = [
+        asyncio.ensure_future(_drive_fault(fleet, ev, duration))
+        for ev in rung["events"]
+    ]
+    req_tasks: List[asyncio.Task] = []
+    try:
+        for i, arrival in enumerate(trace):
+            delay = arrival.t - (time.monotonic() - t_start)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            req_tasks.append(
+                asyncio.ensure_future(
+                    _one_request(fleet.client, i, arrival.isl, arrival.osl, seed)
+                )
+            )
+        outcomes = await asyncio.gather(*req_tasks)
+        await asyncio.gather(*fault_tasks)
+    finally:
+        for t in (*req_tasks, *fault_tasks):
+            t.cancel()
+        faults.reset()
+        await fleet.close()
+    # -- scoring ------------------------------------------------------------
+    outcomes = sorted(outcomes, key=lambda o: o.i)
+    completed = [o for o in outcomes if o.status == "ok"]
+    dropped = [o for o in outcomes if o.status == "dropped"]
+    within_slo = [
+        o for o in completed
+        if (o.ttft_ms or 0.0) <= slo_ttft_s * 1e3
+        and max(o.itl_ms or [0.0]) <= slo_itl_s * 1e3
+    ]
+    n = max(len(outcomes), 1)
+    delta = lambda k, after: after - before[k]  # noqa: E731
+    report = {
+        "level": rung["level"],
+        "name": rung["name"],
+        "faults": [ev.to_dict() for ev in rung["events"]],
+        "requests": len(outcomes),
+        "completed": len(completed),
+        "dropped": len(dropped),
+        "dropped_errors": sorted({o.error for o in dropped}),
+        "shed": 0,  # no admission control in the direct-client harness
+        "goodput": len(within_slo) / n,
+        "completion_rate": len(completed) / n,
+        "ttft_p50_ms": _pct([o.ttft_ms for o in completed if o.ttft_ms], 0.5),
+        "ttft_p95_ms": _pct([o.ttft_ms for o in completed if o.ttft_ms], 0.95),
+        "itl_p95_ms": _pct(
+            [x for o in completed for x in o.itl_ms], 0.95
+        ),
+        "resilience": {
+            "reconnects": delta("reconnects", res.hub_reconnects_total),
+            "sessions_resumed": delta(
+                "sessions_resumed", res.hub_sessions_resumed_total
+            ),
+            "requeued": delta("requeued", res.hub_requeued_items_total),
+            "stream_resumes": delta("stream_resumes", res.stream_resumes_total),
+            "migration_splices": delta(
+                "migration_splices", res.migration_splices_total
+            ),
+            "failovers": delta("failovers", res.failovers_total),
+            "quarantines": delta(
+                "quarantines", health_metrics.quarantines_total
+            ),
+            "ejections": delta("ejections", health_metrics.ejections_total),
+        },
+        "deterministic": {
+            "outcomes": [
+                [o.i, o.status, o.tokens, o.token_hash] for o in outcomes
+            ],
+            "dropped": len(dropped),
+        },
+    }
+    return report
+
+
+# --------------------------------------------------------------------------
+# Ladder driver + checks
+# --------------------------------------------------------------------------
+
+
+def check_report(report: Dict[str, Any], min_ratio: float = 0.85) -> List[str]:
+    """The CI bars; returns human-readable violations (empty = pass)."""
+    problems: List[str] = []
+    rungs = {r["level"]: r for r in report["rungs"]}
+    if 0 not in rungs:
+        return ["no L0 baseline rung in report"]
+    l0 = rungs[0]
+    if l0["completed"] == 0:
+        problems.append("L0 completed no requests")
+    control = {o[0]: o[3] for o in l0["deterministic"]["outcomes"] if o[1] == "ok"}
+    for level, rung in sorted(rungs.items()):
+        if rung["dropped"] != 0:
+            problems.append(
+                f"L{level}: {rung['dropped']} dropped stream(s) "
+                f"{rung['dropped_errors']}"
+            )
+        if level > 0:
+            for i, status, _tokens, token_hash in rung["deterministic"]["outcomes"]:
+                if status == "ok" and i in control and token_hash != control[i]:
+                    problems.append(
+                        f"L{level}: request {i} token stream diverged from "
+                        f"the L0 control (resume/splice not exact)"
+                    )
+                    break
+    if 2 in rungs and l0["goodput"] > 0:
+        ratio = rungs[2]["goodput"] / l0["goodput"]
+        if ratio < min_ratio:
+            problems.append(
+                f"L2 goodput {rungs[2]['goodput']:.3f} is "
+                f"{ratio:.2f}x L0 ({l0['goodput']:.3f}); bar is {min_ratio}"
+            )
+    return problems
+
+
+async def run_ladder(args) -> Dict[str, Any]:
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+
+    levels = sorted({int(x) for x in str(args.levels).split(",") if x != ""})
+    rungs = [r for r in ladder_rungs() if r["level"] in levels]
+    n_workers = max(
+        [args.workers]
+        + [ev.worker + 1 for r in rungs for ev in r["events"]
+           if ev.worker is not None]
+    )
+    logger.info("building %d engines (%s)", n_workers, ENGINE_CFG["model"])
+    engines = [TpuEngine(EngineConfig(**ENGINE_CFG)) for _ in range(n_workers)]
+    for engine in engines:
+        await prewarm_engine(engine, args.seed)
+    fault_matrix = None
+    if args.fault_matrix:
+        try:
+            fault_matrix = json.loads(Path(args.fault_matrix).read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            logger.warning("could not read fault matrix %s: %s",
+                           args.fault_matrix, e)
+    report: Dict[str, Any] = {
+        "schema": REPORT_SCHEMA,
+        "seed": args.seed,
+        "trace": {"shape": "burst", "rate": args.rate,
+                  "duration_s": args.duration, "isl": args.isl,
+                  "osl": args.osl},
+        "slo": {"ttft_s": args.slo_ttft_s, "itl_s": args.slo_itl_s},
+        "workers": n_workers,
+        "rungs": [],
+    }
+    try:
+        for rung in rungs:
+            logger.info("=== rung %s ===", rung["name"])
+            persist = str(
+                Path(args.workdir) / f"hub-l{rung['level']}.json"
+            )
+            Path(persist).unlink(missing_ok=True)
+            r = await run_rung(
+                engines,
+                rung,
+                seed=args.seed,
+                rate=args.rate,
+                duration=args.duration,
+                isl=args.isl,
+                osl=args.osl,
+                persist_path=persist,
+                slo_ttft_s=args.slo_ttft_s,
+                slo_itl_s=args.slo_itl_s,
+                watchdog=not args.no_watchdog,
+            )
+            report["rungs"].append(r)
+            logger.info(
+                "%s: goodput=%.3f completed=%d/%d dropped=%d resilience=%s",
+                rung["name"], r["goodput"], r["completed"], r["requests"],
+                r["dropped"], r["resilience"],
+            )
+    finally:
+        for engine in engines:
+            await engine.close()
+    if fault_matrix is not None:
+        swept = set(fault_matrix.get("fault_kinds") or ()) or {
+            row.get("fault", "").split(" ")[0]
+            for row in fault_matrix.get("fault_matrix", [])
+        }
+        used = {ev["kind"] for r in report["rungs"] for ev in r["faults"]}
+        report["fault_matrix"] = {
+            "path": args.fault_matrix,
+            "swept_kinds": sorted(swept),
+            "unswept_used_kinds": sorted(
+                k for k in used if k != "hub_outage" and k not in swept
+            ),
+        }
+    l0 = next((r for r in report["rungs"] if r["level"] == 0), None)
+    for r in report["rungs"]:
+        r["goodput_vs_l0"] = (
+            r["goodput"] / l0["goodput"]
+            if l0 and l0["goodput"] > 0 else None
+        )
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--levels", default="0,1,2", help="comma list of rungs")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--rate", type=float, default=2.5, help="arrivals/s")
+    ap.add_argument("--duration", type=float, default=6.0, help="trace seconds")
+    ap.add_argument("--isl", type=int, default=12)
+    ap.add_argument("--osl", type=int, default=8)
+    ap.add_argument("--workers", type=int, default=3)
+    # CPU-smoke SLOs: generous enough that only pathological stalls (a
+    # resume that spins, an outage that never heals) violate them — the
+    # goodput signal on CI is recovery, not raw speed.  Hardware ladder
+    # runs pass real DistServe-style budgets here.
+    ap.add_argument("--slo-ttft-s", type=float, default=20.0)
+    ap.add_argument("--slo-itl-s", type=float, default=5.0)
+    ap.add_argument("--json", default=None, help="write the report here")
+    ap.add_argument("--check", action="store_true",
+                    help="enforce the CI bars (exit 1 on violation)")
+    ap.add_argument("--min-goodput-ratio", type=float, default=0.85)
+    ap.add_argument("--fault-matrix", default=None,
+                    help="tools/fault_matrix.py --json artifact to cross-check")
+    ap.add_argument("--no-watchdog", action="store_true")
+    ap.add_argument("--workdir", default="/tmp")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+
+    report = asyncio.run(run_ladder(args))
+    print(json.dumps(
+        {k: v for k, v in report.items() if k != "rungs"}, indent=2
+    ))
+    for r in report["rungs"]:
+        print(json.dumps({k: v for k, v in r.items()
+                          if k != "deterministic"}, indent=2))
+    if args.json:
+        Path(args.json).write_text(json.dumps(report, indent=2))
+        print(f"wrote {args.json}")
+    if args.check:
+        problems = check_report(report, args.min_goodput_ratio)
+        if problems:
+            for p in problems:
+                print(f"CHECK FAILED: {p}", file=sys.stderr)
+            return 1
+        print("all chaos-ladder checks passed "
+              f"(levels {[r['level'] for r in report['rungs']]}, "
+              "0 dropped streams)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -488,13 +488,15 @@ class WorkerRoles:
         if h is None:
             return
         if h.get("mig") is not None:
-            # De-advertise the migrate capability FIRST: target discovery
-            # filters on instance metadata, so two concurrently-draining
-            # workers must stop seeing each other as receivers before
-            # either starts pushing KV (mutual migration would cut both
-            # streams over into workers about to stop).
+            # Close the drain race at BOTH ends.  (1) Accept-time gate:
+            # refuse migrate-in from here on — even a peer holding a stale
+            # hub snapshot that still advertises us gets refused when its
+            # push arrives, so mutual drains are impossible regardless of
+            # metadata propagation timing.  (2) De-advertise the migrate
+            # capability so fresh target discovery stops picking us.
             from .llm.migration import drain_via_migration
 
+            h["mig"].stop_accepting()
             try:
                 md = {
                     k: v

@@ -794,13 +794,28 @@ class TpuEngine(
 
     def _grammar_automaton(self, g: Dict[str, Any]):
         """Deserialize (or LRU-hit) a request's token-mask automaton and fix
-        its mask geometry to this engine's vocab/eos."""
-        from ..llm.tenancy.grammar import TokenMaskAutomaton
+        its mask geometry to this engine's vocab/eos.
+
+        Hash-first wire protocol (llm/tenancy): the preprocessor ships a
+        hash-only stub by default; a content-hash LRU hit resolves it with
+        zero table bytes on the wire, a miss raises GrammarCacheMissError
+        (prologue kind ``grammar_miss``) and the preprocessor re-sends the
+        full edge table exactly once."""
+        from ..llm.metrics import tenancy_metrics
+        from ..llm.tenancy.grammar import (
+            GrammarCacheMissError,
+            TokenMaskAutomaton,
+        )
 
         key = g.get("hash")
         automaton = self._grammar_lru.pop(key, None) if key else None
         if automaton is None:
+            if g.get("stub") or "edges" not in g:
+                tenancy_metrics.grammar_hash_misses_total += 1
+                raise GrammarCacheMissError(str(key))
             automaton = TokenMaskAutomaton.from_dict(g)
+        elif g.get("stub"):
+            tenancy_metrics.grammar_hash_hits_total += 1
         self._grammar_lru[automaton.hash] = automaton  # LRU refresh/insert
         while len(self._grammar_lru) > 32:
             self._grammar_lru.pop(next(iter(self._grammar_lru)))

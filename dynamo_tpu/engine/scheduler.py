@@ -343,10 +343,18 @@ class Scheduler:
                 break
             if not self._try_admit(seq):
                 own_pins = len(seq.pin_ids or [])
-                if not self.running and self.kv.active_blocks <= own_pins:
+                if (
+                    not self.running
+                    and self.kv.active_blocks <= own_pins
+                    and not self._pressure_reserve()
+                ):
                     # Pool entirely free (apart from this request's OWN
                     # pre-admission pin) and it still doesn't fit: this
                     # request can never run — reject instead of deadlocking.
+                    # (Not under an armed kv_pressure squeeze: that pool is
+                    # SYNTHETICALLY small and the right behaviour is to
+                    # stall until the fault clears, exactly like waiting
+                    # out a real tenant's HBM.)
                     self.waiting.popleft()
                     self._release_pin(seq)
                     self.rejected.append(seq)
@@ -396,6 +404,9 @@ class Scheduler:
         if seq.frozen:
             return False  # mid-migration: schedule() will not admit it
         prompt_blocks = (len(seq.prompt) + self.cfg.block_size) // self.cfg.block_size
+        reserve = self._pressure_reserve()
+        if reserve and prompt_blocks + reserve > self.kv.free_blocks:
+            return False  # squeezed pool: the head cannot land right now
         if prompt_blocks <= self.kv.free_blocks:
             return True  # fits even with zero prefix hits: skip the hashing
         # The fused pipeline polls this twice per chunk at saturation; the
@@ -412,10 +423,27 @@ class Scheduler:
             seq._admit_hash_cache = cached
         return self.kv.would_fit(cached[1], prompt_blocks)
 
+    def _pressure_reserve(self) -> int:
+        """Blocks withheld from ADMISSION by the ``kv_pressure`` fault point
+        (chaos ladder): a squeezed pool stalls newcomers — queue depth and
+        TTFT rise exactly as they would when real tenants hold the HBM —
+        without destabilizing already-running sequences."""
+        from ..runtime.faultinject import faults
+
+        if not faults.enabled:
+            return 0
+        level = faults.level_for("kv_pressure")
+        if level <= 0:
+            return 0
+        return int(self.kv.num_blocks * min(level, 1.0))
+
     def _try_admit(self, seq: SequenceState) -> bool:
         """Allocate prompt blocks (sharing any cached prefix)."""
         prompt_blocks = (len(seq.prompt) + self.cfg.block_size) // self.cfg.block_size
         # ^ +1 slack block so the first decode token always has a slot.
+        reserve = self._pressure_reserve()
+        if reserve and prompt_blocks + reserve > self.kv.free_blocks:
+            return False  # kv_pressure fault: pool squeezed, head waits
         seq.block_seq.extend(seq.prompt)
         alloc = self.kv.allocate_sequence(seq.block_seq.blocks, prompt_blocks)
         if alloc is None:

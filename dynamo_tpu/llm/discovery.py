@@ -163,24 +163,75 @@ class ModelWatcher:
         self._clients.clear()
 
     async def _run(self) -> None:
-        try:
-            async for event in self._watcher:
-                name = event.key[len(MODEL_PREFIX) :].rsplit("/", 1)[0]
+        """Model watch with hub-restart recovery: on watcher death (e.g.
+        ``HubSessionLost``) the watch is re-armed and the served-model set
+        resynced — models deregistered during the outage tear down, new
+        ones build, surviving ones keep their warm pipelines/caches."""
+        backoff = 0.1
+        while True:
+            try:
+                async for event in self._watcher:
+                    backoff = 0.1
+                    name = event.key[len(MODEL_PREFIX) :].rsplit("/", 1)[0]
+                    try:
+                        if event.type == "put":
+                            await self._handle_put(name, event.value)
+                        else:
+                            await self._handle_delete(name)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:  # noqa: BLE001 — keep watching
+                        logger.exception(
+                            "model watcher failed handling %s", event.key
+                        )
+                return  # closed cleanly (stop())
+            except asyncio.CancelledError:
+                return
+            except Exception:  # noqa: BLE001 — re-arm below
+                logger.exception("model watch died; re-arming + resync")
+            while True:
                 try:
-                    if event.type == "put":
-                        await self._handle_put(name, event.value)
-                    else:
-                        await self._handle_delete(name)
+                    await asyncio.sleep(backoff)
+                    backoff = min(backoff * 2, 5.0)
+                    old, self._watcher = self._watcher, None
+                    if old is not None:
+                        try:
+                            await old.aclose()
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception:  # noqa: BLE001 — dead watcher
+                            pass
+                    self._watcher = await self.runtime.hub.watch_prefix(
+                        MODEL_PREFIX
+                    )
+                    await self._resync()
+                    break
                 except asyncio.CancelledError:
-                    raise
-                except Exception:  # noqa: BLE001 — keep watching
-                    logger.exception("model watcher failed handling %s", event.key)
-        except asyncio.CancelledError:
-            pass
+                    return
+                except Exception:  # noqa: BLE001 — hub still down
+                    logger.warning("model watch re-arm failed; retrying")
+
+    async def _resync(self) -> None:
+        """Reconcile against the hub's current model registrations after a
+        watch gap.  Names gone from the hub tear down now; refcounts reset
+        to zero because the re-armed watch replays the current keys as its
+        snapshot (each put re-counts one registration) — ``_handle_put``
+        reuses live pipelines, so surviving models keep warm state."""
+        snapshot = await self.runtime.hub.kv_get_prefix(MODEL_PREFIX)
+        live = {
+            key[len(MODEL_PREFIX):].rsplit("/", 1)[0]
+            for key in snapshot
+        }
+        for name in [n for n in list(self._clients) if n not in live]:
+            self._refcount[name] = 1  # force the teardown path
+            await self._handle_delete(name)
+        self._refcount = {}
 
     async def _handle_put(self, name: str, entry: Dict[str, Any]) -> None:
         self._refcount[name] = self._refcount.get(name, 0) + 1
-        if self._refcount[name] > 1:
+        if name in self._clients:
+            # Already built (refcount > 1, or a post-resync snapshot replay
+            # re-counting a surviving model): keep the warm pipeline.
             return
         ns, comp, ep = parse_endpoint_path(entry["endpoint"])
         endpoint = self.runtime.namespace(ns).component(comp).endpoint(ep)

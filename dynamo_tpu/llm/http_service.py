@@ -155,6 +155,7 @@ class HttpService:
         # do the engine's speculative-decoding gauges when the engine is
         # colocated (llm/metrics.py spec_metrics).
         from ..planner.pmetrics import metrics as planner_metrics
+        from ..runtime.health import health_metrics
         from .metrics import migration_metrics, spec_metrics, tenancy_metrics
 
         body = (
@@ -164,6 +165,7 @@ class HttpService:
             + spec_metrics.render(self._metrics_prefix).encode()
             + migration_metrics.render(self._metrics_prefix).encode()
             + tenancy_metrics.render(self._metrics_prefix).encode()
+            + health_metrics.render(self._metrics_prefix).encode()
         )
         return web.Response(body=body, content_type="text/plain")
 

@@ -329,6 +329,11 @@ class TenancyMetrics:
         self.grammar_cache_hits_total = 0
         self.grammar_masked_rows_total = 0  # device rows sampled under a mask
         self.grammar_violations_total = 0   # defensive: inadmissible accepts
+        # hash-first wire protocol (engine content-hash LRU)
+        self.grammar_hash_hits_total = 0    # stubs resolved with zero bytes
+        self.grammar_hash_misses_total = 0  # stubs that forced a full resend
+        self.grammar_full_resends_total = 0  # preprocessor-side fallbacks
+        self.grammar_stub_dispatches_total = 0  # stubs accepted first try
         # multi-LoRA
         self.adapters_registered = 0      # gauge: host-pool size
         self.adapter_promotions = 0       # host→device slot writes
@@ -365,6 +370,18 @@ class TenancyMetrics:
         emit("grammar_violations_total", "counter",
              "Accepted tokens the mask should have forbidden (defensive; "
              "always 0)", self.grammar_violations_total)
+        emit("grammar_hash_hits_total", "counter",
+             "Hash-only grammar stubs resolved from the engine LRU",
+             self.grammar_hash_hits_total)
+        emit("grammar_hash_misses_total", "counter",
+             "Hash-only grammar stubs that forced a full-table resend",
+             self.grammar_hash_misses_total)
+        emit("grammar_full_resends_total", "counter",
+             "Constrained dispatches that fell back to the full edge table",
+             self.grammar_full_resends_total)
+        emit("grammar_stub_dispatches_total", "counter",
+             "Constrained dispatches served hash-only end to end",
+             self.grammar_stub_dispatches_total)
         emit("lora_adapters_registered", "gauge",
              "Adapters in the host pool", self.adapters_registered)
         emit("lora_promotions_total", "counter",

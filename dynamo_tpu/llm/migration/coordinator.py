@@ -61,8 +61,10 @@ async def pick_migration_target(
     Draining workers de-advertise ``metadata.migrate`` before calling this
     (cli WorkerRoles.stop_decode), so concurrent drains do not pick each
     other.  A hub snapshot read before a peer's de-advertise propagates
-    can still name it; the resulting migration then aborts or rolls back
-    harmlessly (the source stays authoritative)."""
+    can still name it — but the capability is RE-CHECKED AT ACCEPT TIME:
+    a draining target's ``MigratableWorker.accepting`` gate refuses the
+    migrate-in, the migration aborts or rolls back harmlessly (the source
+    stays authoritative), and the next round picks a live receiver."""
     try:
         snapshot = await hub.kv_get_prefix(instance_prefix)
     except asyncio.CancelledError:

@@ -76,16 +76,32 @@ class MigratableWorker(AsyncEngine):
         # short-circuit the service plane (tests; single-process fleets).
         self.direct = direct or {}
         self._clients: Dict[str, Client] = {}
+        # Accept-time capability gate: a draining worker flips this False
+        # BEFORE starting its own migrate-out (cli WorkerRoles.stop_decode),
+        # closing the de-advertise propagation race — a peer whose hub
+        # snapshot predates the metadata rewrite can still PICK this worker,
+        # but the pick is re-checked here at accept time and refused, so two
+        # concurrent drains can never migrate into each other.
+        self.accepting = True
 
     # ------------------------------------------------------------- serving
     async def generate(self, request: Context) -> ResponseStream:
         return await self.serve.generate(request)
+
+    def stop_accepting(self) -> None:
+        """Refuse future migrate-in traffic (drain/quarantine path)."""
+        self.accepting = False
 
     # ---------------------------------------------------------- target side
     async def migrate_in_handler(self, request: Context) -> AsyncIterator[Dict]:
         yield await self._migrate_in(request.data)
 
     async def _migrate_in(self, data: Dict[str, Any]) -> Dict[str, Any]:
+        if not self.accepting:
+            # Sources treat any refusal as abort/rollback: the sequence
+            # stays authoritative on the source and another target is
+            # picked on the next drain round.
+            return {"ok": False, "error": "target draining; migrate-in refused"}
         kind = data.get("kind", "blocks")
         tokens = list(data["token_ids"])
         # Tenant sequences (llm/tenancy) seal KV under a salted hash chain;

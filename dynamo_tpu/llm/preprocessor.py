@@ -10,6 +10,7 @@ formatted prompt / token ids back to the caller as annotation events.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 from typing import Any, AsyncIterator, Dict, Optional, Union
 
 from ..runtime.engine import AsyncEngine, Context, ResponseStream
@@ -52,6 +53,13 @@ class OpenAIPreprocessor(Operator):
         # created otherwise.  Compilation is the expensive step (vocab
         # indexing), so the cache matters for agent/tool-calling traffic.
         self._grammar_compiler = grammar_compiler
+        # Hash-first dispatch state per automaton hash: {"misses", "full"}.
+        # After a miss the next 2**misses dispatches ship the full table —
+        # with round-robin routing a single miss→resend pair can seed the
+        # SAME two workers forever (the stub always lands on the unseeded
+        # one), so the full-table burst walks the rotation and seeds the
+        # whole fleet before stubs are retried.
+        self._grammar_wire: Dict[str, Dict[str, int]] = {}
 
     def _constraint_spec(self, oai) -> Optional[dict]:
         from .tenancy.grammar import constraint_spec
@@ -156,6 +164,59 @@ class OpenAIPreprocessor(Operator):
             grammar=self._compile_grammar(oai) if grammar is _UNSET else grammar,
         )
 
+    # -- dispatch -----------------------------------------------------------
+
+    @staticmethod
+    def _is_grammar_miss(exc: BaseException) -> bool:
+        from ..runtime.transports.service import RemoteEngineError
+        from .tenancy.grammar import GrammarCacheMissError
+
+        if isinstance(exc, GrammarCacheMissError):
+            return True  # in-process engine (cli run out=tpu)
+        return (
+            isinstance(exc, RemoteEngineError) and exc.kind == "grammar_miss"
+        )
+
+    async def _dispatch(self, next: AsyncEngine, ctx, pre) -> ResponseStream:
+        """Hash-first constrained dispatch (ROADMAP tenancy carry-over):
+        ship the automaton's content hash alone; only an engine whose LRU
+        lacks it answers ``grammar_miss``, and exactly then the full edge
+        table (KBs per request on a real vocabulary) goes over the wire.
+        Repeated misses (cold fleet) switch to an exponentially growing
+        full-table burst that seeds the routing rotation, then stubs are
+        retried.  Unconstrained requests dispatch unchanged."""
+        from .metrics import tenancy_metrics
+
+        g = pre.grammar
+        if not g or not g.get("hash") or "edges" not in g:
+            return await next.generate(Context(pre.to_dict(), ctx))
+        state = self._grammar_wire.setdefault(
+            g["hash"], {"misses": 0, "full": 0}
+        )
+        if len(self._grammar_wire) > 256:  # bounded (hash churn)
+            # (`next` names the downstream engine here — index, don't iter.)
+            self._grammar_wire.pop(list(self._grammar_wire)[0])
+        if state["full"] > 0:
+            state["full"] -= 1
+        else:
+            stub = dataclasses.replace(
+                pre, grammar={"hash": g["hash"], "stub": True}
+            )
+            try:
+                stream = await next.generate(Context(stub.to_dict(), ctx))
+                state["misses"] = 0
+                tenancy_metrics.grammar_stub_dispatches_total += 1
+                return stream
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not self._is_grammar_miss(e):
+                    raise
+                state["misses"] += 1
+                state["full"] = min(2 ** state["misses"], 16)
+                tenancy_metrics.grammar_full_resends_total += 1
+        return await next.generate(Context(pre.to_dict(), ctx))
+
     # -- the operator -------------------------------------------------------
 
     async def generate(self, request: Context, next: AsyncEngine) -> ResponseStream:
@@ -175,7 +236,7 @@ class OpenAIPreprocessor(Operator):
             if k in ("formatted_prompt", "token_ids")
         }
         if n <= 1:
-            stream = await next.generate(request.transfer(pre.to_dict()))
+            stream = await self._dispatch(next, request.ctx, pre)
             return ResponseStream(
                 self._to_chunks(stream, model, chat, request.id, echo),
                 request.ctx,
@@ -183,8 +244,6 @@ class OpenAIPreprocessor(Operator):
         # n > 1: one engine request per choice — the prefix cache shares the
         # prompt KV across them; streams merge with per-choice indices.
         # Reference: protocols/openai (n) + multiple SSE choice indices.
-        import dataclasses
-
         from ..runtime.engine import AsyncEngineContext
 
         streams = []
@@ -197,9 +256,7 @@ class OpenAIPreprocessor(Operator):
                     pre.sampling_options, seed=pre.sampling_options.seed + i
                 )
                 pre_i = dataclasses.replace(pre, sampling_options=so)
-            streams.append(
-                await next.generate(Context(pre_i.to_dict(), child))
-            )
+            streams.append(await self._dispatch(next, child, pre_i))
         return ResponseStream(
             self._merge_choices(streams, model, chat, request.id, echo),
             request.ctx,

@@ -50,6 +50,24 @@ class GrammarError(ValueError):
     """Unsupported/invalid constraint (maps to HTTP 400 at the edge)."""
 
 
+class GrammarCacheMissError(ValueError):
+    """A hash-only grammar stub missed the engine's content-hash LRU.
+
+    Not a request error in the usual sense: the DISPATCHER (preprocessor)
+    owns the full automaton and re-sends it on this signal.  ``error_kind``
+    rides the service-plane prologue so the remote flavour surfaces as a
+    non-retryable ``RemoteEngineError(kind="grammar_miss")`` — replaying
+    the stub on other workers would just collect more misses."""
+
+    error_kind = "grammar_miss"
+
+    def __init__(self, content_hash: str):
+        super().__init__(
+            f"grammar {content_hash!r} not in engine cache; resend full table"
+        )
+        self.content_hash = content_hash
+
+
 # --------------------------------------------------------------------------
 # Restricted regex syntax: literals, escapes, [...] classes (ranges,
 # negation), ( ) grouping, |, *, +, ?, {m}, {m,n}, {m,}.  This is the syntax
@@ -448,6 +466,14 @@ class TokenMaskAutomaton:
                 "hash": self.hash,
             }
         return self._wire
+
+    def wire_stub(self) -> Dict[str, Any]:
+        """Hash-only wire form (content-addressed dispatch): engines whose
+        LRU already holds this automaton resolve it from the hash alone —
+        the full edge table (KBs per constrained request on real vocabs)
+        ships only after an explicit ``GrammarCacheMissError`` round trip
+        (the preprocessor's fallback)."""
+        return {"hash": self.hash, "stub": True}
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "TokenMaskAutomaton":

@@ -26,6 +26,7 @@ from ..llm.kv_router.protocols import ForwardPassMetrics
 from ..llm.kv_router.publisher import KV_METRICS_TOPIC, unpack_message
 from ..llm.kv_router.scheduler import KV_HIT_RATE_SUBJECT
 from ..runtime.component import INSTANCE_PREFIX
+from ..runtime.health import QUARANTINE_PREFIX, worker_latency
 
 logger = logging.getLogger(__name__)
 
@@ -234,11 +235,16 @@ class SignalCollector:
         # TTL (instance-gone events delete rows — lease expiry IS the
         # liveness signal here, exactly like every other watcher).
         self._pool_of: Dict[int, str] = {}
+        # Watchdog quarantine view (runtime/health.py): quarantined workers
+        # are excluded from the pool stats so the planner never counts a
+        # draining straggler as usable capacity.
+        self._quarantined: set = set()
         self._hit_isl = 0
         self._hit_overlap = 0
         self._tasks: List[asyncio.Task] = []
         self._subs: List[Any] = []
         self._watcher = None
+        self._q_watcher = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -249,14 +255,15 @@ class SignalCollector:
         e_sub = await self.component.namespace.subscribe(SLO_METRICS_TOPIC)
         self._subs = [m_sub, h_sub, e_sub]
         ns = self.component.namespace.name
-        self._watcher = await self.component.runtime.hub.watch_prefix(
-            f"{INSTANCE_PREFIX}/{ns}/"
-        )
+        hub = self.component.runtime.hub
+        self._watcher = await hub.watch_prefix(f"{INSTANCE_PREFIX}/{ns}/")
+        self._q_watcher = await hub.watch_prefix(QUARANTINE_PREFIX)
         self._tasks = [
             loop.create_task(self._consume_metrics(m_sub)),
             loop.create_task(self._consume_hit_rate(h_sub)),
             loop.create_task(self._consume_edges(e_sub)),
-            loop.create_task(self._consume_instances(self._watcher)),
+            loop.create_task(self._consume_instances()),
+            loop.create_task(self._consume_quarantine()),
         ]
         await self._watcher.synced.wait()
         return self
@@ -274,9 +281,11 @@ class SignalCollector:
             if hasattr(sub, "aclose"):
                 await sub.aclose()
         self._subs = []
-        if self._watcher is not None:
-            await self._watcher.aclose()
-            self._watcher = None
+        for attr in ("_watcher", "_q_watcher"):
+            w = getattr(self, attr)
+            if w is not None:
+                await w.aclose()
+                setattr(self, attr, None)
 
     # -- consumers ---------------------------------------------------------
 
@@ -315,20 +324,107 @@ class SignalCollector:
         except asyncio.CancelledError:
             pass
 
-    async def _consume_instances(self, watcher) -> None:
+    async def _watch_consume(self, attr: str, prefix: str, on_event, on_resync) -> None:
+        """Shared watch-consume loop with hub-restart recovery: a dead
+        watcher (e.g. ``HubSessionLost`` after a hub crash) is re-armed and
+        the derived state fully resynced from a fresh snapshot — deletes
+        missed during the outage must not leave phantom state (the same
+        recovery shape as the routed Client's instance watch)."""
+        hub = self.component.runtime.hub
+        backoff = 0.1
+        while True:
+            try:
+                async for event in getattr(self, attr):
+                    backoff = 0.1
+                    on_event(event)
+                return  # closed cleanly (collector shutdown)
+            except asyncio.CancelledError:
+                return
+            except Exception:  # noqa: BLE001 — re-arm below
+                logger.warning(
+                    "planner watch %r died; re-arming", prefix, exc_info=True
+                )
+            while True:
+                try:
+                    await asyncio.sleep(backoff)
+                    backoff = min(backoff * 2, 5.0)
+                    old = getattr(self, attr)
+                    setattr(self, attr, None)
+                    if old is not None:
+                        try:
+                            await old.aclose()
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception:  # noqa: BLE001 — dead watcher
+                            pass
+                    setattr(self, attr, await hub.watch_prefix(prefix))
+                    on_resync(await hub.kv_get_prefix(prefix))
+                    break
+                except asyncio.CancelledError:
+                    return
+                except Exception:  # noqa: BLE001 — hub still down
+                    logger.warning(
+                        "planner watch %r re-arm failed; retrying", prefix
+                    )
+
+    # instance watch: pool membership
+    def _apply_instance_event(self, event) -> None:
+        parsed = classify_instance(event.key, event.value)
+        if parsed is None:
+            return
+        worker_id, pool = parsed
+        if event.type == "put":
+            self._pool_of[worker_id] = pool
+        else:  # lease expiry / deregistration: worker is GONE
+            self._pool_of.pop(worker_id, None)
+            self._metrics.pop(worker_id)
+
+    def _resync_instances(self, snapshot: Dict[str, Any]) -> None:
+        fresh: Dict[int, str] = {}
+        for key, value in snapshot.items():
+            parsed = classify_instance(key, value)
+            if parsed is not None:
+                fresh[parsed[0]] = parsed[1]
+        for wid in set(self._pool_of) - set(fresh):
+            self._metrics.pop(wid)
+        self._pool_of = fresh
+
+    async def _consume_instances(self) -> None:
+        ns = self.component.namespace.name
+        await self._watch_consume(
+            "_watcher",
+            f"{INSTANCE_PREFIX}/{ns}/",
+            self._apply_instance_event,
+            self._resync_instances,
+        )
+
+    # quarantine watch: watchdog markers → pool-view exclusion
+    def _apply_quarantine_event(self, event) -> None:
         try:
-            async for event in watcher:
-                parsed = classify_instance(event.key, event.value)
-                if parsed is None:
-                    continue
-                worker_id, pool = parsed
-                if event.type == "put":
-                    self._pool_of[worker_id] = pool
-                else:  # lease expiry / deregistration: worker is GONE
-                    self._pool_of.pop(worker_id, None)
-                    self._metrics.pop(worker_id)
-        except asyncio.CancelledError:
-            pass
+            wid = int(event.key[len(QUARANTINE_PREFIX):])
+        except ValueError:
+            return
+        if event.type == "put":
+            self._quarantined.add(wid)
+        else:
+            self._quarantined.discard(wid)
+
+    def _resync_quarantine(self, snapshot: Dict[str, Any]) -> None:
+        fresh = set()
+        for key in snapshot:
+            try:
+                fresh.add(int(key[len(QUARANTINE_PREFIX):]))
+            except ValueError:
+                continue
+        self._quarantined = fresh
+
+    async def _consume_quarantine(self) -> None:
+        await self._watch_consume(
+            "_q_watcher",
+            QUARANTINE_PREFIX,
+            self._apply_quarantine_event,
+            self._resync_quarantine,
+        )
 
     # -- views -------------------------------------------------------------
 
@@ -346,15 +442,36 @@ class SignalCollector:
         ]
         return max(vals) if vals else None
 
+    def worker_slo_view(self) -> Dict[int, Dict[str, Any]]:
+        """Merged per-worker TTFT/ITL view from the live edges' slo_metrics
+        publications (``workers`` key) — a planner-side HealthWatchdog's
+        ``latency_source`` when it does not share a process with the
+        routed client."""
+        merged: Dict[int, Dict[str, Any]] = {}
+        for edge in self._edges.values():
+            for wid, row in (edge.get("workers") or {}).items():
+                try:
+                    wid = int(wid)
+                except (TypeError, ValueError):
+                    continue
+                prev = merged.get(wid)
+                if prev is None or row.get("n", 0) > prev.get("n", 0):
+                    merged[wid] = row
+        return merged
+
     async def snapshot(self) -> SignalSnapshot:
         by_pool: Dict[str, Dict[int, ForwardPassMetrics]] = {}
         for worker_id, m in self._metrics.items():
+            if worker_id in self._quarantined:
+                continue  # draining under watchdog quarantine: not capacity
             pool = self._pool_of.get(worker_id, "decode")
             by_pool.setdefault(pool, {})[worker_id] = m
         # Discovery-known workers that have not published metrics yet still
         # count toward pool SIZE (a just-scaled-up worker must not read as
         # "pool shrank" while it warms up).
         for worker_id, pool in self._pool_of.items():
+            if worker_id in self._quarantined:
+                continue
             by_pool.setdefault(pool, {}).setdefault(
                 worker_id, ForwardPassMetrics()
             )
@@ -406,6 +523,11 @@ class EdgeSloPublisher:
     async def publish_once(self) -> None:
         snap = self.metrics.edge_slo_snapshot()
         snap["edge_id"] = self.edge_id
+        # Per-worker TTFT/ITL p50s observed by this edge's routed clients
+        # (runtime/health.py): the planner-side watchdog's straggler feed.
+        workers = worker_latency.snapshot()
+        if workers:
+            snap["workers"] = {str(wid): row for wid, row in workers.items()}
         await self.namespace.publish(SLO_METRICS_TOPIC, snap)
 
     async def _run(self) -> None:

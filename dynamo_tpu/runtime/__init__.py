@@ -22,6 +22,14 @@ from .resilience import (
     RetryPolicy,
 )
 from .faultinject import faults
+from .health import (
+    HealthConfig,
+    HealthWatchdog,
+    WorkerLatencyTracker,
+    health_metrics,
+    probe_address,
+    worker_latency,
+)
 from .component import (
     Component,
     DistributedRuntime,
@@ -30,7 +38,13 @@ from .component import (
     endpoint_path,
     parse_endpoint_path,
 )
-from .transports.hub import HubClient, HubServer, InprocHub, WatchEvent
+from .transports.hub import (
+    HubClient,
+    HubServer,
+    HubSessionLost,
+    InprocHub,
+    WatchEvent,
+)
 from .transports.service import RemoteEngine, RemoteEngineError, ServiceServer
 
 __all__ = [
@@ -45,6 +59,13 @@ __all__ = [
     "DeadlineExceededError",
     "RetryPolicy",
     "faults",
+    "HealthConfig",
+    "HealthWatchdog",
+    "WorkerLatencyTracker",
+    "health_metrics",
+    "probe_address",
+    "worker_latency",
+    "HubSessionLost",
     "Component",
     "DistributedRuntime",
     "Endpoint",

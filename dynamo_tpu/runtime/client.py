@@ -23,11 +23,13 @@ import asyncio
 import enum
 import logging
 import random
+import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 logger = logging.getLogger(__name__)
 
 from .engine import AsyncEngine, Context, ResponseStream
+from .health import worker_latency
 from .resilience import (
     CircuitBreaker,
     Deadline,
@@ -346,7 +348,38 @@ class Client(AsyncEngine):
             if deadline is not None and deadline.expired:
                 metrics.deadline_exceeded_total += 1
                 raise DeadlineExceededError("deadline exceeded (routing)")
-            wid, info = self._pick(worker_id, mode, exclude=state["tried"])
+            try:
+                wid, info = self._pick(worker_id, mode, exclude=state["tried"])
+            except NoInstancesError:
+                if worker_id is not None:
+                    raise  # direct routing: the chosen worker is simply gone
+                # Pool TRANSIENTLY empty — e.g. a hub restart resynced the
+                # instance watch before the workers' lease monitors re-put
+                # their registrations.  The fleet is still serving, so wait
+                # for discovery to repopulate within the retry budget
+                # instead of failing a survivable request (a hub crash
+                # pauses traffic, it doesn't kill it).
+                state["attempt"] += 1
+                metrics.retries_total += 1
+                if state["attempt"] >= policy.max_attempts:
+                    metrics.retries_exhausted_total += 1
+                    raise
+                delay = max(policy.backoff(state["attempt"]), 0.1)
+                if deadline is not None:
+                    delay = min(delay, max(deadline.remaining(), 0.0))
+                logger.warning(
+                    "request %s: no live instances under %r; waiting %.2fs "
+                    "for discovery (attempt %d/%d)",
+                    request.id, self.instance_prefix, delay,
+                    state["attempt"], policy.max_attempts,
+                )
+                try:
+                    await asyncio.wait_for(self._ready.wait(), delay)
+                except asyncio.TimeoutError:
+                    pass
+                # Membership changed wholesale: prior exclusions are stale.
+                state["tried"] = set()
+                continue
             address = info["address"]
             breaker = self._breaker(address)
             breaker.on_attempt()
@@ -493,6 +526,11 @@ class _StreamGuard:
         self._stream = stream
         self._allow_failover = allow_failover
         self._got_first = False
+        # Per-worker latency observations (runtime/health.py): the routed
+        # client is the one vantage point that sees queueing + transport +
+        # engine together, so the straggler scan feeds off these.
+        self._t_dispatched = time.monotonic()
+        self._t_last_item: Optional[float] = None
         # Resume bookkeeping: the fed-token stream (base prompt + every
         # delivered token) and the original prompt length.  Only tracked
         # for token-shaped requests (dict with token_ids) — other payloads
@@ -538,16 +576,38 @@ class _StreamGuard:
                         self._deadline,
                     )
                 )
+                self._reset_latency_anchor()
                 continue
             if isinstance(item, dict) and item.get("migrated"):
                 await self._splice(item["migrated"])
                 continue
+            now = time.monotonic()
+            if not self._got_first:
+                worker_latency.record_ttft(
+                    self._wid, self._address,
+                    (now - self._t_dispatched) * 1e3,
+                )
+            elif self._t_last_item is not None:
+                worker_latency.record_itl(
+                    self._wid, self._address,
+                    (now - self._t_last_item) * 1e3,
+                )
+            self._t_last_item = now
             self._got_first = True
             if self._all_tokens is not None and isinstance(item, dict):
                 self._all_tokens.extend(item.get("token_ids") or ())
             return item
 
     # -- recovery helpers ---------------------------------------------------
+
+    def _reset_latency_anchor(self) -> None:
+        """Re-anchor the per-worker latency observations after any
+        re-dispatch (failover, resume, splice): the recovery gap belongs to
+        the WORKER THAT FAILED, not to the replacement — charging it there
+        would make the watchdog's straggler scan quarantine the healthy
+        failover target exactly when the fleet is already degraded."""
+        self._t_dispatched = time.monotonic()
+        self._t_last_item = None
 
     def _track_request(self, data: Any) -> None:
         """(Re)anchor resume tracking on a request payload: its token_ids
@@ -625,6 +685,7 @@ class _StreamGuard:
             request, None, self._mode, self._state, self._deadline
         )
         self._request = request
+        self._reset_latency_anchor()
         metrics.stream_resumes_total += 1
         return True
 
@@ -700,6 +761,7 @@ class _StreamGuard:
             )
         self._stream = stream
         self._request = request
+        self._reset_latency_anchor()
         # The target's view of the fed stream is authoritative from here.
         self._track_request(req_data)
         # The in-flight request is now the self-contained resolved-seed

@@ -61,15 +61,17 @@ class DistributedRuntime:
     # and re-registers if a stall still outlives the lease.
     DEFAULT_LEASE_TTL = 10.0
 
-    def __init__(self, hub, host: str = "127.0.0.1"):
+    def __init__(self, hub, host: str = "127.0.0.1", lease_ttl: Optional[float] = None):
         self.hub = hub
         self.worker_id: int = uuid.uuid4().int & ((1 << 63) - 1)
         self.primary_lease: Optional[int] = None
+        self.lease_ttl = lease_ttl or self.DEFAULT_LEASE_TTL
         self._host = host
         self._service_server: Optional[ServiceServer] = None
         self._shutdown_event = asyncio.Event()
         # key → value for every primary-lease registration, so a lost lease
-        # (event-loop stall > TTL) self-heals: re-grant + re-put everything.
+        # (event-loop stall > TTL, hub restart) self-heals: re-grant +
+        # re-put everything.
         self._registrations: Dict[str, Any] = {}
         self._lease_monitor_task: Optional[asyncio.Task] = None
 
@@ -79,12 +81,17 @@ class DistributedRuntime:
         return await cls(hub)._init()
 
     @classmethod
-    async def connect(cls, address: str, host: str = "127.0.0.1") -> "DistributedRuntime":
+    async def connect(
+        cls,
+        address: str,
+        host: str = "127.0.0.1",
+        lease_ttl: Optional[float] = None,
+    ) -> "DistributedRuntime":
         hub = await HubClient(address).connect()
-        return await cls(hub, host=host)._init()
+        return await cls(hub, host=host, lease_ttl=lease_ttl)._init()
 
     async def _init(self) -> "DistributedRuntime":
-        self.primary_lease = await self.hub.lease_grant(self.DEFAULT_LEASE_TTL)
+        self.primary_lease = await self.hub.lease_grant(self.lease_ttl)
         self._lease_monitor_task = asyncio.get_running_loop().create_task(
             self._lease_monitor()
         )
@@ -101,11 +108,18 @@ class DistributedRuntime:
 
     async def _lease_monitor(self) -> None:
         """Elastic recovery (SURVEY §5 failure detection): if the primary
-        lease expired (e.g. a compile stalled the loop past the TTL), grant a
-        fresh one and restore every tracked registration — the worker
-        re-appears to watchers instead of staying dead."""
+        lease expired (e.g. a compile stalled the loop past the TTL, or the
+        hub itself restarted and lost all lease state), grant a fresh one
+        and restore every tracked registration — the worker re-appears to
+        watchers instead of staying dead.
+
+        A hub outage must NOT kill this monitor: it is the exact mechanism
+        by which a worker rejoins a restarted hub, so connection errors are
+        retried on a shortened cadence until shutdown."""
+        interval = self.lease_ttl
         while not self._shutdown_event.is_set():
-            await asyncio.sleep(self.DEFAULT_LEASE_TTL)
+            await asyncio.sleep(interval)
+            interval = self.lease_ttl
             if self.primary_lease is None:
                 continue
             try:
@@ -114,13 +128,20 @@ class DistributedRuntime:
                     continue
                 logger.warning("primary lease lost; re-registering %d keys",
                                len(self._registrations))
-                self.primary_lease = await self.hub.lease_grant(
-                    self.DEFAULT_LEASE_TTL
-                )
+                self.primary_lease = await self.hub.lease_grant(self.lease_ttl)
                 for key, value in list(self._registrations.items()):
                     await self.hub.kv_put(key, value, self.primary_lease)
-            except (ConnectionError, RuntimeError, asyncio.CancelledError):
+            except asyncio.CancelledError:
                 return
+            except (ConnectionError, RuntimeError, OSError):
+                # Hub unreachable or mid-restart: retry soon — the backoff
+                # budget for fleet re-registration is this cadence plus the
+                # HubClient's own reconnect backoff.
+                interval = min(self.lease_ttl, max(self.lease_ttl / 5.0, 0.2))
+                logger.warning(
+                    "lease monitor: hub unreachable; retrying in %.1fs",
+                    interval,
+                )
 
     async def service_server(self) -> ServiceServer:
         if self._service_server is None:
@@ -145,8 +166,13 @@ class DistributedRuntime:
             await self._service_server.close()
         if self.primary_lease is not None:
             try:
-                await self.hub.lease_revoke(self.primary_lease)
-            except (ConnectionError, RuntimeError):
+                # Bounded: revoking against a down/reconnecting hub must not
+                # park teardown for the client's whole grace budget — an
+                # unrevoked lease just expires by TTL.
+                await asyncio.wait_for(
+                    self.hub.lease_revoke(self.primary_lease), 2.0
+                )
+            except (ConnectionError, RuntimeError, asyncio.TimeoutError):
                 pass
         await self.hub.close()
 

@@ -18,6 +18,16 @@ point              hooked in                                  simulates
                                                               watchers stale
 ``watch_error``    ``transports/hub.Watcher``                 watch stream
                                                               crash
+``worker_crash``   ``transports/service.ServiceServer``       whole worker
+                   (aborts EVERY connection + stops           dies mid-step
+                   accepting; fires ``on_crash``)
+``hub_outage``     ``transports/hub.HubServer``               control plane
+                   (drops new + established connections       down (leases,
+                   while armed; disarm = hub back up)         watches, queues)
+``slow_stream``    ``transports/service.ServiceServer``       straggler: ITL
+                   (``delay_s`` sleep before each item)       outlier worker
+``kv_pressure``    ``engine/scheduler`` free-block view       KV pool squeeze
+                   (``delay_s`` = fraction withheld)          → preemptions
 =================  =========================================  ==============
 
 Arming: programmatic (``faults.arm("connect_error", match=addr, count=2)``)
@@ -135,6 +145,14 @@ class FaultInjector:
             self._prune(point)
         return fault.delay_s
 
+    def level_for(self, point: str, key: str = "") -> float:
+        """Non-consuming magnitude lookup: the armed fault's ``delay_s``
+        reinterpreted as a level (e.g. ``kv_pressure`` = fraction of the
+        free-block pool withheld), or 0.0 when not armed.  Holding faults
+        read this every pass, so it never counts against ``count``."""
+        fault = self._find(point, key)
+        return 0.0 if fault is None else fault.delay_s
+
     def _prune(self, point: str) -> None:
         kept = [f for f in self._points.get(point, []) if not f.exhausted]
         if kept:
@@ -146,7 +164,7 @@ class FaultInjector:
     # -- env ----------------------------------------------------------------
 
     def load_env(self, raw: Optional[str] = None) -> None:
-        """Parse ``DYN_FAULTS`` (``point[:match][#count]`` comma-list)."""
+        """Parse ``DYN_FAULTS`` (``point[:match][@level][#count]`` list)."""
         raw = os.environ.get(ENV_VAR, "") if raw is None else raw
         for spec in filter(None, (s.strip() for s in raw.split(","))):
             count: Optional[int] = None
@@ -155,8 +173,15 @@ class FaultInjector:
                 spec, _, count_s = spec.rpartition("#")
                 if count_s.isdigit():
                     count = int(count_s)
+            delay_s = 0.05
+            if "@" in spec:
+                spec, _, level_s = spec.rpartition("@")
+                try:
+                    delay_s = float(level_s)
+                except ValueError:
+                    spec = f"{spec}@{level_s}"  # not a level; restore
             point, _, match = spec.partition(":")
-            self.arm(point, match=match or "*", count=count)
+            self.arm(point, match=match or "*", count=count, delay_s=delay_s)
 
 
 faults = FaultInjector()

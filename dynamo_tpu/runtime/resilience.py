@@ -332,6 +332,12 @@ class ResilienceMetrics:
         # Mid-stream crash recoveries: a seeded request's stream was
         # reconstructed from delivered tokens and resumed elsewhere.
         self.stream_resumes_total = 0
+        # Hub session resume (transports/hub.py HubClient): reconnects to a
+        # restarted/recovered hub, subscriptions re-armed onto their live
+        # consumers, and unacked queue items returned to the queue.
+        self.hub_reconnects_total = 0
+        self.hub_sessions_resumed_total = 0
+        self.hub_requeued_items_total = 0
         self.admission_shed: Dict[str, int] = {}
         self.breaker_transitions: Dict[Tuple[str, str], int] = {}
         self._breakers: Dict[str, CircuitBreaker] = {}
@@ -380,6 +386,16 @@ class ResilienceMetrics:
                 "Seeded streams resumed on another worker after a "
                 "mid-stream crash",
                 self.stream_resumes_total)
+        counter("hub_reconnects_total",
+                "Hub connections re-established after loss",
+                self.hub_reconnects_total)
+        counter("hub_sessions_resumed_total",
+                "Hub subscriptions re-armed across a reconnect",
+                self.hub_sessions_resumed_total)
+        counter("hub_requeued_items_total",
+                "Unacked queue items returned to the hub queue on "
+                "connection loss",
+                self.hub_requeued_items_total)
         lines.append(f"# HELP {ns}_admission_shed_total Requests shed at admission")
         lines.append(f"# TYPE {ns}_admission_shed_total counter")
         for code, n in sorted(self.admission_shed.items()):

@@ -32,6 +32,7 @@ import itertools
 import json
 import logging
 import os
+import random
 import time
 import uuid
 from collections import deque
@@ -41,6 +42,20 @@ from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Set, Tupl
 from ..faultinject import faults
 
 logger = logging.getLogger(__name__)
+
+
+class HubSessionLost(ConnectionError):
+    """The hub connection dropped mid-watch.  Server-side watch state is
+    gone, so deltas may have been missed: the consumer must re-arm the
+    watch (``hub.watch_prefix`` again — it blocks until the hub is back)
+    and RESYNC its derived state from a fresh ``kv_get_prefix`` snapshot.
+    Every long-lived watcher in the tree follows this recovery shape."""
+
+
+# Queue sentinel a reconnecting HubClient injects into live watch queues:
+# the old server-side watch died with the connection, so the Watcher must
+# surface HubSessionLost rather than silently starve.
+_WATCH_LOST = object()
 
 
 # --------------------------------------------------------------------------
@@ -101,7 +116,7 @@ class HubState:
         self._kv: Dict[str, Any] = {}
         self._kv_lease: Dict[str, int] = {}
         self._leases: Dict[int, _Lease] = {}
-        self._lease_ids = itertools.count(1)
+        self._next_lease_id = 1
         self._revision = 0
         # watch id → (prefix, asyncio.Queue of WatchEvent)
         self._watches: Dict[str, Tuple[str, asyncio.Queue]] = {}
@@ -171,8 +186,10 @@ class HubState:
         return True
 
     def _notify(self, event: WatchEvent) -> None:
-        if faults.enabled and faults.is_armed("watch_stall"):
-            # Simulated hub partition: deltas silently stop reaching
+        if faults.enabled and (
+            faults.is_armed("watch_stall") or faults.is_armed("hub_outage")
+        ):
+            # Simulated hub partition/outage: deltas silently stop reaching
             # watchers (their view goes stale until the fault clears).
             return
         for prefix, q in self._watches.values():
@@ -199,7 +216,8 @@ class HubState:
     # -- leases -------------------------------------------------------------
 
     async def lease_grant(self, ttl: float) -> int:
-        lid = next(self._lease_ids)
+        lid = self._next_lease_id
+        self._next_lease_id += 1
         self._leases[lid] = _Lease(lid, ttl, time.monotonic() + ttl)
         return lid
 
@@ -229,6 +247,8 @@ class HubState:
         self._subs.pop(sid, None)
 
     async def publish(self, subject: str, payload: Any) -> int:
+        if faults.enabled and faults.is_armed("hub_outage"):
+            return 0  # event plane down with the hub
         n = 0
         for pattern, q in self._subs.values():
             if subject_matches(pattern, subject):
@@ -291,7 +311,10 @@ class HubState:
     def snapshot(self) -> Dict[str, Any]:
         """Durable state: KV entries NOT bound to leases (lease-bound keys
         are live-worker registrations that must re-register on rejoin) plus
-        queued + in-flight work items (at-least-once across restart)."""
+        queued + in-flight work items (at-least-once across restart).  The
+        lease-id floor also persists: a restarted hub must never re-issue
+        an id a pre-restart client still keeps alive (its keepalives would
+        silently sustain a stranger's lease)."""
         return {
             "kv": {
                 k: v for k, v in self._kv.items() if k not in self._kv_lease
@@ -304,9 +327,15 @@ class HubState:
             "inflight": [
                 [queue, item] for queue, item in self._inflight.values()
             ],
+            "lease_floor": self._next_lease_id,
         }
 
     def restore(self, snap: Dict[str, Any]) -> None:
+        try:
+            floor = int(snap.get("lease_floor", 1))
+        except (TypeError, ValueError):
+            floor = 1
+        self._next_lease_id = max(self._next_lease_id, floor)
         for k, v in (snap.get("kv") or {}).items():
             self._kv[k] = v
         for name, items in (snap.get("queues") or {}).items():
@@ -367,6 +396,10 @@ class Watcher(_QueueIter):
             raise RuntimeError("[fault] injected watch stream failure")
         while True:
             ev = await super().__anext__()
+            if ev is _WATCH_LOST:
+                raise HubSessionLost(
+                    "hub connection lost; re-arm the watch and resync"
+                )
             if ev.type == "sync":
                 self.synced.set()
                 continue
@@ -606,8 +639,16 @@ class HubServer:
 
         try:
             while True:
+                if faults.enabled and faults.is_armed("hub_outage"):
+                    # Simulated hub outage: drop the connection without a
+                    # goodbye (clients observe exactly what a dead hub
+                    # looks like and enter their reconnect loops; the
+                    # accept path below drops fresh dials the same way).
+                    break
                 line = await reader.readline()
                 if not line:
+                    break
+                if faults.enabled and faults.is_armed("hub_outage"):
                     break
                 try:
                     msg = json.loads(line)
@@ -724,22 +765,66 @@ class HubServer:
 # --------------------------------------------------------------------------
 
 
+class _SubSession:
+    """A live client-side subscription: survives reconnects (the server-side
+    sid is rebound; the local queue and its consumer never change)."""
+
+    __slots__ = ("sid", "pattern", "queue")
+
+    def __init__(self, sid: str, pattern: str, queue: asyncio.Queue):
+        self.sid = sid
+        self.pattern = pattern
+        self.queue = queue
+
+
 class HubClient:
     """Asyncio client for HubServer; same interface as InprocHub.
 
     Leases granted through this client are kept alive automatically by a
     background task (ttl/3 cadence) until ``lease_revoke``/``close`` — the
     reference's etcd lease keep-alive loop (transports/etcd/lease.rs:51).
+
+    Session resume (hub restart survival): a lost connection enters a
+    full-jitter backoff reconnect loop instead of bricking the client.
+    While down, ``_request`` parks callers for up to ``request_grace_s``
+    (a hub crash pauses the fleet rather than killing it); on reconnect:
+
+    - **subscriptions** re-arm transparently — the event plane is lossy by
+      contract, so the same local queue is re-bound to a fresh server-side
+      subscription and consumers never notice;
+    - **watches** CANNOT resume transparently (deltas were missed and the
+      snapshot-then-delta contract would be silently broken), so each live
+      watcher raises ``HubSessionLost`` — every consumer in the tree
+      already owns a re-arm+resync recovery path for exactly this;
+    - **unacked queue items** are counted as requeued (the server's
+      disconnect/restart handling re-enqueues them; at-least-once holds)
+      and the ack tokens dropped.
     """
 
-    def __init__(self, address: str):
+    RECONNECT_BACKOFF_INITIAL = 0.05
+
+    def __init__(
+        self,
+        address: str,
+        reconnect: bool = True,
+        reconnect_max_s: float = 2.0,
+        request_grace_s: float = 10.0,
+    ):
         self.address = address
+        self.reconnect = reconnect
+        self.reconnect_max_s = reconnect_max_s
+        self.request_grace_s = request_grace_s
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._rids = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
         self._watch_queues: Dict[str, asyncio.Queue] = {}
         self._sub_queues: Dict[str, asyncio.Queue] = {}
+        # sid → live subscription session (pattern + queue); reconnect
+        # re-arms these server-side and rebinds the NEW sid to the session.
+        self._sub_sessions: Dict[str, _SubSession] = {}
+        # unacked q_pop tokens held by this client (requeued on conn loss)
+        self._unacked: Set[str] = set()
         # pushes that arrive before the requesting coroutine registers its
         # queue (read_loop may outrun watch_prefix/subscribe resumption)
         self._early_pushes: Dict[str, List[Any]] = {}
@@ -747,22 +832,32 @@ class HubClient:
         # buffering them forever
         self._closed_push_ids: set = set()
         self._reader_task: Optional[asyncio.Task] = None
+        self._reconnect_task: Optional[asyncio.Task] = None
         self._keepalive_tasks: Dict[int, asyncio.Task] = {}
         self._write_lock = asyncio.Lock()
+        self._connected = asyncio.Event()
+        self._connected_at = 0.0
         self._closed = False
 
     async def connect(self) -> "HubClient":
         host, port = self.address.rsplit(":", 1)
         self._reader, self._writer = await asyncio.open_connection(host, int(port))
         self._reader_task = asyncio.create_task(self._read_loop())
+        self._connected.set()
+        self._connected_at = time.monotonic()
         return self
 
     async def close(self) -> None:
         self._closed = True
+        # Wake requests parked on the reconnect: they re-check _closed and
+        # fail fast instead of sleeping out the grace budget.
+        self._connected.set()
         for t in self._keepalive_tasks.values():
             t.cancel()
         if self._reader_task:
             self._reader_task.cancel()
+        if self._reconnect_task:
+            self._reconnect_task.cancel()
         if self._writer:
             self._writer.close()
         for q in self._watch_queues.values():
@@ -797,28 +892,175 @@ class HubClient:
                     fut = self._pending.pop(msg.get("rid"), None)
                     if fut and not fut.done():
                         fut.set_result(msg)
-        except (asyncio.CancelledError, ConnectionResetError):
+        except asyncio.CancelledError:
+            pass
+        except (ConnectionResetError, OSError, json.JSONDecodeError):
             pass
         finally:
+            self._connected.clear()
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError("hub connection lost"))
             self._pending.clear()
+            if not self._closed:
+                self._on_connection_lost()
+
+    def _on_connection_lost(self) -> None:
+        """Connection died under us: account requeues, error live watches,
+        and (when enabled) start the backoff reconnect loop."""
+        from ..resilience import metrics
+
+        if self._unacked:
+            # The server requeues a disconnected session's unacked items
+            # (and a restarted hub restores in-flight items from its
+            # snapshot) — from this client's view they are requeued work.
+            metrics.hub_requeued_items_total += len(self._unacked)
+            self._unacked.clear()
+        # Live watches are broken by contract (missed deltas): surface
+        # HubSessionLost to their consumers, who re-arm + resync.
+        for wid, q in list(self._watch_queues.items()):
+            self._closed_push_ids.add(wid)
+            q.put_nowait(_WATCH_LOST)
+        self._watch_queues.clear()
+        # Drop the dead server-side sub ids from push routing; the sessions
+        # themselves survive and are re-bound after reconnect.
+        for sid in list(self._sub_queues):
+            self._sub_queues.pop(sid, None)
+        if self.reconnect:
+            # A connection that died young means the hub is accepting and
+            # immediately dropping (mid-restart, outage fault): start the
+            # backoff ladder higher so the retry loop doesn't spin.
+            uptime = time.monotonic() - self._connected_at
+            initial = (
+                self.RECONNECT_BACKOFF_INITIAL
+                if uptime >= 1.0
+                else min(0.5, self.reconnect_max_s)
+            )
+            self._reconnect_task = asyncio.get_running_loop().create_task(
+                self._reconnect_loop(initial)
+            )
+
+    async def _reconnect_loop(self, backoff: float) -> None:
+        from ..resilience import metrics
+
+        try:
+            while not self._closed:
+                # Full jitter BEFORE each dial: a fleet of clients orphaned
+                # by one hub crash must not re-dial in lockstep.
+                await asyncio.sleep(random.uniform(0.0, backoff))
+                if self._closed:
+                    return
+                try:
+                    host, port = self.address.rsplit(":", 1)
+                    self._reader, self._writer = await asyncio.open_connection(
+                        host, int(port)
+                    )
+                    break
+                except OSError:
+                    backoff = min(max(backoff, 0.05) * 2, self.reconnect_max_s)
+            if self._closed:
+                if self._writer is not None:
+                    self._writer.close()
+                return
+            self._reader_task = asyncio.create_task(self._read_loop())
+            self._connected.set()
+            self._connected_at = time.monotonic()
+            metrics.hub_reconnects_total += 1
+            logger.info("hub connection to %s re-established", self.address)
+            # Re-arm subscriptions onto their existing local queues: the
+            # pub/sub plane is lossy by contract, so consumers keep their
+            # iterators and never observe the gap.
+            for old_sid, sess in list(self._sub_sessions.items()):
+                self._sub_sessions.pop(old_sid, None)
+                try:
+                    resp = await self._request("subscribe", pattern=sess.pattern)
+                except (ConnectionError, RuntimeError):
+                    # Hub flapped again mid-resume: the fresh read_loop's
+                    # death restarts this whole loop; re-register the
+                    # session so the next pass retries it.
+                    self._sub_sessions[old_sid] = sess
+                    continue
+                new_sid = resp["id"]
+                sess.sid = new_sid
+                for item in self._early_pushes.pop(new_sid, []):
+                    sess.queue.put_nowait(item)
+                self._sub_queues[new_sid] = sess.queue
+                self._sub_sessions[new_sid] = sess
+                metrics.hub_sessions_resumed_total += 1
+        except asyncio.CancelledError:
+            raise
+
+    # Ops safe to replay across a reconnect: a lost response cannot make a
+    # replay observable (KV puts/gets/deletes are last-write-wins; a
+    # half-registered watch/sub dies with its connection; an orphaned
+    # lease_grant expires unkept; publish dupes are within the lossy-plane
+    # contract).  Queue verbs are EXCLUDED — q_push would duplicate work
+    # items beyond the at-least-once redelivery contract, and pop/ack
+    # tokens are connection-scoped.
+    _IDEMPOTENT_OPS = frozenset({
+        "kv_put", "kv_get", "kv_get_prefix", "kv_delete", "lease_keepalive",
+        "lease_grant", "lease_revoke", "q_len", "ping", "watch",
+        "watch_cancel", "subscribe", "unsubscribe", "publish",
+    })
 
     async def _request(self, op: str, **kw) -> Dict[str, Any]:
-        if self._writer is None:
-            raise ConnectionError("not connected")
-        rid = next(self._rids)
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending[rid] = fut
-        payload = {"rid": rid, "op": op, **kw}
-        async with self._write_lock:
-            self._writer.write(json.dumps(payload).encode() + b"\n")
-            await self._writer.drain()
-        msg = await fut
-        if not msg.get("ok") and op not in ("lease_keepalive", "q_ack", "q_nack"):
-            raise RuntimeError(msg.get("error", f"{op} failed"))
-        return msg
+        retryable = self.reconnect and op in self._IDEMPOTENT_OPS
+        deadline = time.monotonic() + self.request_grace_s
+        last_exc: Optional[BaseException] = None
+        first = True
+        while first or (retryable and time.monotonic() < deadline):
+            first = False
+            if self._closed:
+                raise ConnectionError("hub client closed")
+            if self._writer is None:
+                raise ConnectionError("not connected")
+            if not self._connected.is_set():
+                if not self.reconnect:
+                    # No reconnect loop will ever set the event again —
+                    # parking would just sleep out the grace for nothing.
+                    raise ConnectionError("hub connection lost")
+                # Hub down, reconnect in progress: park the caller so a hub
+                # restart pauses traffic instead of failing it.
+                budget = deadline - time.monotonic()
+                if budget <= 0:
+                    break
+                try:
+                    await asyncio.wait_for(self._connected.wait(), budget)
+                except asyncio.TimeoutError:
+                    break
+            rid = next(self._rids)
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._pending[rid] = fut
+            payload = {"rid": rid, "op": op, **kw}
+            try:
+                async with self._write_lock:
+                    self._writer.write(json.dumps(payload).encode() + b"\n")
+                    await self._writer.drain()
+                msg = await fut
+            except (ConnectionError, ConnectionResetError, BrokenPipeError,
+                    OSError) as e:
+                # Connection died under this request: idempotent ops keep
+                # replaying until the grace budget runs out (a flapping hub
+                # accepts and drops several times mid-restart); the rest
+                # surface immediately.
+                self._pending.pop(rid, None)
+                last_exc = e
+                if retryable:
+                    await asyncio.sleep(random.uniform(0.02, 0.1))
+                continue
+            if not msg.get("ok") and op not in (
+                "lease_keepalive", "q_ack", "q_nack"
+            ):
+                raise RuntimeError(msg.get("error", f"{op} failed"))
+            return msg
+        if isinstance(last_exc, ConnectionError):
+            raise last_exc
+        if last_exc is not None:
+            raise ConnectionError(f"hub request failed: {last_exc}") from last_exc
+        raise ConnectionError(
+            f"hub {self.address} unreachable "
+            f"(reconnect pending > {self.request_grace_s:g}s)"
+        )
 
     # KV
     async def kv_put(self, key, value, lease_id=None):
@@ -864,10 +1106,19 @@ class HubClient:
         try:
             while True:
                 await asyncio.sleep(max(ttl / 3.0, 0.05))
-                ok = (await self._request("lease_keepalive", lease=lease_id)).get("ok")
+                try:
+                    ok = (
+                        await self._request("lease_keepalive", lease=lease_id)
+                    ).get("ok")
+                except ConnectionError:
+                    # Hub down/reconnecting: keep trying — a SHORT outage
+                    # (connection blip, not a restart) leaves the lease
+                    # alive server-side, and abandoning it here would
+                    # deregister a perfectly healthy worker.
+                    continue
                 if not ok:
-                    return
-        except (asyncio.CancelledError, ConnectionError):
+                    return  # lease truly gone; the owner re-grants
+        except asyncio.CancelledError:
             pass
 
     async def lease_keepalive(self, lease_id: int) -> bool:
@@ -885,14 +1136,17 @@ class HubClient:
 
     async def subscribe(self, pattern) -> Subscription:
         resp = await self._request("subscribe", pattern=pattern)
-        sid = resp["id"]
-        q: asyncio.Queue = asyncio.Queue()
-        for item in self._early_pushes.pop(sid, []):
-            q.put_nowait(item)
-        self._sub_queues[sid] = q
+        sess = _SubSession(resp["id"], pattern, asyncio.Queue())
+        for item in self._early_pushes.pop(sess.sid, []):
+            sess.queue.put_nowait(item)
+        self._sub_queues[sess.sid] = sess.queue
+        self._sub_sessions[sess.sid] = sess
 
         async def cancel():
+            # The session's sid moves on reconnect: always read it live.
+            sid = sess.sid
             self._sub_queues.pop(sid, None)
+            self._sub_sessions.pop(sid, None)
             self._early_pushes.pop(sid, None)
             self._closed_push_ids.add(sid)
             if not self._closed:
@@ -900,9 +1154,9 @@ class HubClient:
                     await self._request("unsubscribe", id=sid)
                 except (ConnectionError, RuntimeError):
                     pass
-            q.put_nowait(None)
+            sess.queue.put_nowait(None)
 
-        return Subscription(q, cancel)
+        return Subscription(sess.queue, cancel)
 
     # queues
     async def q_push(self, queue, item) -> None:
@@ -910,12 +1164,15 @@ class HubClient:
 
     async def q_pop(self, queue) -> Tuple[Any, str]:
         resp = await self._request("q_pop", queue=queue)
+        self._unacked.add(resp["token"])
         return resp["item"], resp["token"]
 
     async def q_ack(self, token) -> bool:
+        self._unacked.discard(token)
         return (await self._request("q_ack", token=token)).get("ok", False)
 
     async def q_nack(self, token) -> bool:
+        self._unacked.discard(token)
         return (await self._request("q_nack", token=token)).get("ok", False)
 
     async def q_len(self, queue) -> int:

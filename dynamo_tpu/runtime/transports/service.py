@@ -56,6 +56,12 @@ class RemoteEngineError(RuntimeError):
         self.kind = kind
 
 
+# Built-in liveness/readiness path every ServiceServer answers without
+# registration (runtime/health.py probes it over the SAME transport real
+# requests ride; no extra port or protocol).
+HEALTH_ENDPOINT = "__health__"
+
+
 class ServiceServer:
     """Hosts AsyncEngines at string paths over TCP (multiplexed streams)."""
 
@@ -65,6 +71,13 @@ class ServiceServer:
         self._endpoints: Dict[str, AsyncEngine] = {}
         self._server: Optional[asyncio.base_events.Server] = None
         self._conn_tasks: set = set()
+        self._conn_writers: set = set()
+        self.crashed = False
+        # Optional harness hook fired by the ``worker_crash`` fault point:
+        # the owning process finishes the death (revoke lease, close
+        # runtime) the way a real SIGKILL would.
+        self.on_crash = None
+        self._crash_task: Optional[asyncio.Task] = None
 
     def register(self, path: str, engine: AsyncEngine) -> None:
         self._endpoints[path] = engine
@@ -82,7 +95,40 @@ class ServiceServer:
             self.port = self._server.sockets[0].getsockname()[1]
         return self
 
+    def crash(self) -> None:
+        """Simulate sudden worker death (the ``worker_crash`` fault point):
+        stop accepting, hard-abort every live connection (clients see a
+        reset, exactly like a SIGKILL'd process), and fire ``on_crash`` so
+        the owner can finish the job (lease revoke etc.)."""
+        if self.crashed:
+            return
+        self.crashed = True
+        if self._server is not None:
+            self._server.close()
+        for writer in list(self._conn_writers):
+            try:
+                writer.transport.abort()
+            except Exception:  # noqa: BLE001 — already-dead transport
+                pass
+        if self.on_crash is not None:
+            res = self.on_crash()
+            if asyncio.iscoroutine(res):
+                self._crash_task = asyncio.get_running_loop().create_task(res)
+
     async def close(self) -> None:
+        if (
+            self._crash_task is not None
+            and self._crash_task is not asyncio.current_task()
+        ):
+            # (An on_crash hook that itself closes the runtime reaches here
+            # FROM the crash task — awaiting yourself deadlocks.)
+            try:
+                await self._crash_task
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — harness hook best-effort
+                logger.exception("on_crash hook failed")
+            self._crash_task = None
         if self._server is not None:
             self._server.close()
             # Long-lived multiplexed connections never end on their own —
@@ -96,6 +142,7 @@ class ServiceServer:
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         conn_task = asyncio.current_task()
         self._conn_tasks.add(conn_task)
+        self._conn_writers.add(writer)
         wlock = asyncio.Lock()
         headers: Dict[int, Dict[str, Any]] = {}  # sid → REQ_HEADER awaiting data
         streams: Dict[int, Tuple[AsyncEngineContext, asyncio.Task]] = {}
@@ -121,6 +168,9 @@ class ServiceServer:
             streams[sid] = (ctx, asyncio.current_task())
             try:
                 if faults.enabled:
+                    if faults.should("worker_crash", self.address):
+                        self.crash()  # aborts this transport too
+                        return
                     delay = faults.delay_for("delay", endpoint_name)
                     if delay > 0:
                         await asyncio.sleep(delay)
@@ -132,6 +182,18 @@ class ServiceServer:
                             sid,
                         )
                         return
+                if endpoint_name == HEALTH_ENDPOINT:
+                    # Liveness+readiness without registration: answering at
+                    # all proves the transport; the endpoint count is the
+                    # readiness signal (runtime/health.probe_address).
+                    await send(FrameType.RESP_PROLOGUE, {"ok": True}, sid)
+                    await send(
+                        FrameType.RESP_ITEM,
+                        {"ok": True, "endpoints": len(self._endpoints)},
+                        sid,
+                    )
+                    await send(FrameType.RESP_COMPLETE, None, sid)
+                    return
                 engine = self._endpoints.get(endpoint_name)
                 if engine is None:
                     await send(
@@ -166,6 +228,14 @@ class ServiceServer:
                 await send(FrameType.RESP_PROLOGUE, {"ok": True}, sid)
                 try:
                     async for item in stream:
+                        if faults.enabled:
+                            # Straggler simulation: stretch THIS worker's
+                            # inter-token latency (watchdog outlier bait).
+                            stall = faults.delay_for(
+                                "slow_stream", self.address
+                            )
+                            if stall > 0:
+                                await asyncio.sleep(stall)
                         await send(FrameType.RESP_ITEM, item, sid)
                         if faults.enabled and faults.should(
                             "drop_mid_stream", endpoint_name
@@ -224,6 +294,7 @@ class ServiceServer:
             for task in list(stream_tasks):
                 task.cancel()
             writer.close()
+            self._conn_writers.discard(writer)
             self._conn_tasks.discard(conn_task)
 
 

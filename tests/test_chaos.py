@@ -1,0 +1,635 @@
+"""Chaos-ladder + control-plane survival tests (ISSUE 7).
+
+Covers the three tentpole surfaces and their satellites:
+
+- hub session resume: HubClient reconnect with sub re-arm, HubSessionLost
+  surfaced to watchers, idempotent-op parking through an outage, unacked
+  queue-item requeue across a REAL hub kill/restart, and worker
+  re-registration via the lease monitor;
+- health watchdog: probe-failure and straggler quarantine, drain ordering,
+  eject-after-grace, recovery reinstatement, planner pool-view exclusion;
+- new fault kinds (worker_crash / hub_outage / slow_stream / kv_pressure)
+  arming + env parsing;
+- satellites: migrate-in refusal while draining (the stop_decode
+  de-advertise race), grammar hash-first wire protocol with miss fallback;
+- the heavy acceptance tests (real engines; marked ``slow``, run by the
+  ci.sh chaos step): hub kill/restart + worker crash mid-stream with the
+  seeded resume token-identical to the control, and chaos-ladder rung
+  determinism (same seed ⇒ same deterministic goodput report core).
+"""
+
+import asyncio
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from dynamo_tpu.runtime import (
+    Client,
+    DistributedRuntime,
+    HealthConfig,
+    HealthWatchdog,
+    HubClient,
+    HubServer,
+    HubSessionLost,
+    WorkerLatencyTracker,
+    faults,
+    health_metrics,
+)
+from dynamo_tpu.runtime.engine import Context, collect
+from dynamo_tpu.runtime.health import QUARANTINE_PREFIX, worker_latency
+from dynamo_tpu.runtime.resilience import metrics as res_metrics
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    worker_latency.reset()
+    yield
+    faults.reset()
+    worker_latency.reset()
+
+
+# --------------------------------------------------------------------------
+# Hub session resume
+# --------------------------------------------------------------------------
+
+
+async def test_hub_restart_session_resume(tmp_path):
+    """Kill + restart the hub under a live client: durable KV survives,
+    subscriptions re-arm transparently, watchers surface HubSessionLost,
+    and the reconnect/resume counters tick."""
+    snap = str(tmp_path / "hub.json")
+    server = await HubServer(persist_path=snap, persist_interval_s=0.1).start()
+    port = server.port
+    client = await HubClient(server.address, request_grace_s=8.0).connect()
+    before_rc = res_metrics.hub_reconnects_total
+    before_sr = res_metrics.hub_sessions_resumed_total
+    try:
+        sub = await client.subscribe("news.*")
+        watcher = await client.watch_prefix("cfg/")
+        await client.kv_put("cfg/a", 41)  # durable (no lease)
+        ev = await asyncio.wait_for(watcher.__anext__(), 2.0)
+        assert (ev.key, ev.value) == ("cfg/a", 41)
+        server._persist_now()
+
+        await server.close()
+        # Ops issued while the hub is DOWN park until it returns.
+        parked = asyncio.ensure_future(client.kv_put("cfg/b", 42))
+        await asyncio.sleep(0.3)
+        assert not parked.done()
+        server = await HubServer(
+            port=port, persist_path=snap, persist_interval_s=0.1
+        ).start()
+        await asyncio.wait_for(parked, 8.0)
+
+        # Watcher contract: missed deltas are unknowable → HubSessionLost.
+        with pytest.raises(HubSessionLost):
+            await asyncio.wait_for(watcher.__anext__(), 8.0)
+        # Durable KV state survived the restart.
+        assert await client.kv_get("cfg/a") == 41
+        assert await client.kv_get("cfg/b") == 42
+        # The subscription re-armed onto the SAME iterator: publishes from a
+        # fresh client land without the consumer doing anything.
+        other = await HubClient(server.address).connect()
+        for _ in range(40):  # re-arm races the publish; retry briefly
+            await other.publish("news.x", {"n": 7})
+            try:
+                subject, payload = await asyncio.wait_for(
+                    sub.__anext__(), 0.25
+                )
+                break
+            except asyncio.TimeoutError:
+                continue
+        else:
+            pytest.fail("re-armed subscription never received a publish")
+        assert subject == "news.x" and payload == {"n": 7}
+        await other.close()
+        assert res_metrics.hub_reconnects_total > before_rc
+        assert res_metrics.hub_sessions_resumed_total > before_sr
+    finally:
+        await client.close()
+        await server.close()
+
+
+async def test_hub_restart_requeues_unacked_items(tmp_path):
+    """At-least-once across restart: an item popped but never acked is
+    restored from the snapshot's in-flight set and redelivered."""
+    snap = str(tmp_path / "hub.json")
+    server = await HubServer(persist_path=snap).start()
+    port = server.port
+    client = await HubClient(server.address, request_grace_s=8.0).connect()
+    before = res_metrics.hub_requeued_items_total
+    try:
+        lid_before = await client.lease_grant(5.0)
+        await client.q_push("work", {"job": 1})
+        item, token = await client.q_pop("work")
+        assert item == {"job": 1}
+        server._persist_now()  # snapshot WITH the un-acked in-flight item
+        await server.close()
+        server = await HubServer(port=port, persist_path=snap).start()
+        item2, token2 = await asyncio.wait_for(client.q_pop("work"), 8.0)
+        assert item2 == {"job": 1}  # redelivered
+        assert await client.q_ack(token2)
+        assert res_metrics.hub_requeued_items_total > before
+        # The restarted hub must never re-issue lease ids stale keepalives
+        # still reference (persisted lease-id floor).
+        lid_after = await client.lease_grant(5.0)
+        assert lid_after > lid_before
+    finally:
+        await client.close()
+        await server.close()
+
+
+async def test_worker_reregisters_after_hub_restart(tmp_path):
+    """The full rejoin story: hub dies and restarts with NO lease state;
+    the worker's lease monitor re-grants and re-puts its registrations
+    within the backoff budget, and a routed client sees it again."""
+    snap = str(tmp_path / "hub.json")
+    server = await HubServer(persist_path=snap, persist_interval_s=0.1).start()
+    port = server.port
+    rt = await DistributedRuntime.connect(server.address, lease_ttl=0.6)
+    crt = await DistributedRuntime.connect(server.address, lease_ttl=0.6)
+    try:
+        async def echo(request: Context):
+            yield {"ok": True}
+
+        ep = rt.namespace("rejoin").component("w").endpoint("gen")
+        await ep.serve_endpoint(echo)
+        client = await Client(crt.hub, ep.instance_prefix).start()
+        await client.wait_for_instances(5)
+
+        await server.close()
+        await asyncio.sleep(0.3)
+        server = await HubServer(
+            port=port, persist_path=snap, persist_interval_s=0.1
+        ).start()
+        # Lease state died with the hub; the monitor must re-register.
+        deadline = time.monotonic() + 10.0
+        registered = {}
+        while time.monotonic() < deadline:
+            registered = await server.state.kv_get_prefix(ep.instance_prefix)
+            if registered:
+                break
+            await asyncio.sleep(0.1)
+        assert registered, "worker never re-registered after hub restart"
+        # The client's watch re-armed + resynced: requests still route.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not client.instance_ids:
+            await asyncio.sleep(0.1)
+        items = await collect(await client.generate(Context({})))
+        assert items == [{"ok": True}]
+        await client.close()
+    finally:
+        await rt.close()
+        await crt.close()
+        await server.close()
+
+
+# --------------------------------------------------------------------------
+# Health watchdog
+# --------------------------------------------------------------------------
+
+
+def _instance(ns, wid, address):
+    return (
+        f"instances/{ns}/c/gen/{wid}",
+        {"address": address, "path": f"{ns}.c.gen", "worker_id": wid,
+         "metadata": {"role": "decode"}},
+    )
+
+
+async def test_watchdog_probe_failure_quarantine_drain_eject():
+    """Probe failures → quarantine (marker + drain) → eject after grace;
+    the healthy peer is untouched; re-registration reinstates."""
+    from dynamo_tpu.runtime import InprocHub
+
+    hub = await InprocHub().start()
+    clock = SimpleNamespace(t=100.0)
+    drained = []
+
+    async def prober(address, timeout_s):
+        return address != "dead:1"
+
+    async def drainer(info):
+        drained.append(info["worker_id"])
+        return 2
+
+    for wid, addr in ((1, "dead:1"), (2, "ok:2")):
+        key, info = _instance("h", wid, addr)
+        await hub.kv_put(key, info)
+    dog = HealthWatchdog(
+        hub, "instances/h/", prober=prober, drainer=drainer,
+        latency_source=lambda: {},
+        config=HealthConfig(quarantine_after=2, eject_grace_s=5.0),
+        clock=lambda: clock.t,
+    )
+    try:
+        await dog.tick()
+        assert dog.workers[1].fail_streak == 1
+        assert dog.workers[1].state == "healthy"
+        await dog.tick()  # second consecutive failure → quarantine + drain
+        assert dog.workers[1].state == "quarantined"
+        assert drained == [1]
+        marker = await hub.kv_get(f"{QUARANTINE_PREFIX}1")
+        assert marker and marker["state"] == "quarantined"
+        assert dog.workers[2].state == "healthy"
+        clock.t += 6.0  # grace expired, still failing probes
+        await dog.tick()
+        assert dog.workers[1].state == "ejected"
+        assert await hub.kv_get("instances/h/c/gen/1") is None  # deregistered
+        assert (await hub.kv_get(f"{QUARANTINE_PREFIX}1"))["state"] == "ejected"
+        assert await hub.kv_get("instances/h/c/gen/2") is not None
+        # Ejected records survive discovery absence: a LATE re-registration
+        # (many ticks later) must still clear the durable marker.
+        await dog.tick()
+        await dog.tick()
+        assert dog.workers[1].state == "ejected"
+        # Operator brings the worker back: re-registration wipes the slate.
+        key, info = _instance("h", 1, "ok:1")
+        await hub.kv_put(key, info)
+
+        async def prober_ok(address, timeout_s):
+            return True
+
+        dog._prober = prober_ok
+        await dog.tick()
+        assert dog.workers[1].state == "healthy"
+        assert await hub.kv_get(f"{QUARANTINE_PREFIX}1") is None
+    finally:
+        await dog.stop()
+        await hub.close()
+
+
+async def test_watchdog_straggler_quarantine_and_recovery():
+    """A sustained ITL outlier quarantines; clearing the outlier before the
+    grace window reinstates instead of ejecting."""
+    from dynamo_tpu.runtime import InprocHub
+
+    hub = await InprocHub().start()
+    lat = {
+        1: {"address": "a:1", "itl_p50_ms": 900.0, "ttft_p50_ms": 50.0, "n": 10},
+        2: {"address": "a:2", "itl_p50_ms": 20.0, "ttft_p50_ms": 45.0, "n": 10},
+        3: {"address": "a:3", "itl_p50_ms": 22.0, "ttft_p50_ms": 48.0, "n": 10},
+    }
+    for wid in (1, 2, 3):
+        key, info = _instance("s", wid, f"a:{wid}")
+        await hub.kv_put(key, info)
+
+    async def prober(address, timeout_s):
+        return True
+
+    async def drainer(info):
+        return 0
+
+    dog = HealthWatchdog(
+        hub, "instances/s/", prober=prober, drainer=drainer,
+        latency_source=lambda: lat,
+        config=HealthConfig(
+            straggler_factor=3.0, straggler_min_ms=50.0,
+            straggler_min_samples=5, straggler_streak=2,
+            eject_grace_s=30.0,
+        ),
+    )
+    before = health_metrics.stragglers_detected_total
+    try:
+        await dog.tick()
+        assert dog.workers[1].straggler_streak == 1
+        await dog.tick()
+        assert dog.workers[1].state == "quarantined"
+        assert dog.workers[1].reason == "latency_outlier"
+        assert health_metrics.stragglers_detected_total > before
+        lat[1]["itl_p50_ms"] = 25.0  # straggler recovered (e.g. GC pause over)
+        await dog.tick()  # outlier clears → streak resets
+        await dog.tick()  # quarantined + recovered → reinstate
+        assert dog.workers[1].state == "healthy"
+        assert await hub.kv_get(f"{QUARANTINE_PREFIX}1") is None
+    finally:
+        await dog.stop()
+        await hub.close()
+
+
+def test_worker_latency_tracker_snapshot():
+    clock = SimpleNamespace(t=0.0)
+    tracker = WorkerLatencyTracker(window=4, stale_after_s=10.0,
+                                   clock=lambda: clock.t)
+    for ms in (10.0, 20.0, 30.0):
+        tracker.record_itl(7, "a:7", ms)
+    tracker.record_ttft(7, "a:7", 100.0)
+    snap = tracker.snapshot()
+    assert snap[7]["itl_p50_ms"] == 20.0
+    assert snap[7]["ttft_p50_ms"] == 100.0
+    assert snap[7]["n"] == 4
+    clock.t = 11.0  # stale: pruned from the snapshot
+    assert tracker.snapshot() == {}
+
+
+async def test_collector_pool_view_excludes_quarantined():
+    """Planner integration: a quarantine marker removes the worker from
+    the SignalCollector's pool stats (and deletion restores it)."""
+    from dynamo_tpu.planner.signals import SignalCollector
+
+    rt = await DistributedRuntime.detached()
+    try:
+        for wid in (11, 12):
+            key, info = _instance("p", wid, f"a:{wid}")
+            await rt.hub.kv_put(key, info)
+        component = rt.namespace("p").component("c")
+        collector = await SignalCollector(component).start()
+        snap = await collector.snapshot()
+        assert set(snap.pool("decode").workers) == {11, 12}
+        await rt.hub.kv_put(f"{QUARANTINE_PREFIX}11", {"state": "quarantined"})
+        await asyncio.sleep(0.05)  # watch delivery
+        snap = await collector.snapshot()
+        assert set(snap.pool("decode").workers) == {12}
+        await rt.hub.kv_delete(f"{QUARANTINE_PREFIX}11")
+        await asyncio.sleep(0.05)
+        snap = await collector.snapshot()
+        assert set(snap.pool("decode").workers) == {11, 12}
+        await collector.stop()
+    finally:
+        await rt.close()
+
+
+async def test_health_probe_over_service_plane():
+    """Every ServiceServer answers __health__ without registration;
+    readiness requires at least one real endpoint."""
+    from dynamo_tpu.runtime import ServiceServer
+    from dynamo_tpu.runtime.health import probe_address
+
+    server = await ServiceServer().start()
+    try:
+        # Alive but empty = not ready.
+        assert not await probe_address(server.address, 1.0)
+        server.register("x", SimpleNamespace())
+        assert await probe_address(server.address, 1.0)
+    finally:
+        await server.close()
+    assert not await probe_address(server.address, 0.5)  # dead = dead
+
+
+# --------------------------------------------------------------------------
+# Fault kinds
+# --------------------------------------------------------------------------
+
+
+def test_faultinject_new_points_env_and_level():
+    faults.load_env("slow_stream:127.0.0.1:9001@0.25,kv_pressure@0.6,"
+                    "worker_crash:*#1")
+    assert faults.level_for("slow_stream", "127.0.0.1:9001") == 0.25
+    assert faults.level_for("slow_stream", "other") == 0.0
+    assert faults.level_for("kv_pressure") == 0.6
+    assert faults.should("worker_crash", "anything")
+    assert not faults.should("worker_crash", "anything")  # count=1 expired
+    # level_for is non-consuming: the holding fault survives reads.
+    for _ in range(5):
+        assert faults.level_for("kv_pressure") == 0.6
+
+
+async def test_worker_crash_fault_kills_server_and_fires_hook():
+    from dynamo_tpu.runtime import RemoteEngine, ServiceServer
+    from dynamo_tpu.runtime.engine import engine_from_generator
+
+    async def echo(request: Context):
+        yield {"ok": True}
+
+    server = await ServiceServer().start()
+    fired = asyncio.Event()
+
+    async def on_crash():
+        fired.set()
+
+    server.on_crash = on_crash
+    server.register("gen", engine_from_generator(echo))
+    try:
+        engine = RemoteEngine(server.address, "gen")
+        assert (await collect(await engine.generate(Context({}))))[0]["ok"]
+        faults.arm("worker_crash", match=server.address, count=1)
+        with pytest.raises(Exception):
+            await collect(await engine.generate(Context({})))
+        await asyncio.wait_for(fired.wait(), 2.0)
+        assert server.crashed
+        # Stops accepting: a fresh dial is refused like a dead process.
+        with pytest.raises(OSError):
+            await asyncio.wait_for(
+                asyncio.open_connection(*server.address.rsplit(":", 1)), 1.0
+            )
+    finally:
+        faults.reset()
+        await server.close()
+
+
+# --------------------------------------------------------------------------
+# Satellites
+# --------------------------------------------------------------------------
+
+
+async def test_migrate_in_refused_while_draining():
+    """The stop_decode race fix: capability is re-checked at ACCEPT time,
+    so a peer with a stale hub snapshot cannot migrate into a drainer."""
+    from dynamo_tpu.llm.migration import MigratableWorker
+
+    mig = MigratableWorker(engine=None)
+    assert mig.accepting
+    mig.stop_accepting()
+    resp = await mig._migrate_in(
+        {"kind": "blocks", "token_ids": [1, 2], "payload": {}}
+    )
+    assert resp["ok"] is False
+    assert "draining" in resp["error"]
+
+
+async def test_grammar_hash_first_wire():
+    """Hash-only stubs resolve from the engine LRU; a miss raises the
+    typed error and the preprocessor re-sends the full table exactly once."""
+    from collections import OrderedDict
+
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.llm.metrics import tenancy_metrics
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.protocols import PreprocessedRequest
+    from dynamo_tpu.llm.tenancy.grammar import (
+        GrammarCacheMissError,
+        TokenMaskAutomaton,
+    )
+    from dynamo_tpu.runtime.engine import AsyncEngineContext
+
+    automaton = TokenMaskAutomaton(start=0, edges=[{5: 1}, {}], accepting=[1])
+    stub = automaton.wire_stub()
+    assert stub == {"hash": automaton.hash, "stub": True}
+
+    # Engine half (the real method, on a minimal self): miss → typed error;
+    # full table → cached; stub → zero-byte hit.
+    fake_engine = SimpleNamespace(
+        _grammar_lru=OrderedDict(),
+        model_config=SimpleNamespace(vocab_size=64, eos_token_ids=(0,)),
+    )
+    with pytest.raises(GrammarCacheMissError):
+        TpuEngine._grammar_automaton(fake_engine, dict(stub))
+    got = TpuEngine._grammar_automaton(fake_engine, automaton.to_dict())
+    assert got.hash == automaton.hash
+    hits = tenancy_metrics.grammar_hash_hits_total
+    again = TpuEngine._grammar_automaton(fake_engine, dict(stub))
+    assert again is got
+    assert tenancy_metrics.grammar_hash_hits_total == hits + 1
+
+    # Preprocessor half: stub first, full table only after the miss; the
+    # adaptive policy then ships a full-table burst (seeding the routing
+    # rotation) before retrying stubs — without it, a 2-worker round-robin
+    # fleet alternates stub-miss/full-resend onto the same pair of workers
+    # forever and never records a hit.
+    seen = []
+
+    class FakeNext:
+        def __init__(self):
+            self.has_table = False
+
+        async def generate(self, request):
+            g = request.data.get("grammar")
+            seen.append(g)
+            if g.get("stub"):
+                if not self.has_table:
+                    raise GrammarCacheMissError(g["hash"])
+            else:
+                self.has_table = True
+
+            async def gen():
+                yield {"ok": True}
+
+            from dynamo_tpu.runtime.engine import ResponseStream
+
+            return ResponseStream(gen(), request.ctx)
+
+    pre = PreprocessedRequest(token_ids=[1], grammar=automaton.to_dict())
+    pp = OpenAIPreprocessor(tokenizer=None)
+    fake = FakeNext()
+    resends = tenancy_metrics.grammar_full_resends_total
+    for i in range(5):
+        stream = await pp._dispatch(fake, AsyncEngineContext(f"r{i}"), pre)
+        assert [i async for i in stream] == [{"ok": True}]
+    wire = ["stub" if g.get("stub") else "full" for g in seen]
+    # miss → resend, a 2-dispatch full burst, then stubs win end to end
+    assert wire == ["stub", "full", "full", "full", "stub", "stub"]
+    assert tenancy_metrics.grammar_full_resends_total == resends + 1
+
+
+# --------------------------------------------------------------------------
+# Heavy acceptance tests (real engines; ci.sh chaos step)
+# --------------------------------------------------------------------------
+
+
+async def _build_engines(n: int):
+    """Fresh prewarmed tiny engines.  Built INSIDE each test: engine
+    internals (asyncio.Event on py3.10) bind to the running loop, and every
+    async test runs under its own asyncio.run."""
+    from benchmarks.goodput import ENGINE_CFG, prewarm_engine
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+
+    engines = [TpuEngine(EngineConfig(**ENGINE_CFG)) for _ in range(n)]
+    for e in engines:
+        await prewarm_engine(e)
+    return engines
+
+
+@pytest.mark.slow
+async def test_hub_kill_and_worker_crash_midstream_seeded_resume(tmp_path):
+    """The acceptance scenario: hub killed mid-stream AND the serving
+    worker crashes while the hub is still down.  The seeded stream resumes
+    on the survivor from the CACHED instance set, token-identical to the
+    control; the hub restarts from its snapshot and the fleet re-registers
+    within the backoff budget, visible in the resilience counters."""
+    from benchmarks.goodput import ChaosFleet, _request_dict
+
+    chaos_engines = await _build_engines(2)
+    req = _request_dict(3, isl=12, osl=10, seed=99)
+    # Control stream on a warm engine (seeded ⇒ engine-instance agnostic).
+    control = [
+        t
+        for item in await collect(
+            await chaos_engines[0].generate(Context(dict(req)))
+        )
+        for t in item.get("token_ids", ())
+    ]
+    assert len(control) == 10
+
+    fleet = await ChaosFleet(
+        chaos_engines, str(tmp_path / "hub.json"), watchdog=False
+    ).start()
+    before_rc = res_metrics.hub_reconnects_total
+    before_sr = res_metrics.stream_resumes_total
+    try:
+        stream = await fleet.client.generate(Context(dict(req)))
+        tokens = []
+        crashed = False
+        async for item in stream:
+            tokens.extend(item.get("token_ids", ()))
+            if not crashed and len(tokens) >= 3:
+                crashed = True
+                await fleet.kill_hub()  # hub dies first…
+                serving = next(
+                    w for w in fleet.workers
+                    if w.engine.live_request_ids()
+                )
+                server = await serving.runtime.service_server()
+                server.crash()  # …then the serving worker, hub still down
+        assert tokens == control, "resumed stream diverged from control"
+        assert res_metrics.stream_resumes_total > before_sr
+        await fleet.restart_hub()
+        # Survivor re-registers within the backoff budget.
+        deadline = time.monotonic() + 10.0
+        registered = {}
+        while time.monotonic() < deadline:
+            registered = await fleet.hub.state.kv_get_prefix(
+                fleet.instance_prefix
+            )
+            if registered:
+                break
+            await asyncio.sleep(0.1)
+        assert registered, "no worker re-registered after hub restart"
+        assert res_metrics.hub_reconnects_total > before_rc
+    finally:
+        await fleet.close()
+        for e in chaos_engines:
+            await e.close()
+
+
+@pytest.mark.slow
+async def test_ladder_rung_deterministic_and_schema(tmp_path):
+    """Same seed ⇒ same deterministic goodput-report core, and the report
+    carries the documented schema fields (docs/chaos.md)."""
+    from benchmarks.goodput import run_rung
+
+    chaos_engines = await _build_engines(2)
+    rung = {
+        "level": 1,
+        "name": "L1-worker-crash",
+        "events": [],  # determinism of the replay core itself
+    }
+    kw = dict(
+        seed=23, rate=2.0, duration=2.0, isl=10, osl=6,
+        slo_ttft_s=30.0, slo_itl_s=10.0, watchdog=False,
+    )
+    try:
+        r1 = await run_rung(
+            chaos_engines, rung, persist_path=str(tmp_path / "h1.json"), **kw
+        )
+        r2 = await run_rung(
+            chaos_engines, rung, persist_path=str(tmp_path / "h2.json"), **kw
+        )
+    finally:
+        for e in chaos_engines:
+            await e.close()
+    for key in (
+        "level", "name", "faults", "requests", "completed", "dropped",
+        "shed", "goodput", "completion_rate", "ttft_p50_ms", "ttft_p95_ms",
+        "itl_p95_ms", "resilience", "deterministic",
+    ):
+        assert key in r1, f"report missing {key}"
+    assert r1["dropped"] == 0
+    assert r1["requests"] > 0
+    # The deterministic core — per-request outcome, token count, and the
+    # hash of the exact token stream — is identical run to run.
+    assert r1["deterministic"] == r2["deterministic"]

@@ -18,6 +18,14 @@ echo "== tenancy suite (structured output + multi-LoRA correctness gates) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q -m tenancy \
   -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== chaos suite (hub session resume + watchdog + ladder determinism) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q -m chaos \
+  -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "== chaos ladder L0-L2 (seeded goodput smoke; 0 dropped streams bar) =="
+env JAX_PLATFORMS=cpu python benchmarks/goodput.py --levels 0,1,2 --seed 7 \
+  --duration 5 --rate 2.5 --check --json /tmp/_goodput_smoke.json
+
 echo "== tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -3,11 +3,17 @@ emit the failure matrix (fault point × observed behaviour × status code).
 
 Usage:
     JAX_PLATFORMS=cpu python tools/fault_matrix.py [--json OUT.json] [--md OUT.md]
+        [--engine]   # include the kv_pressure sweep (builds a real engine)
 
 Each row is produced by actually arming the fault (runtime/faultinject.py)
 against a live HubServer + ServiceServer worker set or an HttpService edge —
-the same machinery tests/test_resilience.py asserts on — so the table in
-docs/resilience.md is generated evidence, not prose.
+the same machinery tests/test_resilience.py asserts on — so the tables in
+docs/resilience.md and docs/chaos.md are generated evidence, not prose.
+
+The JSON artifact carries ``fault_kinds`` (every point swept) and is
+consumable by ``benchmarks/goodput.py --fault-matrix``: the chaos ladder
+cross-checks that each fault kind its rungs inject has a swept row, so a
+new fault point cannot silently enter the ladder unevidenced.
 """
 
 from __future__ import annotations
@@ -193,6 +199,144 @@ async def sweep_runtime() -> list:
     return rows
 
 
+async def sweep_chaos() -> list:
+    """Chaos-ladder fault kinds (ISSUE 7): worker_crash / slow_stream /
+    hub_outage against a fresh echo fleet, plus the hub restart path."""
+    import time as _time
+
+    from dynamo_tpu.runtime.health import probe_address, worker_latency
+    from dynamo_tpu.runtime.resilience import metrics as res
+
+    rows = []
+    hub = await HubServer().start()
+    workers = [await DistributedRuntime.connect(hub.address) for _ in range(3)]
+    crt = await DistributedRuntime.connect(hub.address)
+    try:
+        for w in workers:
+            await _serve_echo(w)
+        client = await _client(crt)
+        while len(client.instance_ids) < 3:
+            await asyncio.sleep(0.02)
+
+        # worker_crash → transport aborted + listener closed; traffic
+        # reroutes; the health probe sees the corpse.
+        target = await workers[0].service_server()
+        dead_addr = target.address
+        faults.arm("worker_crash", match=dead_addr, count=1)
+        ok = 0
+        for _ in range(12):
+            try:
+                items = await collect(await client.generate(Context({})))
+                ok += len(items) == 3
+            except RemoteEngineError:
+                pass  # the stream that triggered the crash dies mid-flight
+        alive = await probe_address(dead_addr, 0.5)
+        faults.reset()
+        rows.append({
+            "fault": "worker_crash",
+            "injected_at": "ServiceServer dispatch (aborts every connection, "
+                           "stops accepting)",
+            "observed": f"{ok}/12 requests completed around the corpse; "
+                        f"health probe now {'UNEXPECTEDLY alive' if alive else 'dead'}",
+            "status": "200 on survivors",
+        })
+
+        # slow_stream → straggler: items delayed, stream completes, and the
+        # client-side latency tracker flags the outlier ITL.
+        straggler = (await workers[1].service_server()).address
+        worker_latency.reset()
+        faults.arm("slow_stream", match=straggler, delay_s=0.08)
+        t0 = _time.perf_counter()
+        for _ in range(6):
+            await collect(await client.generate(Context({})))
+        elapsed = _time.perf_counter() - t0
+        lat = worker_latency.snapshot()
+        outlier = max(
+            (row.get("itl_p50_ms") or 0.0 for row in lat.values()),
+            default=0.0,
+        )
+        faults.reset()
+        rows.append({
+            "fault": "slow_stream",
+            "injected_at": "ServiceServer response loop (per-item stall)",
+            "observed": f"6/6 streams completed in {elapsed:.2f}s; worst "
+                        f"per-worker ITL p50 {outlier:.0f}ms (watchdog "
+                        "straggler-scan input)",
+            "status": "200 (degraded latency)",
+        })
+
+        # hub_outage (armed flavour) → connections dropped; reconnect with
+        # backoff; KV ops park then succeed once the outage clears.
+        before = res.hub_reconnects_total
+        faults.arm("hub_outage")
+        await asyncio.sleep(0.3)
+        put = asyncio.ensure_future(crt.hub.kv_put("sweep/outage", 1))
+        await asyncio.sleep(0.4)
+        faults.disarm("hub_outage")
+        try:
+            await asyncio.wait_for(put, 10.0)
+            survived = (await crt.hub.kv_get("sweep/outage")) == 1
+        except Exception:  # noqa: BLE001 — observation, not assertion
+            put.cancel()
+            survived = False
+        rows.append({
+            "fault": "hub_outage",
+            "injected_at": "HubServer connection plane (accept+drop while "
+                           "armed)",
+            "observed": ("kv_put parked through the outage and landed after; "
+                         if survived else "kv_put DID NOT survive; ")
+                        + f"{res.hub_reconnects_total - before} reconnect(s)",
+            "status": "paused, then 200",
+        })
+    finally:
+        faults.reset()
+        for rt in (*workers, crt):
+            await rt.close()
+        await hub.close()
+    return rows
+
+
+async def sweep_engine() -> list:
+    """kv_pressure against a real (tiny) engine: admission stalls while the
+    pool is squeezed and drains after.  Costs one XLA compile; opt-in."""
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+
+    rows = []
+    engine = TpuEngine(EngineConfig(
+        model="debug-tiny", block_size=4, num_blocks=32, max_batch=2,
+        max_model_len=128, prefill_chunk=32, dtype="float32",
+        decode_steps=2, pipeline_depth=2,
+    ))
+    try:
+        req = {
+            "token_ids": list(range(1, 17)),
+            "stop_conditions": {"max_tokens": 4, "ignore_eos": True},
+            "sampling_options": {"temperature": 0.0, "seed": 1},
+        }
+        await collect(await engine.generate(Context(dict(req))))  # warm
+        faults.arm("kv_pressure", delay_s=0.95)
+        task = asyncio.ensure_future(
+            collect(await engine.generate(Context(dict(req, token_ids=list(range(20, 44))))))
+        )
+        await asyncio.sleep(0.4)
+        stalled = not task.done()
+        faults.reset()
+        items = await asyncio.wait_for(task, 30.0)
+        rows.append({
+            "fault": "kv_pressure",
+            "injected_at": "scheduler admission (free-block view squeezed)",
+            "observed": ("admission stalled under pressure, "
+                         if stalled else "UNEXPECTED: admitted under pressure, ")
+                        + f"drained to {len(items)} items after release",
+            "status": "delayed TTFT, then 200",
+        })
+    finally:
+        faults.reset()
+        await engine.close()
+    return rows
+
+
 async def sweep_http() -> list:
     """HTTP-edge behaviours: admission shed + deadline + no instances."""
     from aiohttp import ClientSession
@@ -286,13 +430,21 @@ async def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--json", default=None, help="write JSON artifact here")
     ap.add_argument("--md", default=None, help="write markdown matrix here")
+    ap.add_argument("--engine", action="store_true",
+                    help="include the kv_pressure sweep (builds a real engine)")
     args = ap.parse_args()
 
-    rows = await sweep_runtime() + await sweep_http()
+    rows = await sweep_runtime() + await sweep_chaos() + await sweep_http()
+    if args.engine:
+        rows += await sweep_engine()
     md = to_markdown(rows)
     print(md)
     if args.json:
-        Path(args.json).write_text(json.dumps({"fault_matrix": rows}, indent=2))
+        Path(args.json).write_text(json.dumps({
+            "schema": "dynamo-tpu-fault-matrix-v2",
+            "fault_kinds": sorted({r["fault"].split(" ")[0] for r in rows}),
+            "fault_matrix": rows,
+        }, indent=2))
         print(f"wrote {args.json}")
     if args.md:
         Path(args.md).write_text(md)
