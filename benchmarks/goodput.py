@@ -21,6 +21,14 @@ L2    L1 + ``slow_stream`` straggler window + REAL hub kill/restart during
 L3    L2 + ``kv_pressure`` window (admission squeeze → queue growth)
 L4    L3 + ``watch_error``/``error_prologue``/``delay`` storm + a second
       worker crash — the everything-at-once rung
+L5    ``worker_crash`` + SUPERVISOR-DRIVEN RESPAWN mid-burst
+      (planner/supervisor.py): the crashed worker rejoins the fleet and
+      receives one migrated sequence as a rebalance — crashed workers no
+      longer stay down for the rung (ROADMAP L5 carry-over)
+L6    OVERLOAD: a ``tenant_flood`` fault drives a 3x noisy-neighbor burst
+      from one flooding tenant on top of the normal multi-tenant trace;
+      the scheduler's WFQ (engine/scheduler.py) must keep the non-flooding
+      tenants' goodput >= 0.9x their L0 (isolated) goodput
 ====  =======================================================================
 
 Determinism: the trace, every request's sampling seed, and the fault
@@ -28,18 +36,23 @@ schedule derive from ``--seed``.  Wall-clock latencies (and therefore the
 strict goodput number) carry scheduler noise, so the report separates a
 ``deterministic`` core — per-request outcome, token count, and the hash of
 the exact token stream — which is byte-stable across runs of the same seed
-and is what the regression test compares.  Because every request is
-seeded, completed token streams must ALSO be identical across rungs: L0 is
-the unmigrated/unfaulted control, and ``--check`` verifies byte-identity
-for every resumed/spliced stream on the higher rungs.
+and is what the regression test compares.  Every request is also stream-
+deterministic across rungs: most carry an explicit seed, and an UNSEEDED
+subset (every 5th request) relies on server-side seed resolution — the
+engine derives the seed from the FIXED request id, stamps it on the first
+stream item, and the routed client resumes with it after crashes
+(runtime/client.py _StreamGuard) — so ``--check`` verifies byte-identity
+against the L0 control for seeded and unseeded streams alike.
 
 Usage:
-    JAX_PLATFORMS=cpu python benchmarks/goodput.py --levels 0,1,2 --seed 7 \
-        [--json out.json] [--check] [--fault-matrix tools_fault_matrix.json]
+    JAX_PLATFORMS=cpu python benchmarks/goodput.py --levels 0,1,2,5,6 \
+        --seed 7 [--json out.json] [--check] [--fault-matrix fm.json]
 
 ``--check`` exits nonzero unless: every rung has 0 dropped streams, L2
-goodput >= 0.85 x L0 goodput, and all completed streams are token-identical
-to the L0 control.  tools/ci.sh runs exactly that as the standing L2 gate.
+goodput >= 0.85 x L0 goodput, all completed streams are token-identical to
+the L0 control, L5 respawned its crashed worker, and L6's non-flooding
+tenants each retain >= 0.9x their L0 goodput.  tools/ci.sh runs exactly
+that as the standing gate.
 """
 
 from __future__ import annotations
@@ -77,6 +90,19 @@ ENGINE_CFG = dict(
 
 NAMESPACE = "chaos"
 COMPONENT = "fleet"
+
+# Multi-tenant trace shape: normal requests round-robin over these fairness
+# tenants (engine/scheduler.py WfqQueue keys on them); the L6 noisy
+# neighbor floods as FLOOD_TENANT with request ids offset by FLOOD_BASE so
+# they never collide with (or get compared against) the control trace.
+TENANTS = ("t0", "t1", "t2")
+FLOOD_TENANT = "flood"
+FLOOD_BASE = 100_000
+# Every UNSEEDED_EVERY-th request omits its sampling seed: server-side
+# seed resolution (engine stamps the resolved seed, derived from the fixed
+# request id, on the first stream item) must keep these byte-identical
+# across rungs and resumable after crashes.
+UNSEEDED_EVERY = 5
 
 
 def _prompt_tokens(i: int, isl: int, vocab: int = 251) -> List[int]:
@@ -123,7 +149,7 @@ class FaultEvent:
 
 
 def ladder_rungs() -> List[Dict[str, Any]]:
-    """The canonical L0–L4 ladder (docs/chaos.md documents each rung)."""
+    """The canonical L0–L6 ladder (docs/chaos.md documents each rung)."""
     crash1 = FaultEvent("worker_crash", at=0.35, worker=1, count=1)
     slow = FaultEvent("slow_stream", at=0.15, until=0.55, worker=0, level=0.12)
     outage = FaultEvent("hub_outage", at=0.40, until=0.52)
@@ -134,6 +160,10 @@ def ladder_rungs() -> List[Dict[str, Any]]:
         FaultEvent("delay", at=0.60, until=0.75, level=0.2),
         FaultEvent("worker_crash", at=0.70, worker=2, count=1),
     ]
+    # L6: the noisy neighbor — a 3x flood from one tenant while the fault
+    # is armed (the trace driver reads the armed level as the rate
+    # multiplier; runtime/faultinject.py documents the kind).
+    flood = FaultEvent("tenant_flood", at=0.10, until=0.80, level=3.0)
     return [
         {"level": 0, "name": "L0-baseline", "events": []},
         {"level": 1, "name": "L1-worker-crash", "events": [crash1]},
@@ -143,6 +173,10 @@ def ladder_rungs() -> List[Dict[str, Any]]:
          "events": [slow, crash1, outage, pressure]},
         {"level": 4, "name": "L4-storm",
          "events": [slow, crash1, outage, pressure, *storm]},
+        {"level": 5, "name": "L5-crash+respawn+rebalance",
+         "events": [crash1], "supervise": True},
+        {"level": 6, "name": "L6-tenant-flood-overload",
+         "events": [flood]},
     ]
 
 
@@ -158,6 +192,13 @@ class _Worker:
     mig: Any
     address: str
     closed: bool = False
+    # Migration-target record for this worker (rebalance after respawn).
+    target: Dict[str, Any] = field(default_factory=dict)
+
+    def poll(self):
+        """Process-handle duck type for planner/supervisor.Supervisor's
+        liveness check: None = alive, anything else = exited."""
+        return 1 if self.closed else None
 
 
 class ChaosFleet:
@@ -178,6 +219,9 @@ class ChaosFleet:
         self.collector = None
         self.planner = None
         self.watchdog = None
+        self.supervisor = None
+        self.respawned = 0
+        self.rebalanced = 0
         self._pubs: List[Any] = []
 
     @property
@@ -245,7 +289,77 @@ class ChaosFleet:
                 await rt.close()
 
         server.on_crash = die
+        worker.target = {
+            "worker_id": rt.worker_id,
+            "address": server.address,
+            "import_path": in_ep.path,
+            "generate_path": gen_ep.path,
+        }
         return worker
+
+    # -- supervisor-driven respawn (L5 rung; ROADMAP carry-over) -----------
+
+    async def start_supervisor(self) -> None:
+        """Watch ``planner/targets/decode`` and respawn crashed workers
+        (planner/supervisor.py).  The ledger is seeded with the live fleet,
+        so only deaths trigger spawns; a respawn reuses the dead worker's
+        ENGINE (its process never died — only its runtime/lease) and then
+        receives one migrated sequence from the busiest survivor as the
+        post-rejoin rebalance."""
+        from dynamo_tpu.planner.actuate import TARGET_PREFIX
+        from dynamo_tpu.planner.supervisor import Supervisor
+
+        async def spawn(pool: str):
+            for idx, worker in enumerate(self.workers):
+                if worker.closed:
+                    fresh = await self._spawn_worker(worker.engine)
+                    self.workers[idx] = fresh
+                    self.respawned += 1
+                    logger.warning("[supervisor] respawned worker %d", idx)
+                    await self._rebalance_to(fresh)
+                    return fresh
+            return await self._spawn_worker(self.engines[0])
+
+        async def stop(pool: str, handle, drain: str):
+            if not handle.closed:
+                handle.closed = True
+                await handle.runtime.close()
+
+        await self.client_rt.hub.kv_put(
+            f"{TARGET_PREFIX}decode",
+            {"replicas": len(self.workers), "drain": "migrate"},
+        )
+        self.supervisor = Supervisor(
+            self.client_rt.hub, spawn, stop, pools=["decode"], resync_s=0.25
+        )
+        self.supervisor.handles["decode"] = list(self.workers)
+        await self.supervisor.start()
+
+    async def _rebalance_to(self, worker: _Worker) -> None:
+        """Migration rebalance after rejoin: move one live sequence from
+        the most loaded survivor onto the fresh worker."""
+        donors = [
+            w
+            for w in self.workers
+            if w is not worker and not w.closed and w.engine.live_request_ids()
+        ]
+        if not donors:
+            return
+        donor = max(donors, key=lambda w: len(w.engine.live_request_ids()))
+        rids = donor.engine.live_request_ids()
+        if not rids:
+            return
+        try:
+            if await donor.mig.migrate_out(rids[0], worker.target):
+                self.rebalanced += 1
+                logger.warning(
+                    "[supervisor] rebalanced %s onto respawned worker",
+                    rids[0],
+                )
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — rebalance is best-effort
+            logger.warning("post-respawn rebalance failed", exc_info=True)
 
     async def _start_client_plane(self) -> None:
         from dynamo_tpu.planner.policy import DecisionEngine
@@ -314,6 +428,9 @@ class ChaosFleet:
     # -- teardown ----------------------------------------------------------
 
     async def close(self) -> None:
+        if self.supervisor is not None:
+            await self.supervisor.stop()
+            self.supervisor = None
         for obj in (self.watchdog, self.planner, self.collector):
             if obj is not None:
                 await obj.stop()
@@ -355,8 +472,14 @@ class Outcome:
     tokens: int = 0
     token_hash: str = ""
     error: str = ""
+    tenant: str = ""
     ttft_ms: Optional[float] = None
     itl_ms: List[float] = field(default_factory=list)
+
+
+def _tenant_for(i: int) -> str:
+    """Deterministic tenant assignment (flood ids live past FLOOD_BASE)."""
+    return FLOOD_TENANT if i >= FLOOD_BASE else TENANTS[i % len(TENANTS)]
 
 
 def _request_dict(i: int, isl: int, osl: int, seed: int) -> Dict[str, Any]:
@@ -366,12 +489,18 @@ def _request_dict(i: int, isl: int, osl: int, seed: int) -> Dict[str, Any]:
         StopConditions,
     )
 
+    # Every UNSEEDED_EVERY-th normal request omits its seed: the engine
+    # resolves one from the FIXED request id (_one_request pins it), so the
+    # stream stays byte-deterministic across rungs AND crash-resumable via
+    # the resolved-seed stamp (runtime/client.py _StreamGuard).
+    unseeded = i < FLOOD_BASE and i % UNSEEDED_EVERY == 2
     return PreprocessedRequest(
         token_ids=_prompt_tokens(i, isl),
         stop_conditions=StopConditions(max_tokens=osl, ignore_eos=True),
         sampling_options=SamplingOptions(
-            temperature=0.8, seed=seed * 100003 + i
+            temperature=0.8, seed=None if unseeded else seed * 100003 + i
         ),
+        annotations={"tenant": _tenant_for(i)},
     ).to_dict()
 
 
@@ -390,12 +519,17 @@ async def prewarm_engine(engine, seed: int = 0) -> None:
 async def _one_request(client, i: int, isl: int, osl: int, seed: int) -> Outcome:
     from dynamo_tpu.runtime.engine import Context
 
-    out = Outcome(i=i)
+    out = Outcome(i=i, tenant=_tenant_for(i))
     tokens: List[int] = []
     t0 = time.monotonic()
     last = None
     try:
-        stream = await client.generate(Context(_request_dict(i, isl, osl, seed)))
+        # FIXED request id: unseeded requests derive their engine-resolved
+        # seed from it (crc32(id) ^ engine seed), so the same (ladder seed,
+        # i) replays byte-identically on any worker and across rungs.
+        stream = await client.generate(
+            Context.with_id(_request_dict(i, isl, osl, seed), f"g{seed}-{i}")
+        )
         async for item in stream:
             now = time.monotonic()
             got = item.get("token_ids") or ()
@@ -446,6 +580,56 @@ async def _drive_fault(fleet: ChaosFleet, ev: FaultEvent, duration: float) -> No
         faults.disarm(ev.kind, match if match != "*" else None)
 
 
+async def _drive_flood(
+    fleet: ChaosFleet,
+    ev: FaultEvent,
+    t_start: float,
+    *,
+    seed: int,
+    rate: float,
+    duration: float,
+    isl: int,
+    osl: int,
+) -> List[Outcome]:
+    """The ``tenant_flood`` fault's hook site: replay a seeded
+    noisy-neighbor trace at ``level``x the base rate under FLOOD_TENANT
+    across the fault's SCHEDULED window [at, until].  The window gate is
+    the schedule itself, not the live armed state — arming/disarming
+    happens via wall-clock sleeps in a separate task, and a boundary
+    arrival racing them would make the rung's deterministic core differ
+    run to run.  (The armed fault remains the rung's declarative record
+    of the window; tools/fault_matrix.py sweeps the kind.)"""
+    from dynamo_tpu.planner.sim import gen_trace
+
+    level = max(ev.level, 1.0)
+    trace = gen_trace(
+        "burst", rate=rate * level, duration_s=duration,
+        seed=seed + 7919, isl=isl, osl=osl,
+    )
+    lo = ev.at * duration
+    hi = (ev.until if ev.until is not None else 1.0) * duration
+    tasks: List[asyncio.Task] = []
+    try:
+        for j, arrival in enumerate(trace):
+            if not lo <= arrival.t <= hi:
+                continue  # outside the scheduled flood window
+            delay = arrival.t - (time.monotonic() - t_start)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            tasks.append(
+                asyncio.ensure_future(
+                    _one_request(
+                        fleet.client, FLOOD_BASE + j,
+                        arrival.isl, arrival.osl, seed,
+                    )
+                )
+            )
+        return list(await asyncio.gather(*tasks))
+    finally:
+        for t in tasks:
+            t.cancel()
+
+
 async def run_rung(
     engines: List[Any],
     rung: Dict[str, Any],
@@ -483,12 +667,23 @@ async def run_rung(
     fleet = await ChaosFleet(
         engines, persist_path, watchdog=watchdog
     ).start()
+    if rung.get("supervise"):
+        await fleet.start_supervisor()
     t_start = time.monotonic()
     fault_tasks = [
         asyncio.ensure_future(_drive_fault(fleet, ev, duration))
         for ev in rung["events"]
     ]
     req_tasks: List[asyncio.Task] = []
+    flood_events = [ev for ev in rung["events"] if ev.kind == "tenant_flood"]
+    flood_task = None
+    if flood_events:
+        flood_task = asyncio.ensure_future(
+            _drive_flood(
+                fleet, flood_events[0], t_start,
+                seed=seed, rate=rate, duration=duration, isl=isl, osl=osl,
+            )
+        )
     try:
         for i, arrival in enumerate(trace):
             delay = arrival.t - (time.monotonic() - t_start)
@@ -499,22 +694,42 @@ async def run_rung(
                     _one_request(fleet.client, i, arrival.isl, arrival.osl, seed)
                 )
             )
-        outcomes = await asyncio.gather(*req_tasks)
+        outcomes = list(await asyncio.gather(*req_tasks))
+        if flood_task is not None:
+            # The flood's streams are admitted work too: they count against
+            # the 0-dropped bar (and are reported under their own tenant).
+            outcomes.extend(await flood_task)
         await asyncio.gather(*fault_tasks)
     finally:
         for t in (*req_tasks, *fault_tasks):
             t.cancel()
+        if flood_task is not None:
+            flood_task.cancel()
         faults.reset()
         await fleet.close()
     # -- scoring ------------------------------------------------------------
     outcomes = sorted(outcomes, key=lambda o: o.i)
     completed = [o for o in outcomes if o.status == "ok"]
     dropped = [o for o in outcomes if o.status == "dropped"]
-    within_slo = [
-        o for o in completed
-        if (o.ttft_ms or 0.0) <= slo_ttft_s * 1e3
-        and max(o.itl_ms or [0.0]) <= slo_itl_s * 1e3
-    ]
+
+    def _in_slo(o: Outcome) -> bool:
+        return (
+            o.status == "ok"
+            and (o.ttft_ms or 0.0) <= slo_ttft_s * 1e3
+            and max(o.itl_ms or [0.0]) <= slo_itl_s * 1e3
+        )
+
+    within_slo = [o for o in completed if _in_slo(o)]
+    # Per-tenant goodput: the L6 fairness bar compares each non-flooding
+    # tenant against its own L0 (isolated) number.
+    per_tenant: Dict[str, Dict[str, Any]] = {}
+    for tenant in sorted({o.tenant for o in outcomes}):
+        touts = [o for o in outcomes if o.tenant == tenant]
+        per_tenant[tenant] = {
+            "requests": len(touts),
+            "completed": sum(1 for o in touts if o.status == "ok"),
+            "goodput": sum(1 for o in touts if _in_slo(o)) / max(len(touts), 1),
+        }
     n = max(len(outcomes), 1)
     delta = lambda k, after: after - before[k]  # noqa: E731
     report = {
@@ -526,8 +741,10 @@ async def run_rung(
         "dropped": len(dropped),
         "dropped_errors": sorted({o.error for o in dropped}),
         "shed": 0,  # no admission control in the direct-client harness
+        "supervise": bool(rung.get("supervise")),
         "goodput": len(within_slo) / n,
         "completion_rate": len(completed) / n,
+        "per_tenant": per_tenant,
         "ttft_p50_ms": _pct([o.ttft_ms for o in completed if o.ttft_ms], 0.5),
         "ttft_p95_ms": _pct([o.ttft_ms for o in completed if o.ttft_ms], 0.95),
         "itl_p95_ms": _pct(
@@ -548,6 +765,8 @@ async def run_rung(
                 "quarantines", health_metrics.quarantines_total
             ),
             "ejections": delta("ejections", health_metrics.ejections_total),
+            "respawns": fleet.respawned,
+            "rebalanced": fleet.rebalanced,
         },
         "deterministic": {
             "outcomes": [
@@ -564,7 +783,11 @@ async def run_rung(
 # --------------------------------------------------------------------------
 
 
-def check_report(report: Dict[str, Any], min_ratio: float = 0.85) -> List[str]:
+def check_report(
+    report: Dict[str, Any],
+    min_ratio: float = 0.85,
+    min_tenant_ratio: float = 0.9,
+) -> List[str]:
     """The CI bars; returns human-readable violations (empty = pass)."""
     problems: List[str] = []
     rungs = {r["level"]: r for r in report["rungs"]}
@@ -581,6 +804,9 @@ def check_report(report: Dict[str, Any], min_ratio: float = 0.85) -> List[str]:
                 f"{rung['dropped_errors']}"
             )
         if level > 0:
+            # Flood-tenant ids (>= FLOOD_BASE) never appear in the L0
+            # control, so the identity bar covers exactly the shared trace
+            # — seeded AND unseeded (server-resolved seed) streams alike.
             for i, status, _tokens, token_hash in rung["deterministic"]["outcomes"]:
                 if status == "ok" and i in control and token_hash != control[i]:
                     problems.append(
@@ -588,6 +814,25 @@ def check_report(report: Dict[str, Any], min_ratio: float = 0.85) -> List[str]:
                         f"the L0 control (resume/splice not exact)"
                     )
                     break
+        if rung.get("supervise") and not rung["resilience"].get("respawns"):
+            problems.append(
+                f"L{level}: supervised rung respawned no crashed worker"
+            )
+        if any(ev["kind"] == "tenant_flood" for ev in rung["faults"]):
+            # Noisy-neighbor isolation: every non-flooding tenant keeps >=
+            # min_tenant_ratio of its isolated (L0) goodput while the
+            # flood runs — the WFQ fairness acceptance bar.
+            for tenant, base in (l0.get("per_tenant") or {}).items():
+                if tenant == FLOOD_TENANT or base["goodput"] <= 0:
+                    continue
+                got = (rung.get("per_tenant") or {}).get(tenant, {})
+                ratio = got.get("goodput", 0.0) / base["goodput"]
+                if ratio < min_tenant_ratio:
+                    problems.append(
+                        f"L{level}: tenant {tenant!r} goodput "
+                        f"{got.get('goodput', 0.0):.3f} is {ratio:.2f}x its "
+                        f"L0 {base['goodput']:.3f}; bar is {min_tenant_ratio}"
+                    )
     if 2 in rungs and l0["goodput"] > 0:
         ratio = rungs[2]["goodput"] / l0["goodput"]
         if ratio < min_ratio:
@@ -700,6 +945,8 @@ def main() -> int:
     ap.add_argument("--check", action="store_true",
                     help="enforce the CI bars (exit 1 on violation)")
     ap.add_argument("--min-goodput-ratio", type=float, default=0.85)
+    ap.add_argument("--min-tenant-ratio", type=float, default=0.9,
+                    help="per-tenant goodput retention bar on flood rungs")
     ap.add_argument("--fault-matrix", default=None,
                     help="tools/fault_matrix.py --json artifact to cross-check")
     ap.add_argument("--no-watchdog", action="store_true")
@@ -719,7 +966,9 @@ def main() -> int:
         Path(args.json).write_text(json.dumps(report, indent=2))
         print(f"wrote {args.json}")
     if args.check:
-        problems = check_report(report, args.min_goodput_ratio)
+        problems = check_report(
+            report, args.min_goodput_ratio, args.min_tenant_ratio
+        )
         if problems:
             for p in problems:
                 print(f"CHECK FAILED: {p}", file=sys.stderr)

@@ -86,6 +86,30 @@ async def _run_hub(args) -> None:
     await _wait_forever()
 
 
+def _edge_qos(args):
+    """QosController for the HTTP edge from the layered ``qos`` config
+    section under explicit --qos-*/--brownout flags (llm/qos.py).  Returns
+    None when neither quotas nor the brownout ladder are enabled — zero
+    behaviour change by default."""
+    from .llm.qos import QosConfig, QosController
+
+    section = dict(RuntimeConfig.from_layers().qos)
+    for key in ("tenant_weights", "default_weight", "batch_every"):
+        section.pop(key, None)  # scheduler half (engine/__init__.py)
+    if getattr(args, "qos_rate", None) is not None:
+        section["rate"] = args.qos_rate
+    if getattr(args, "qos_burst", None) is not None:
+        section["burst"] = args.qos_burst
+    if getattr(args, "brownout", False) and not section.get("brownout"):
+        # The explicit flag wins over an absent/disabled config value, but
+        # a configured brownout DICT (custom thresholds) is kept as-is.
+        section["brownout"] = True
+    cfg = QosConfig.from_dict(section)
+    if cfg.rate is None and cfg.brownout is None:
+        return None
+    return QosController(cfg)
+
+
 async def _run_http_frontend(args) -> None:
     from .runtime.client import RouterMode
 
@@ -94,6 +118,7 @@ async def _run_http_frontend(args) -> None:
     # (DYN_RESILIENCE__HTTP_MAX_INFLIGHT=64 etc.), which wins over defaults.
     res = RuntimeConfig.from_layers().resilience
     raw_inflight = res.get("http_max_inflight")
+    qos_ctl = _edge_qos(args)
     service = HttpService(
         host=args.host,
         port=args.port,
@@ -117,6 +142,7 @@ async def _run_http_frontend(args) -> None:
             if args.deadline_s is not None
             else res.get("request_deadline_s")
         ),
+        qos=qos_ctl,
     )
     mode = RouterMode(getattr(args, "router", "round_robin"))
     watcher = await ModelWatcher(runtime, service.models, router_mode=mode).start()
@@ -127,7 +153,7 @@ async def _run_http_frontend(args) -> None:
 
     ns = RuntimeConfig.from_layers().namespace
     slo_pub = await EdgeSloPublisher(
-        runtime.namespace(ns), service.metrics
+        runtime.namespace(ns), service.metrics, qos=qos_ctl
     ).start()
     print(f"OpenAI frontend on http://{service.host}:{service.port}", flush=True)
     try:
@@ -228,7 +254,16 @@ async def _run(args) -> None:
         return engine
 
     if inp == "http":
-        service = HttpService(host=args.host, port=args.port)
+        # Colocated engine: feed its live KV usage to the brownout ladder.
+        kv_usage_fn = (
+            (lambda: engine.metrics().gpu_cache_usage_perc)
+            if hasattr(engine, "metrics")
+            else None
+        )
+        service = HttpService(
+            host=args.host, port=args.port,
+            qos=_edge_qos(args), kv_usage_fn=kv_usage_fn,
+        )
         pipeline = _console_pipeline()
         service.models.add_chat_model(args.model, pipeline)
         service.models.add_completion_model(args.model, pipeline)
@@ -792,6 +827,19 @@ def main(argv: Optional[list] = None) -> None:
         "--deadline-s", type=float, default=None, dest="deadline_s",
         help="default per-request deadline (504 on exhaustion)",
     )
+    # QoS / overload control (llm/qos.py); defaults keep both disabled.
+    p_http.add_argument(
+        "--qos-rate", type=float, default=None, dest="qos_rate",
+        help="per-tenant sustained requests/s (token bucket; unset = off)",
+    )
+    p_http.add_argument(
+        "--qos-burst", type=float, default=None, dest="qos_burst",
+        help="per-tenant burst allowance (default 2x rate)",
+    )
+    p_http.add_argument(
+        "--brownout", action="store_true",
+        help="enable the brownout degradation ladder (docs/qos.md)",
+    )
 
     p_run = sub.add_parser("run", help="in=… out=… launcher")
     p_run.add_argument("inout", nargs=2, metavar="in=/out=")
@@ -931,6 +979,19 @@ def main(argv: Optional[list] = None) -> None:
     p_run.add_argument(
         "--cpu-devices", type=int, default=None, dest="cpu_devices",
         help="TEST ONLY: use N virtual CPU devices per process",
+    )
+    # QoS / overload control for in=http (llm/qos.py; defaults disabled).
+    p_run.add_argument(
+        "--qos-rate", type=float, default=None, dest="qos_rate",
+        help="per-tenant sustained requests/s (token bucket; unset = off)",
+    )
+    p_run.add_argument(
+        "--qos-burst", type=float, default=None, dest="qos_burst",
+        help="per-tenant burst allowance (default 2x rate)",
+    )
+    p_run.add_argument(
+        "--brownout", action="store_true",
+        help="enable the brownout degradation ladder (docs/qos.md)",
     )
 
     p_model = sub.add_parser("model", help="model registry (llmctl equivalent)")

@@ -2,7 +2,12 @@
 scheduler, and the jitted device step loop (SURVEY.md §7 stage 4 — the piece
 the reference outsources to vLLM/sglang)."""
 
-from .config import EngineConfig, LoraConfig, SpecDecodeConfig  # noqa: F401
+from .config import (  # noqa: F401
+    EngineConfig,
+    LoraConfig,
+    QosSchedConfig,
+    SpecDecodeConfig,
+)
 from .kv_manager import KvBlockManager  # noqa: F401
 from .scheduler import Scheduler, SequenceState  # noqa: F401
 
@@ -76,6 +81,7 @@ def build_tpu_engine(args):
         attn_impl=getattr(args, "attn_impl", "auto"),
         spec_decode=_spec_decode_section(args),
         lora=lora_section,
+        qos=_qos_sched_section(),
     )
     engine = TpuEngine(cfg)
     _load_adapters(engine, lora_adapters, getattr(args, "model", None))
@@ -97,6 +103,18 @@ def _spec_decode_section(args) -> dict:
     if getattr(args, "spec_ngram_min", None) is not None:
         section["ngram_min"] = int(args.spec_ngram_min)
     return section
+
+
+def _qos_sched_section() -> dict:
+    """Scheduler half of the layered ``qos`` config section (file /
+    DYN_QOS__* env): WFQ tenant weights + the batch starvation bound.  The
+    edge half (quotas, brownout) is consumed by the CLI's HttpService
+    wiring instead."""
+    from ..runtime.config import RuntimeConfig
+
+    section = RuntimeConfig.from_layers().qos or {}
+    known = ("tenant_weights", "default_weight", "batch_every")
+    return {k: section[k] for k in known if k in section}
 
 
 def _lora_section(args):

@@ -11,7 +11,7 @@ every workload mix.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 
 def _pow2_buckets(lo: int, hi: int) -> List[int]:
@@ -147,6 +147,48 @@ class LoraConfig:
 
 
 @dataclass
+class QosSchedConfig:
+    """Scheduler-side QoS (engine/scheduler.py WfqQueue; llm/qos.py has the
+    edge half).  Defaults reproduce pre-QoS behaviour exactly for
+    single-tenant traffic: equal weights collapse WFQ to per-tenant FIFO,
+    and FIFO within one tenant.
+    """
+
+    # Tenant → WFQ weight (share of admission work while backlogged).
+    tenant_weights: Dict[str, float] = field(default_factory=dict)
+    default_weight: float = 1.0
+    # Batch-class starvation bound: at most this many consecutive
+    # interactive admissions while batch is backlogged before one batch
+    # admission is forced.
+    batch_every: int = 4
+
+    def __post_init__(self) -> None:
+        if self.default_weight <= 0:
+            raise ValueError("qos default_weight must be > 0")
+        if self.batch_every < 1:
+            raise ValueError("qos batch_every must be >= 1")
+        for name, w in self.tenant_weights.items():
+            if float(w) <= 0:
+                raise ValueError(f"qos tenant weight {name!r} must be > 0")
+
+    @classmethod
+    def normalize(cls, v: Any) -> "QosSchedConfig":
+        """Accept the section in any layered-config shape (see
+        SpecDecodeConfig.normalize)."""
+        if v is None:
+            return cls()
+        if isinstance(v, cls):
+            return v
+        if isinstance(v, dict):
+            known = set(cls.__dataclass_fields__)
+            bad = set(v) - known
+            if bad:
+                raise ValueError(f"unknown qos keys: {sorted(bad)}")
+            return cls(**v)
+        raise ValueError(f"bad qos section: {v!r}")
+
+
+@dataclass
 class EngineConfig:
     model: str = "debug-tiny"
     block_size: int = 16
@@ -236,6 +278,10 @@ class EngineConfig:
     # select an adapter via the OpenAI ``model`` field; rows without one run
     # the base model unchanged.
     lora: Any = None
+    # Scheduler QoS section (QosSchedConfig; accepts dict): WFQ tenant
+    # weights + the batch-class starvation bound.  Defaults are exact-FIFO
+    # for single-tenant traffic.
+    qos: Any = None
 
     def __post_init__(self) -> None:
         if not self.batch_buckets:
@@ -248,6 +294,7 @@ class EngineConfig:
             self.cache_dtype = self.dtype
         self.spec_decode = SpecDecodeConfig.normalize(self.spec_decode)
         self.lora = LoraConfig.normalize(self.lora)
+        self.qos = QosSchedConfig.normalize(self.qos)
         if self.weight_quant not in (None, "int8"):
             # One check covering every load path (checkpoint / random-init /
             # externally supplied params).

@@ -958,13 +958,31 @@ class TpuEngine(
         self._contexts[request.id] = request.ctx
         self.scheduler.add(seq)
         self._wake.set()
+        # Server-side seed resolution (llm/qos satellite): UNSEEDED sampled
+        # requests get their engine-assigned seed stamped onto the first
+        # stream item, so the routed client's _StreamGuard can build a
+        # byte-identical resume request after a mid-stream crash —
+        # previously only explicit-seed streams were crash-resumable.
+        # Greedy (temperature 0) streams are seed-independent and stay
+        # unstamped: their output must not vary with the request id
+        # (recorder replay and A/B comparisons rely on that), and resume
+        # determinism never needed a seed for them.  Resumed requests
+        # always carry an explicit seed, so they are never re-stamped.
+        samp_opts = pre.sampling_options
+        stamp_seed = (
+            samp_opts.seed is None and (samp_opts.temperature or 0.0) > 0.0
+        )
 
         async def gen() -> AsyncIterator[Dict[str, Any]]:
+            needs_stamp = stamp_seed
             try:
                 while True:
                     item = await queue.get()
                     if item is _FINISHED:
                         return
+                    if needs_stamp and isinstance(item, dict):
+                        item["resolved_seed"] = int(seq.sampling_seed)
+                        needs_stamp = False
                     yield item
             finally:
                 self._queues.pop(request.id, None)

@@ -118,6 +118,8 @@ class MigrationMixin:
             # the target re-derives its state from the resumed tokens.
             adapter=seq.adapter,
             kv_salt=seq.kv_salt,
+            tenant=seq.tenant or None,
+            priority=seq.priority or None,
             grammar=seq.grammar.to_dict() if seq.grammar is not None else None,
         )
 

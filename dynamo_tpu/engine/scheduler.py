@@ -9,9 +9,20 @@ compiled programs total.
 
 Admission is blocks-aware: a sequence is only admitted when the KV manager
 can allocate its prompt blocks (minus prefix-cache hits).  Decode growth
-allocates one block at a time; if the pool is exhausted the youngest sequence
-is preempted back to the waiting queue (its blocks freed — recomputed later,
-matching the reference engines' recompute-style preemption).
+allocates one block at a time; if the pool is exhausted a victim sequence is
+preempted back to the waiting queue (its blocks freed — recomputed later,
+matching the reference engines' recompute-style preemption).  Victims are
+chosen QoS-aware: ``batch``-priority rows first (they signed up to be the
+degradation buffer — llm/qos.py), youngest first within a class, so one
+tenant's burst can never preempt another tenant's interactive rows while
+batch rows are available.
+
+The waiting queue is a weighted-fair queue (``WfqQueue``) keyed on tenant
+identity, not a FIFO: under overload one flooding tenant's backlog cannot
+crowd admission away from others — each backlogged tenant drains in
+proportion to its configured weight (EngineConfig ``qos.tenant_weights``),
+with a provable starvation bound (see WfqQueue).  Single-tenant traffic
+degenerates to exact FIFO, so the pre-QoS behaviour is unchanged.
 """
 
 from __future__ import annotations
@@ -20,9 +31,10 @@ import time
 import zlib
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Deque, List, Optional, Sequence, Tuple
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 from ..llm.protocols import PreprocessedRequest
+from ..llm.qos import BATCH, INTERACTIVE, normalize_priority
 from ..tokens import TokenBlockSequence
 from .config import EngineConfig
 from .kv_manager import KvBlockManager
@@ -114,6 +126,15 @@ class SequenceState:
     # device) — they advance through single unified steps instead.
     grammar: Any = None
     grammar_state: int = 0
+    # --- QoS (llm/qos.py) ---
+    # Fairness identity for the WFQ waiting queue: explicit annotation, the
+    # LoRA adapter, or the served model name — "" means the shared default
+    # tenant (single-tenant traffic collapses to FIFO).
+    tenant: str = ""
+    # interactive (default, protected) | batch (first preemption victim,
+    # shed first under brownout).  Threaded from nvext.priority via
+    # PreprocessedRequest.priority.
+    priority: str = INTERACTIVE
 
     def __post_init__(self) -> None:
         if self.orig_prompt_len == 0:
@@ -185,6 +206,20 @@ class SequenceState:
             ignore_eos=bool(stop.ignore_eos),
             spec_enabled=getattr(samp, "spec_decode", None) is not False,
             orig_prompt_len=orig_len,
+            # QoS identity (llm/qos.py): tenant keys the WFQ waiting queue,
+            # priority picks the class band.  Both default benign — absent
+            # fields reproduce the pre-QoS scheduler exactly.
+            tenant=str(
+                pre.annotations.get("tenant")
+                or pre.annotations.get("adapter")
+                or pre.model
+                or ""
+            ),
+            priority=normalize_priority(
+                pre.priority
+                if pre.priority is not None
+                else pre.annotations.get("priority")
+            ),
         )
         spec = resume.get("spec") if isinstance(resume, dict) else None
         if isinstance(spec, dict):
@@ -197,6 +232,188 @@ class SequenceState:
             seq.spec_next_try = int(spec.get("next_try", seq.spec_next_try))
             seq.spec_miss = int(spec.get("miss", seq.spec_miss))
         return seq
+
+
+class WfqQueue:
+    """Weighted fair queue over (priority class, tenant) with FIFO per flow.
+
+    Classic virtual-finish-time WFQ: each arriving sequence is stamped
+    ``vft = max(V, last_vft[flow]) + cost / weight`` where ``V`` is the
+    queue's virtual time (advanced to the departing head's vft on every
+    pop), ``cost`` is the request's worst-case token work (prompt +
+    generation budget) and ``weight`` the tenant's configured share.  The
+    head is always the minimum-vft entry, so each backlogged tenant drains
+    work in proportion to its weight regardless of arrival order or burst
+    size.
+
+    **Starvation bound** (the fairness contract tests assert): a backlogged
+    tenant of weight ``w`` with head cost ``c`` is admitted after at most
+    ``(W/w)·c`` token-work units of other tenants' admissions, where ``W``
+    is the total weight of backlogged tenants — its head's vft is at most
+    ``V + c/w``, and every competing admission advances ``V`` by at least
+    ``cost/W``.  No request waits forever while the queue drains.
+
+    **Priority classes**: interactive flows are served before batch flows,
+    EXCEPT that after ``batch_every`` consecutive interactive admissions
+    with batch backlogged, one batch admission is forced — so batch is
+    starved by at most ``batch_every`` admissions, never indefinitely.
+
+    **Urgent lane**: ``appendleft`` (preemption requeue) bypasses WFQ —
+    a preempted sequence already earned its admission and re-enters first,
+    preserving the pre-QoS recompute semantics.
+
+    Single tenant + single class degenerates to exact FIFO (vft is
+    monotone per flow), so existing single-tenant behaviour is unchanged.
+    Duck-types the deque surface the scheduler/engine/migration layers use:
+    ``[0]``, ``popleft``, ``append``, ``appendleft``, ``remove``, ``in``,
+    ``len``, truthiness, iteration, ``clear``.
+    """
+
+    def __init__(
+        self,
+        tenant_weights: Optional[Dict[str, float]] = None,
+        default_weight: float = 1.0,
+        batch_every: int = 4,
+    ):
+        self.tenant_weights = dict(tenant_weights or {})
+        self.default_weight = max(default_weight, 1e-9)
+        self.batch_every = max(1, int(batch_every))
+        self._urgent: Deque[SequenceState] = deque()
+        # flow = (priority, tenant) → FIFO of seqs; vft rides on the seq.
+        self._flows: Dict[Tuple[str, str], Deque[SequenceState]] = {}
+        self._last_vft: Dict[Tuple[str, str], float] = {}
+        self._vt = 0.0
+        self._since_batch = 0
+
+    # -- helpers -----------------------------------------------------------
+
+    def _weight(self, tenant: str) -> float:
+        return max(float(self.tenant_weights.get(tenant, self.default_weight)), 1e-9)
+
+    @staticmethod
+    def _cost(seq: SequenceState) -> float:
+        # Worst-case token work: prompt prefill + generation budget.  add()
+        # trims max_new_tokens before enqueue, so the budget is always set.
+        return float(max(1, len(seq.prompt) + (seq.max_new_tokens or 0)))
+
+    def _flow_head(self, priority: str) -> Optional[SequenceState]:
+        """Min-vft head among ``priority``-class flows (tenant name breaks
+        ties deterministically)."""
+        best: Optional[SequenceState] = None
+        best_key: Optional[Tuple[float, str]] = None
+        for (prio, tenant), q in self._flows.items():
+            if prio != priority or not q:
+                continue
+            key = (q[0]._wfq_vft, tenant)
+            if best_key is None or key < best_key:
+                best, best_key = q[0], key
+        return best
+
+    def _select(self) -> Optional[SequenceState]:
+        """The next sequence WFQ would admit (pure — no counter updates)."""
+        if self._urgent:
+            return self._urgent[0]
+        interactive = self._flow_head(INTERACTIVE)
+        batch = self._flow_head(BATCH)
+        if interactive is None:
+            return batch
+        if batch is not None and self._since_batch >= self.batch_every:
+            return batch  # anti-starvation: batch head jumps the class gap
+        return interactive
+
+    # -- deque surface -----------------------------------------------------
+
+    def append(self, seq: SequenceState) -> None:
+        flow = (seq.priority, seq.tenant)
+        vft = max(self._vt, self._last_vft.get(flow, 0.0)) + self._cost(
+            seq
+        ) / self._weight(seq.tenant)
+        seq._wfq_vft = vft
+        self._last_vft[flow] = vft
+        self._flows.setdefault(flow, deque()).append(seq)
+
+    def appendleft(self, seq: SequenceState) -> None:
+        self._urgent.appendleft(seq)
+
+    def popleft(self) -> SequenceState:
+        seq = self._select()
+        if seq is None:
+            raise IndexError("pop from an empty WfqQueue")
+        self._remove_entry(seq)
+        # Virtual time advances to the ADMITTED head's finish time — the
+        # WFQ invariant that keeps newly arriving flows from replaying
+        # history.  Only real admissions advance it: a cancellation deep
+        # in a backlogged flow (remove()) must not jump V to that flow's
+        # far-future finish time, or every later arrival from OTHER
+        # tenants would be stamped behind the whole backlog — exactly the
+        # starvation WFQ exists to prevent.  Same for the batch counter:
+        # only admissions count toward the anti-starvation window.
+        self._vt = max(self._vt, getattr(seq, "_wfq_vft", self._vt))
+        if seq.priority == BATCH:
+            self._since_batch = 0
+        elif self._flow_head(BATCH) is not None:
+            self._since_batch += 1
+        return seq
+
+    def _remove_entry(self, seq: SequenceState) -> None:
+        if seq in self._urgent:
+            self._urgent.remove(seq)
+            return
+        flow = (seq.priority, seq.tenant)
+        q = self._flows.get(flow)
+        if q is None or seq not in q:
+            raise ValueError("sequence not in WfqQueue")
+        q.remove(seq)
+        if not q:
+            # Prune the flow's virtual-time memory with its queue: tenant
+            # ids are wire-controlled, so _last_vft must not grow without
+            # bound as tenants churn — and a flow whose whole backlog was
+            # CANCELLED must not keep the cancelled tail's far-future
+            # finish time as a penalty on its next genuine request.  (An
+            # admission-drained flow's last_vft is <= the advanced V, so
+            # deletion is a no-op semantically.)
+            del self._flows[flow]
+            self._last_vft.pop(flow, None)
+        elif getattr(seq, "_wfq_vft", None) == self._last_vft.get(flow):
+            # Cancelled the flow's TAIL: roll last_vft back to the new
+            # tail (per-flow vfts are FIFO-monotone) so later arrivals
+            # are not stamped behind cancelled, never-served work.
+            self._last_vft[flow] = q[-1]._wfq_vft
+
+    def remove(self, seq: SequenceState) -> None:
+        """Drop a cancelled/aborted entry WITHOUT advancing virtual time
+        or the batch admission counter (see popleft)."""
+        self._remove_entry(seq)
+
+    def clear(self) -> None:
+        self._urgent.clear()
+        self._flows.clear()
+        self._last_vft.clear()
+        self._since_batch = 0
+
+    def __getitem__(self, index: int) -> SequenceState:
+        if index != 0:
+            raise IndexError("WfqQueue only exposes its head ([0])")
+        seq = self._select()
+        if seq is None:
+            raise IndexError("WfqQueue is empty")
+        return seq
+
+    def __contains__(self, seq: SequenceState) -> bool:
+        return seq in self._urgent or any(
+            seq in q for q in self._flows.values()
+        )
+
+    def __len__(self) -> int:
+        return len(self._urgent) + sum(len(q) for q in self._flows.values())
+
+    def __bool__(self) -> bool:
+        return len(self._urgent) > 0 or any(self._flows.values())
+
+    def __iter__(self):
+        yield from self._urgent
+        for q in self._flows.values():
+            yield from q
 
 
 @dataclass
@@ -217,7 +434,11 @@ class Scheduler:
     def __init__(self, cfg: EngineConfig, kv: KvBlockManager):
         self.cfg = cfg
         self.kv = kv
-        self.waiting: Deque[SequenceState] = deque()
+        self.waiting: WfqQueue = WfqQueue(
+            tenant_weights=cfg.qos.tenant_weights,
+            default_weight=cfg.qos.default_weight,
+            batch_every=cfg.qos.batch_every,
+        )
         self.running: List[SequenceState] = []
         self.rejected: List[SequenceState] = []  # can never fit; engine fails them
         self.preempted = 0  # cumulative, for metrics
@@ -265,11 +486,13 @@ class Scheduler:
         items: List[Tuple[SequenceState, int, int]] = []
 
         # Decode rows: one token per running decoded sequence.  On block
-        # exhaustion preempt the YOUNGEST running sequence (vLLM recompute
-        # policy: protect older requests' progress) and retry.  Victims must
-        # come from sequences NOT yet scheduled this step: preempting one
-        # already in ``items`` would leave a stale row whose blocks were
-        # freed (block_ids=[]) and crash _build_ragged downstream.
+        # exhaustion preempt the YOUNGEST BATCH-class sequence if any (QoS:
+        # batch rows are the degradation buffer, llm/qos.py), else the
+        # youngest overall (vLLM recompute policy: protect older requests'
+        # progress) and retry.  Victims must come from sequences NOT yet
+        # scheduled this step: preempting one already in ``items`` would
+        # leave a stale row whose blocks were freed (block_ids=[]) and
+        # crash _build_ragged downstream.
         scheduled: set = set()
         for seq in [
             s
@@ -298,7 +521,8 @@ class Scheduler:
                 ]
                 if not victims:
                     break
-                self._preempt(victims[-1])
+                batch_victims = [s for s in victims if s.priority == BATCH]
+                self._preempt((batch_victims or victims)[-1])
                 ok = self._ensure_slot(seq)
             if not ok:
                 # No unscheduled victim left: self-preempt and recompute later.

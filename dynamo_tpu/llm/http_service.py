@@ -31,9 +31,20 @@ from ..runtime.resilience import (
     DeadlineExceededError,
 )
 from ..runtime.resilience import metrics as resilience_metrics
-from .metrics import Metrics, Status
+from .metrics import Metrics, Status, qos_metrics
 from .openai import SSE_DONE, aggregate_chunks, sse_encode
 from .protocols import ModelNotFoundError
+from .qos import (
+    BATCH,
+    BrownoutSignals,
+    QosController,
+    QosShed,
+    RUNG_CAP_TOKENS,
+    RUNG_SHED_INTERACTIVE,
+    RUNG_SPEC_STANDDOWN,
+    resolve_priority,
+    resolve_tenant,
+)
 from .tenancy.lora import AdapterCapacityError
 
 logger = logging.getLogger(__name__)
@@ -89,6 +100,8 @@ class HttpService:
         admission_queue: int = 0,
         admission_timeout_s: float = 1.0,
         default_deadline_s: Optional[float] = None,
+        qos: Optional[QosController] = None,
+        kv_usage_fn=None,
     ):
         self.host = host
         self.port = port
@@ -98,12 +111,20 @@ class HttpService:
         # Admission control (disabled unless max_inflight is set): beyond
         # the in-flight cap requests wait in a bounded FIFO; overflow sheds
         # 429, wait-timeout sheds 503 — latency stays bounded instead of
-        # collapsing under burst.
+        # collapsing under burst.  Batch-class requests may only occupy the
+        # front half of the queue (llm/qos.py priority classes).
         self.admission = AdmissionController(
             max_inflight=max_inflight,
             max_queue=admission_queue,
             queue_timeout_s=admission_timeout_s,
         )
+        # QoS/overload control (llm/qos.py): per-tenant token buckets + the
+        # brownout degradation ladder.  None = disabled (zero behaviour
+        # change).  ``kv_usage_fn`` optionally feeds the ladder a KV-
+        # pressure signal when an engine/collector is colocated.
+        self.qos = qos
+        self._kv_usage_fn = kv_usage_fn
+        self._qos_task: Optional[asyncio.Task] = None
         # Per-request wall-clock budget (None = unbounded, the previous
         # behaviour); exhaustion maps to 504 below.
         self.default_deadline_s = default_deadline_s
@@ -127,12 +148,61 @@ class HttpService:
             self.port = s.getsockname()[1]
             break
         logger.info("HTTP service listening on %s:%s", self.host, self.port)
+        if self.qos is not None and self.qos.ladder is not None:
+            self._qos_task = asyncio.get_running_loop().create_task(
+                self._qos_tick_loop()
+            )
         return self
 
     async def close(self) -> None:
+        if self._qos_task is not None:
+            self._qos_task.cancel()
+            try:
+                await self._qos_task
+            except asyncio.CancelledError:
+                pass
+            self._qos_task = None
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+
+    async def _qos_tick_loop(self) -> None:
+        """Drive the brownout ladder off live edge signals.  The ladder
+        itself is pure (llm/qos.py BrownoutLadder.tick); this loop only
+        samples queue depth, rolling TTFT and (optionally) KV usage on the
+        configured interval and publishes the rung to metrics."""
+        ladder = self.qos.ladder
+        while True:
+            await asyncio.sleep(self.qos.config.tick_s)
+            kv_usage = 0.0
+            if self._kv_usage_fn is not None:
+                try:
+                    kv_usage = float(self._kv_usage_fn())
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — signal source is optional
+                    logger.warning("qos kv_usage_fn failed", exc_info=True)
+            # TTFT from the AGE-bounded window (None = no first token in
+            # the last few seconds): the count-bounded planner windows
+            # would hold a spike's samples long after it ended — at zero
+            # traffic forever — and the ladder could never recover.
+            ttft_p95_ms = self.metrics.recent_ttft_p95_ms()
+            before = ladder.rung
+            ladder.tick(
+                BrownoutSignals(
+                    queue_depth=float(self.admission.queued),
+                    kv_usage=kv_usage,
+                    ttft_p95_ms=ttft_p95_ms,
+                )
+            )
+            qos_metrics.brownout_rung = ladder.rung
+            if ladder.rung != before:
+                qos_metrics.brownout_transitions_total += 1
+                logger.warning(
+                    "brownout rung %d -> %d (queue=%d ttft_p95=%sms)",
+                    before, ladder.rung, self.admission.queued,
+                    "%.0f" % ttft_p95_ms if ttft_p95_ms is not None else "-",
+                )
 
     async def run(self, shutdown: Optional[asyncio.Event] = None) -> None:
         await self.start()
@@ -147,7 +217,10 @@ class HttpService:
     # -- handlers -----------------------------------------------------------
 
     async def _health(self, request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok", "models": self.models.model_names()})
+        body = {"status": "ok", "models": self.models.model_names()}
+        if self.qos is not None and self.qos.ladder is not None:
+            body["brownout"] = self.qos.ladder.state()
+        return web.json_response(body)
 
     async def _metrics(self, request: web.Request) -> web.Response:
         # Planner decisions/state ride along when a planner runs in this
@@ -166,6 +239,7 @@ class HttpService:
             + migration_metrics.render(self._metrics_prefix).encode()
             + tenancy_metrics.render(self._metrics_prefix).encode()
             + health_metrics.render(self._metrics_prefix).encode()
+            + qos_metrics.render(self._metrics_prefix).encode()
         )
         return web.Response(body=body, content_type="text/plain")
 
@@ -203,17 +277,96 @@ class HttpService:
             self.metrics.requests_total.labels(model, endpoint, "stream", Status.REJECTED).inc()
             return _model_not_found(model)
 
+        # QoS (llm/qos.py): resolve tenant + priority, charge the tenant's
+        # quota, apply the brownout rung — all BEFORE a slot is consumed.
+        priority = resolve_priority(request.headers, body)
+        tenant: Optional[str] = None
+        if self.qos is not None:
+            tenant = resolve_tenant(request.headers, body)
+            if (
+                self.qos.rung >= RUNG_SHED_INTERACTIVE
+                and self.admission.saturated
+            ):
+                # Rung 4: admission is saturated — shed instead of queueing
+                # (never sheds below the in-flight cap).  Checked BEFORE
+                # the quota charge: a shed request consumed no capacity
+                # and must not drain the tenant's bucket.
+                qos_metrics.interactive_shed_total += 1
+                qos_metrics.shed_tenant(tenant)
+                self.metrics.requests_total.labels(
+                    model, endpoint, "stream", Status.REJECTED
+                ).inc()
+                return _error_response(
+                    503,
+                    "server in brownout (interactive overflow)",
+                    retry_after_s=self.admission.estimate_retry_after(),
+                )
+            try:
+                self.qos.admit(
+                    tenant, priority, self.admission.estimate_retry_after()
+                )
+            except QosShed as e:
+                if e.reason == "quota":
+                    qos_metrics.quota_shed_total += 1
+                else:
+                    qos_metrics.batch_shed_total += 1
+                qos_metrics.shed_tenant(tenant)
+                self.metrics.requests_total.labels(
+                    model, endpoint, "stream", Status.REJECTED
+                ).inc()
+                return _error_response(
+                    e.status, e.message, retry_after_s=e.retry_after_s
+                )
+            rung = self.qos.rung
+            if rung >= RUNG_CAP_TOKENS:
+                qos_metrics.capped_requests_total += 1
+            if rung >= RUNG_SPEC_STANDDOWN:
+                qos_metrics.spec_standdowns_total += 1
+            body = self.qos.shape(body)
+            if tenant != model:
+                # Thread the RESOLVED identity to the scheduler's WFQ
+                # (preprocessor: nvext.tenant → annotations.tenant) — a
+                # model-named tenant is the scheduler's own fallback, so
+                # only header/credential identities need the stamp.
+                # Without it, two API keys sharing a model land in one
+                # WFQ flow and noisy-neighbor isolation never engages.
+                nvext = body.get("nvext")
+                if not isinstance(nvext, dict):
+                    nvext = {}
+                    body["nvext"] = nvext
+                nvext["tenant"] = tenant
+        if priority == BATCH or "x-priority" in request.headers:
+            # Thread the resolved class to the scheduler (the preprocessor
+            # reads nvext.priority into PreprocessedRequest.priority).
+            # NOT setdefault: a client-sent ``"nvext": null`` would satisfy
+            # it and the batch class would silently run as interactive —
+            # bypassing batch-first preemption and the rung-3 shed.
+            nvext = body.get("nvext")
+            if not isinstance(nvext, dict):
+                nvext = {}
+                body["nvext"] = nvext
+            nvext["priority"] = priority
+
         # Admission control guards everything that costs engine work; cheap
-        # 400/404s above never consume a slot.
+        # 400/404s above never consume a slot.  Batch-class requests only
+        # queue in their reserved fraction (resilience.AdmissionController).
         try:
-            await self.admission.acquire()
+            await self.admission.acquire(priority)
         except AdmissionRejected as e:
+            if self.qos is not None and tenant is not None:
+                # The quota was charged above, but this request was shed
+                # before consuming any capacity — credit it back.
+                self.qos.quotas.refund(tenant)
             self.metrics.requests_total.labels(
                 model, endpoint, "stream", Status.REJECTED
             ).inc()
-            return _error_response(
-                e.status, e.message, retry_after_s=e.retry_after_s
-            )
+            # The drain-rate estimate says when a slot frees; a deepening
+            # brownout says the estimate is optimistic — back clients off
+            # harder the further down the ladder the edge already is.
+            retry = e.retry_after_s
+            if self.qos is not None and self.qos.rung:
+                retry *= 1 + self.qos.rung
+            return _error_response(e.status, e.message, retry_after_s=retry)
         try:
             return await self._admitted_openai(request, body, engine, model, endpoint)
         finally:

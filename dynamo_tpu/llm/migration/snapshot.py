@@ -57,6 +57,12 @@ class SequenceSnapshot:
     # (addressable KV), or the stream silently changes tenants.
     adapter: Optional[str] = None
     kv_salt: Optional[str] = None
+    # QoS identity (llm/qos.py): the fairness tenant and priority class the
+    # source scheduled under — the target must resume in the SAME class
+    # and fairness flow, or a migration would silently launder a batch row
+    # into the protected interactive band (and vice versa).
+    tenant: Optional[str] = None
+    priority: Optional[str] = None
     # Structured-output constraint: the serialized TokenMaskAutomaton.
     # The automaton STATE does not travel — the target re-derives it by
     # advancing from the start state through the resumed output tokens
@@ -83,6 +89,8 @@ class SequenceSnapshot:
             "detok": self.detok,
             "adapter": self.adapter,
             "kv_salt": self.kv_salt,
+            "tenant": self.tenant,
+            "priority": self.priority,
             "grammar": self.grammar,
         }
 
@@ -99,6 +107,8 @@ class SequenceSnapshot:
             detok=d.get("detok"),
             adapter=d.get("adapter"),
             kv_salt=d.get("kv_salt"),
+            tenant=d.get("tenant"),
+            priority=d.get("priority"),
             grammar=d.get("grammar"),
             version=int(d.get("version", SNAPSHOT_VERSION)),
         )
@@ -143,6 +153,9 @@ class SequenceSnapshot:
                 # the old annotation shape.
                 **({"adapter": self.adapter} if self.adapter else {}),
                 **({"kv_salt": self.kv_salt} if self.kv_salt else {}),
+                # QoS fairness flow (llm/qos.py; omitted when default).
+                **({"tenant": self.tenant} if self.tenant else {}),
             },
             **({"grammar": dict(self.grammar)} if self.grammar else {}),
+            **({"priority": self.priority} if self.priority else {}),
         }

@@ -36,6 +36,11 @@ class NvExt(BaseModel):
     # (restricted syntax) or a JSON-schema dict.  Wins over the standard
     # ``response_format`` field when both are set.
     grammar: Optional[Union[str, Dict[str, Any]]] = None
+    # QoS (llm/qos.py): priority class ("interactive" | "batch"; the
+    # x-priority header wins at the edge) and an explicit tenant identity
+    # override for quota/fairness accounting (default: API key / model).
+    priority: Optional[str] = None
+    tenant: Optional[str] = None
 
 
 class ChatMessage(BaseModel):

@@ -155,6 +155,18 @@ class OpenAIPreprocessor(Operator):
             # set HERE so routing happens before any engine is chosen.
             annotations["adapter"] = self.adapter
             annotations["kv_salt"] = kv_salt_for_adapter(self.adapter)
+        # QoS identity (llm/qos.py): an explicit nvext.tenant overrides the
+        # scheduler's default fairness key (adapter → model name); priority
+        # rides its own PreprocessedRequest field (the HTTP edge may have
+        # already stamped it from the x-priority header).
+        priority = None
+        if oai.nvext:
+            if oai.nvext.tenant:
+                annotations["tenant"] = str(oai.nvext.tenant)
+            if oai.nvext.priority is not None:
+                from .qos import normalize_priority
+
+                priority = normalize_priority(oai.nvext.priority)
         return PreprocessedRequest(
             token_ids=token_ids,
             stop_conditions=oai.stop_conditions(),
@@ -162,6 +174,7 @@ class OpenAIPreprocessor(Operator):
             model=oai.model,
             annotations=annotations,
             grammar=self._compile_grammar(oai) if grammar is _UNSET else grammar,
+            priority=priority,
         )
 
     # -- dispatch -----------------------------------------------------------

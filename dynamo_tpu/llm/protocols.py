@@ -137,6 +137,11 @@ class PreprocessedRequest:
     # holding the tokenizer); engines deserialize by content hash and apply
     # it as a per-row logit mask.  None = unconstrained.
     grammar: Optional[Dict[str, Any]] = None
+    # QoS priority class (llm/qos.py): "interactive" | "batch".  None =
+    # unspecified (treated as interactive downstream); parsed at the edge
+    # from the x-priority header / nvext.priority and consumed by the
+    # scheduler (batch rows preempt first, shed first under brownout).
+    priority: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         out = {
@@ -150,6 +155,9 @@ class PreprocessedRequest:
             # Omitted when absent: pre-tenancy consumers (recorded streams,
             # older workers) never see the key.
             out["grammar"] = self.grammar
+        if self.priority is not None:
+            # Same omitted-when-absent wire compat as grammar.
+            out["priority"] = self.priority
         return out
 
     @classmethod
@@ -161,6 +169,7 @@ class PreprocessedRequest:
             model=d.get("model"),
             annotations=dict(d.get("annotations") or {}),
             grammar=d.get("grammar"),
+            priority=d.get("priority"),
         )
 
 

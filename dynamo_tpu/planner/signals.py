@@ -156,6 +156,10 @@ class SignalSnapshot:
     prefill_queue_depth: int = 0
     hit_isl_blocks: int = 0
     hit_overlap_blocks: int = 0
+    # Worst brownout rung any live edge reports (llm/qos.py ladder): >0
+    # means latency/queue signals are already brownout-suppressed — a
+    # scale-down policy must not read that suppression as idle capacity.
+    edge_brownout_rung: int = 0
 
     def pool(self, name: str) -> PoolStats:
         return self.pools.get(name) or PoolStats()
@@ -495,6 +499,9 @@ class SignalCollector:
             prefill_queue_depth=queue_depth,
             hit_isl_blocks=self._hit_isl,
             hit_overlap_blocks=self._hit_overlap,
+            edge_brownout_rung=int(
+                self._edge_percentile("brownout_rung") or 0
+            ),
         )
 
 
@@ -509,11 +516,18 @@ class EdgeSloPublisher:
         metrics,
         edge_id: Optional[str] = None,
         interval: float = 2.0,
+        qos=None,
     ):
         self.namespace = namespace
         self.metrics = metrics
         self.edge_id = edge_id or f"edge-{id(self):x}"
         self.interval = interval
+        # Optional QosController (llm/qos.py): when the edge runs the
+        # brownout ladder its current rung rides the publication, so the
+        # planner can tell "latency is fine because the edge is already
+        # degrading service" from "latency is fine" — scale-down decisions
+        # should not read brownout-suppressed load as idle capacity.
+        self.qos = qos
         self._task: Optional[asyncio.Task] = None
 
     async def start(self) -> "EdgeSloPublisher":
@@ -523,6 +537,8 @@ class EdgeSloPublisher:
     async def publish_once(self) -> None:
         snap = self.metrics.edge_slo_snapshot()
         snap["edge_id"] = self.edge_id
+        if self.qos is not None and self.qos.ladder is not None:
+            snap["brownout_rung"] = self.qos.rung
         # Per-worker TTFT/ITL p50s observed by this edge's routed clients
         # (runtime/health.py): the planner-side watchdog's straggler feed.
         workers = worker_latency.snapshot()

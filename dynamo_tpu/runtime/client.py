@@ -495,11 +495,15 @@ class _StreamGuard:
       splices the continuation in; the caller sees one uninterrupted,
       token-identical stream and never observes the marker.
     - **After the first token** a crash is recoverable only when replaying
-      cannot change what the caller already saw: requests with an explicit
-      sampling seed are deterministic, so the guard folds the delivered
-      tokens into a resume request (same shape migration uses) and
-      continues on another worker.  Unseeded requests propagate the error
-      untouched, exactly as before.
+      cannot change what the caller already saw: the resume request needs
+      deterministic continuation.  Explicit-seed requests always have it;
+      greedy (temperature-0) streams are seed-independent and resume
+      seedless; for UNSEEDED SAMPLED requests the engine resolves a seed
+      at admission and stamps it on the first stream item
+      (``resolved_seed`` — engine.py generate), which the guard captures
+      here.  So every stream that has delivered a token is resumable; only
+      an unseeded sampled stream from a pre-QoS engine (no stamp seen)
+      still propagates the error untouched.
 
     The deadline bounds the wait for every item and every re-dispatch.
     """
@@ -537,6 +541,10 @@ class _StreamGuard:
         # (KV imports, control calls) can't resume and never migrate.
         self._all_tokens: Optional[List[int]] = None
         self._orig_prompt_len = 0
+        # Engine-resolved sampler seed for UNSEEDED requests (stamped on the
+        # first stream item): makes every stream crash-resumable, not just
+        # explicit-seed ones.
+        self._resolved_seed: Optional[int] = None
         self._track_request(request.data)
 
     def __aiter__(self):
@@ -578,6 +586,11 @@ class _StreamGuard:
                 )
                 self._reset_latency_anchor()
                 continue
+            if isinstance(item, dict) and "resolved_seed" in item:
+                # Captured (and stripped) before anything else: the stamp
+                # may ride the migrated marker when cutover precedes the
+                # first token.
+                self._resolved_seed = int(item.pop("resolved_seed"))
             if isinstance(item, dict) and item.get("migrated"):
                 await self._splice(item["migrated"])
                 continue
@@ -654,17 +667,27 @@ class _StreamGuard:
 
     def _resume_request(self) -> Optional[Context]:
         """Self-contained continuation request from delivered tokens, or
-        None when replay could diverge (no explicit seed)."""
+        None when replay could diverge (no seed known client-side)."""
         data = self._request.data if isinstance(self._request.data, dict) else None
         if data is None or self._all_tokens is None:
             return None
-        samp = data.get("sampling_options") or {}
+        samp = dict(data.get("sampling_options") or {})
         if samp.get("seed") is None:
-            # An engine-assigned default seed incorporates the worker's own
-            # engine seed — another instance may re-derive differently, so
-            # the continuation is not guaranteed token-identical.  Refuse.
-            return None
+            if self._resolved_seed is not None:
+                # The serving engine stamped its RESOLVED seed on the first
+                # stream item exactly for this moment (unseeded sampled
+                # requests, engine.py generate).
+                samp["seed"] = self._resolved_seed
+            elif (samp.get("temperature") or 0.0) > 0.0:
+                # Sampled with no seed known client-side: an engine-
+                # assigned default incorporates the worker's own engine
+                # seed — another instance may re-derive differently.
+                # Refuse, as before the resolved-seed stamp existed.
+                return None
+            # Greedy (temperature 0): argmax is seed-independent, so the
+            # continuation is deterministic on any worker — resume seedless.
         resume = dict(data)
+        resume["sampling_options"] = samp
         resume["token_ids"] = list(self._all_tokens)
         ann = dict(data.get("annotations") or {})
         prev = dict(ann.get("resume") or {})

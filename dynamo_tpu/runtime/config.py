@@ -105,6 +105,13 @@ class RuntimeConfig:
     # engine start.  CLI --lora* flags win per key; nested env works:
     # ``DYN_LORA__ENABLE=true``, ``DYN_LORA__MAX_ADAPTERS=8``.
     lora: Dict[str, Any] = field(default_factory=dict)
+    # QoS/overload-control section (llm/qos.py QosConfig keys at the edge:
+    # rate, burst, tenants, brownout{queue_high,kv_high,ttft_p95_ms,
+    # band_up,band_down,confirm_up,confirm_down,cooldown,max_tokens_cap},
+    # tick_s; engine/config.py QosSchedConfig keys for the scheduler:
+    # tenant_weights, default_weight, batch_every).  Nested env works:
+    # ``DYN_QOS__RATE=20``, ``DYN_QOS__BROWNOUT__QUEUE_HIGH=32``.
+    qos: Dict[str, Any] = field(default_factory=dict)
     extra: Dict[str, Any] = field(default_factory=dict)  # unrecognized keys
 
     @classmethod

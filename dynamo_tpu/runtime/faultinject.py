@@ -28,7 +28,16 @@ point              hooked in                                  simulates
                    (``delay_s`` sleep before each item)       outlier worker
 ``kv_pressure``    ``engine/scheduler`` free-block view       KV pool squeeze
                    (``delay_s`` = fraction withheld)          → preemptions
+``tenant_flood``   ``benchmarks/goodput.py`` trace driver     noisy neighbor:
+                   (``delay_s`` = rate multiplier; a seeded   one tenant
+                   flood trace replays over the fault's       floods the fleet
+                   scheduled window)
 =================  =========================================  ==============
+
+``tenant_flood`` is a *traffic* fault, not a transport one: the armed level
+is read by the overload-rung trace driver as the flooding tenant's rate
+multiplier, and the system under test is the QoS plane (scheduler WFQ,
+edge quotas — llm/qos.py), whose job is to keep the OTHER tenants whole.
 
 Arming: programmatic (``faults.arm("connect_error", match=addr, count=2)``)
 or env-driven for subprocess workers — ``DYN_FAULTS`` is a comma-separated

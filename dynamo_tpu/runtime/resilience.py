@@ -218,19 +218,38 @@ class AdmissionController:
 
     ``max_inflight=None`` disables admission control entirely (the default:
     zero behaviour change for embedded/test services).
+
+    QoS extensions (llm/qos.py):
+
+    - ``acquire(priority)`` — ``batch``-class requests may only occupy the
+      FRONT fraction of the wait queue (``batch_queue_frac``); the rest is
+      reserved headroom for interactive arrivals, so a batch burst cannot
+      queue interactive traffic out under pressure.
+    - ``estimate_retry_after`` — Retry-After computed from the measured
+      queue DRAIN RATE (recent slot releases per second) instead of a fixed
+      constant, so shed clients back off proportionally to real pressure.
     """
+
+    # Releases sampled for the drain-rate estimate (~the last few seconds
+    # of churn at any realistic service rate).
+    DRAIN_WINDOW = 64
 
     def __init__(
         self,
         max_inflight: Optional[int] = None,
         max_queue: int = 0,
         queue_timeout_s: float = 1.0,
+        batch_queue_frac: float = 0.5,
+        clock: Callable[[], float] = time.monotonic,
     ):
         self.max_inflight = max_inflight
         self.max_queue = max(0, max_queue)
         self.queue_timeout_s = queue_timeout_s
+        self.batch_queue_frac = min(max(batch_queue_frac, 0.0), 1.0)
+        self._clock = clock
         self._inflight = 0
         self._waiters: deque = deque()  # FIFO of futures awaiting a slot
+        self._releases: deque = deque(maxlen=self.DRAIN_WINDOW)
 
     @property
     def inflight(self) -> int:
@@ -240,18 +259,49 @@ class AdmissionController:
     def queued(self) -> int:
         return len(self._waiters)
 
-    def _retry_after(self) -> float:
-        # Crude but honest: the wait budget is the best available estimate of
-        # when a slot frees up.
-        return max(1.0, self.queue_timeout_s)
+    @property
+    def saturated(self) -> bool:
+        """Admission would queue (or shed) right now — the brownout
+        ladder's rung-4 'interactive overflow' predicate."""
+        return self.max_inflight is not None and self._inflight >= self.max_inflight
 
-    async def acquire(self) -> None:
+    def drain_rate(self) -> float:
+        """Recent slot releases per second (0.0 until enough samples)."""
+        if len(self._releases) < 2:
+            return 0.0
+        span = self._releases[-1] - self._releases[0]
+        if span <= 0:
+            return 0.0
+        return (len(self._releases) - 1) / span
+
+    def estimate_retry_after(self, ahead: Optional[int] = None) -> float:
+        """Seconds until roughly ``ahead`` queued requests drain (default:
+        the current queue plus one — where a new arrival would land).
+        Falls back to the wait budget before any drain history exists."""
+        ahead = len(self._waiters) + 1 if ahead is None else max(ahead, 1)
+        rate = self.drain_rate()
+        if rate <= 0:
+            return max(1.0, self.queue_timeout_s)
+        return min(max(ahead / rate, 0.05), 60.0)
+
+    def _retry_after(self) -> float:
+        return self.estimate_retry_after()
+
+    async def acquire(self, priority: str = "interactive") -> None:
         if self.max_inflight is None:
             return
         if self._inflight < self.max_inflight:
             self._inflight += 1
             return
-        if len(self._waiters) >= self.max_queue:
+        # Queue reservation: batch requests only occupy the front
+        # batch_queue_frac of the wait queue; the remainder stays free for
+        # interactive arrivals (protected admission under pressure).
+        limit = (
+            int(self.max_queue * self.batch_queue_frac)
+            if priority == "batch"
+            else self.max_queue
+        )
+        if len(self._waiters) >= limit:
             metrics.admission_shed["429"] = metrics.admission_shed.get("429", 0) + 1
             raise AdmissionRejected(
                 429, "server overloaded (admission queue full)", self._retry_after()
@@ -282,6 +332,7 @@ class AdmissionController:
     def release(self) -> None:
         if self.max_inflight is None:
             return
+        self._releases.append(self._clock())
         while self._waiters:
             fut = self._waiters.popleft()
             if not fut.done():
@@ -303,6 +354,7 @@ class AdmissionController:
             max_inflight=int(raw) if raw not in (None, "", 0) else None,
             max_queue=int(cfg.get("http_admission_queue", 0)),
             queue_timeout_s=float(cfg.get("http_admission_timeout_s", 1.0)),
+            batch_queue_frac=float(cfg.get("http_batch_queue_frac", 0.5)),
         )
 
 

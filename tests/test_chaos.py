@@ -14,8 +14,10 @@ Covers the three tentpole surfaces and their satellites:
   de-advertise race), grammar hash-first wire protocol with miss fallback;
 - the heavy acceptance tests (real engines; marked ``slow``, run by the
   ci.sh chaos step): hub kill/restart + worker crash mid-stream with the
-  seeded resume token-identical to the control, and chaos-ladder rung
-  determinism (same seed ⇒ same deterministic goodput report core).
+  seeded resume token-identical to the control, the UNSEEDED mid-stream
+  crash resume gate (ISSUE 8 server-resolved seeds; see tests/test_qos.py
+  for the rest of the QoS plane), and chaos-ladder rung determinism
+  (same seed ⇒ same deterministic goodput report core).
 """
 
 import asyncio
@@ -590,6 +592,111 @@ async def test_hub_kill_and_worker_crash_midstream_seeded_resume(tmp_path):
             await asyncio.sleep(0.1)
         assert registered, "no worker re-registered after hub restart"
         assert res_metrics.hub_reconnects_total > before_rc
+    finally:
+        await fleet.close()
+        for e in chaos_engines:
+            await e.close()
+
+
+@pytest.mark.slow
+async def test_unseeded_midstream_crash_resume_token_identical(tmp_path):
+    """ISSUE 8 standing gate: a mid-stream worker crash on an UNSEEDED
+    request splices token-identically to its control.  The engine resolves
+    the seed at admission (from the fixed request id) and stamps it on the
+    first stream item; the routed client's _StreamGuard captures it and
+    builds the byte-identical resume request — closing the PR 5 gap where
+    only explicit-seed streams survived mid-stream crashes."""
+    from benchmarks.goodput import ChaosFleet, _request_dict
+
+    chaos_engines = await _build_engines(2)
+    req = _request_dict(7, isl=12, osl=10, seed=31)
+    req["sampling_options"]["seed"] = None  # UNSEEDED: temp 0.8, no seed
+    rid = "unseeded-gate-7"
+    # Control on a warm engine with the SAME request id: the engine derives
+    # its default seed from (request id, engine seed), both shared across
+    # the fleet's identically-configured engines.
+    control = [
+        t
+        for item in await collect(
+            await chaos_engines[0].generate(Context.with_id(dict(req), rid))
+        )
+        for t in item.get("token_ids", ())
+    ]
+    assert len(control) == 10
+
+    fleet = await ChaosFleet(
+        chaos_engines, str(tmp_path / "hub.json"), watchdog=False
+    ).start()
+    before_sr = res_metrics.stream_resumes_total
+    try:
+        stream = await fleet.client.generate(Context.with_id(dict(req), rid))
+        tokens = []
+        crashed = False
+        async for item in stream:
+            assert "resolved_seed" not in item, "stamp must not reach callers"
+            tokens.extend(item.get("token_ids", ()))
+            if not crashed and len(tokens) >= 3:
+                crashed = True
+                serving = next(
+                    w for w in fleet.workers
+                    if w.engine.live_request_ids()
+                )
+                server = await serving.runtime.service_server()
+                server.crash()
+        assert tokens == control, "unseeded resume diverged from control"
+        assert res_metrics.stream_resumes_total > before_sr
+    finally:
+        await fleet.close()
+        for e in chaos_engines:
+            await e.close()
+
+
+@pytest.mark.slow
+async def test_respawn_rebalance_splices_live_sequence(tmp_path):
+    """The L5 rebalance half (ROADMAP carry-over): after a crashed worker
+    rejoins, ``ChaosFleet._rebalance_to`` migrates a LIVE sequence from the
+    busiest survivor onto the rejoined worker and the client sees one
+    uninterrupted, token-identical stream across the splice.  (The
+    supervisor-respawn half is gated by the ladder's L5 ``--check`` —
+    respawns >= 1 — in ci.sh; here the rebalance is driven directly so
+    the donor is deterministically mid-stream.)"""
+    from benchmarks.goodput import ChaosFleet, _request_dict
+
+    chaos_engines = await _build_engines(2)
+    req = _request_dict(11, isl=10, osl=200, seed=57)
+    rid = "l5-rebalance-11"
+    control = [
+        t
+        for item in await collect(
+            await chaos_engines[0].generate(Context.with_id(dict(req), rid))
+        )
+        for t in item.get("token_ids", ())
+    ]
+    assert len(control) == 200
+
+    fleet = await ChaosFleet(
+        chaos_engines, str(tmp_path / "hub.json"), watchdog=False
+    ).start()
+    before_splices = res_metrics.migration_splices_total
+    try:
+        stream = await fleet.client.generate(Context.with_id(dict(req), rid))
+        tokens: list = []
+        it = stream.__aiter__()
+        while len(tokens) < 3:  # stream live and flowing
+            tokens.extend((await it.__anext__()).get("token_ids", ()))
+        serving = next(
+            w for w in fleet.workers if w.engine.live_request_ids()
+        )
+        idle = next(w for w in fleet.workers if w is not serving)
+        # The respawn path's rebalance: the busiest survivor (the serving
+        # worker) donates its live sequence to the rejoined worker.
+        await fleet._rebalance_to(idle)
+        assert fleet.rebalanced == 1, "no sequence rebalanced onto rejoiner"
+        async for item in it:
+            tokens.extend(item.get("token_ids", ()))
+        assert tokens == control, "stream diverged across the rebalance"
+        assert res_metrics.migration_splices_total > before_splices
+        assert idle.engine.live_request_ids() == [], "target did not finish"
     finally:
         await fleet.close()
         for e in chaos_engines:

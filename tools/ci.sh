@@ -22,9 +22,15 @@ echo "== chaos suite (hub session resume + watchdog + ladder determinism) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q -m chaos \
   -p no:cacheprovider -p no:xdist -p no:randomly
 
-echo "== chaos ladder L0-L2 (seeded goodput smoke; 0 dropped streams bar) =="
-env JAX_PLATFORMS=cpu python benchmarks/goodput.py --levels 0,1,2 --seed 7 \
-  --duration 5 --rate 2.5 --check --json /tmp/_goodput_smoke.json
+echo "== qos suite (WFQ fairness + priority + brownout determinism) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_qos.py -q -m chaos \
+  -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "== chaos ladder L0-L2 + L5 respawn + L6 overload (seeded goodput"
+echo "   smoke; bars: 0 dropped, byte-identity incl. unseeded streams,"
+echo "   respawn on L5, non-flooding tenants >= 0.9x isolated on L6) =="
+env JAX_PLATFORMS=cpu python benchmarks/goodput.py --levels 0,1,2,5,6 \
+  --seed 7 --duration 5 --rate 2.5 --check --json /tmp/_goodput_smoke.json
 
 echo "== tier-1 tests =="
 set -o pipefail

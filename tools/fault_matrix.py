@@ -331,6 +331,39 @@ async def sweep_engine() -> list:
                         + f"drained to {len(items)} items after release",
             "status": "delayed TTFT, then 200",
         })
+
+        # tenant_flood → WFQ noisy-neighbor isolation (llm/qos.py): a
+        # flooding tenant's backlog must not push another tenant's request
+        # to the back of admission — the victim completes before the flood
+        # tail (FIFO would finish it strictly last).
+        faults.arm("tenant_flood", delay_s=3.0)
+        order: list = []
+
+        async def run_one(tenant: str, i: int) -> None:
+            r = dict(req, token_ids=list(range(50 + i * 29, 50 + i * 29 + 12)),
+                     annotations={"tenant": tenant})
+            await collect(await engine.generate(Context(r)))
+            order.append(tenant)
+
+        flood_tasks = [
+            asyncio.ensure_future(run_one("flood", i)) for i in range(5)
+        ]
+        await asyncio.sleep(0)  # flood enqueues first
+        victim = asyncio.ensure_future(run_one("victim", 7))
+        await asyncio.wait_for(
+            asyncio.gather(*flood_tasks, victim), 60.0
+        )
+        faults.reset()
+        victim_pos = order.index("victim")
+        rows.append({
+            "fault": "tenant_flood",
+            "injected_at": "trace driver (benchmarks/goodput.py L6 rung; "
+                           "armed level = flood rate multiplier)",
+            "observed": f"victim tenant finished at position {victim_pos} "
+                        f"of {len(order)} behind a 5-request flood backlog "
+                        "(WFQ admission; FIFO would finish it last)",
+            "status": "200 (fair shares)",
+        })
     finally:
         faults.reset()
         await engine.close()
