@@ -24,6 +24,7 @@ from typing import Any, Dict, Optional
 
 from aiohttp import web
 
+from ..labels import safe_key_component
 from .renderer import render
 
 logger = logging.getLogger(__name__)
@@ -104,6 +105,14 @@ class ApiStore:
             return web.json_response(
                 {"error": "missing deployment name"}, status=400
             )
+        try:
+            # Deployment names become hub-key components under PREFIX: a
+            # name containing '/', whitespace or control chars could
+            # escape the store's namespace and shadow another subsystem's
+            # keys (dynalint DYN203) — reject at the edge, k8s-style.
+            name = safe_key_component(name)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
         body.pop("name", None)
         cr = _as_cr(name, body)
         try:

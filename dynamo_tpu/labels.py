@@ -1,0 +1,86 @@
+"""Wire-value hygiene helpers: Prometheus label escaping, credential
+hashing, hub-key component validation.
+
+One module, importable from every layer (no dependencies beyond hashlib/re
+— the runtime transports and the llm edge both render ``/metrics`` text
+and build hub keys, and neither may import the other).  These are the
+sanitizers dynalint's DYN2xx taint rules recognize (tools/dynalint
+registry.py SANITIZER_TAILS): wire-controlled values — HTTP headers,
+``nvext`` fields, the OpenAI ``model`` field, hub-delivered metadata —
+must pass through one of them before reaching a label, a log line, or a
+hub key.
+
+PR 8 fixed each occurrence ad hoc (hash in ``resolve_tenant``, manual
+escaping in ``QosMetrics.render``); this centralizes the policy so every
+``/metrics`` family handles labels the same way and the linter can verify
+it mechanically.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+
+# Prometheus exposition label values escape exactly three characters:
+# backslash, double-quote, and newline (in that order — the escape
+# character must be escaped first).  NOT idempotent: escape exactly ONCE,
+# at the final render site, never in helpers that feed a render.
+_LABEL_ESCAPES = (("\\", r"\\"), ('"', r"\""), ("\n", r"\n"))
+
+# Hub key path components: conservative DNS-1123-adjacent charset.  No
+# separators — a component must not be able to escape its prefix — and no
+# whitespace/control characters that would corrupt line-oriented dumps.
+_KEY_COMPONENT_RE = re.compile(r"^[A-Za-z0-9]([A-Za-z0-9._-]{0,253})$")
+
+
+def escape_label(value: object) -> str:
+    """Prometheus-escape a label value (any type; always returns str).
+
+    For clean strings it is the identity, so internal values pass through
+    unharmed; the project rule is: EVERY interpolated label value goes
+    through here exactly ONCE, at the render site (dynalint DYN204
+    enforces presence; double-wrapping a pre-escaped value corrupts it —
+    helpers should hand RAW values to the render)."""
+    out = str(value)
+    for raw, esc in _LABEL_ESCAPES:
+        out = out.replace(raw, esc)
+    return out
+
+
+def hash_credential(secret: str, prefix: str = "key") -> str:
+    """Stable non-secret identity for a credential: ``key:<sha256[:12]>``.
+
+    Raw API keys / bearer tokens must never become tenant strings — tenant
+    ids reach logs, ``/metrics`` labels and scheduler annotations, none of
+    which may carry a secret.  The digest keys quota buckets and fairness
+    flows just as well, and 12 hex chars keep collision odds negligible at
+    fleet scale (2^48)."""
+    return f"{prefix}:{hashlib.sha256(secret.encode()).hexdigest()[:12]}"
+
+
+def bounded_label(value: str) -> str:
+    """Identity marker: the caller has JUST verified ``value`` against a
+    closed server-side set (e.g. the served-model registry), so it is not
+    a cardinality hazard.  No escaping happens here on purpose — this is
+    for ``prometheus_client`` ``.labels(...)`` sinks, where the client
+    library escapes at exposition and pre-escaping would double-escape
+    AND split the series against raw-labeled paths.  Registered as a
+    dynalint sanitizer: the call is the auditable claim of boundedness;
+    use ``escape_label`` instead for hand-rendered exposition text."""
+    return value
+
+
+def safe_key_component(value: str) -> str:
+    """Validate a wire-controlled string for use as ONE hub-key path
+    component.  Returns the value unchanged or raises ``ValueError`` —
+    callers map the error to their 400/reject path.
+
+    Hub keys are a shared namespace (``instances/…``, ``planner/…``,
+    ``health/quarantine/…``); a crafted id containing ``/`` or whitespace
+    could escape its prefix and shadow another subsystem's keys."""
+    if not isinstance(value, str) or not _KEY_COMPONENT_RE.match(value):
+        raise ValueError(
+            f"invalid key component {value!r}: must match "
+            "[A-Za-z0-9][A-Za-z0-9._-]{0,253}"
+        )
+    return value

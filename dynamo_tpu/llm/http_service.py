@@ -22,6 +22,7 @@ from typing import Any, Dict, Optional
 
 from aiohttp import web
 
+from ..labels import bounded_label
 from ..runtime.client import NoInstancesError, RemoteEngineError
 from ..runtime.engine import AsyncEngine, Context
 from ..runtime.resilience import (
@@ -274,8 +275,20 @@ class HttpService:
             self.models.chat_engine(model) if chat else self.models.completion_engine(model)
         )
         if engine is None:
-            self.metrics.requests_total.labels(model, endpoint, "stream", Status.REJECTED).inc()
+            # Label with a CONSTANT, not the wire string: every junk model
+            # name would otherwise mint a fresh label value — an unbounded-
+            # cardinality bomb on requests that cost us nothing else
+            # (dynalint DYN201).  The 404 body still names the model.
+            self.metrics.requests_total.labels(
+                "unknown", endpoint, "stream", Status.REJECTED
+            ).inc()
             return _model_not_found(model)
+        # Past the served-model check the name is bounded (it resolved to
+        # an engine) — not a cardinality hazard.  bounded_label is the
+        # auditable identity marker: prometheus_client escapes at
+        # exposition itself, so pre-escaping here would double-escape AND
+        # split the rejected series from the success path's raw labels.
+        model_label = bounded_label(model)
 
         # QoS (llm/qos.py): resolve tenant + priority, charge the tenant's
         # quota, apply the brownout rung — all BEFORE a slot is consumed.
@@ -294,7 +307,7 @@ class HttpService:
                 qos_metrics.interactive_shed_total += 1
                 qos_metrics.shed_tenant(tenant)
                 self.metrics.requests_total.labels(
-                    model, endpoint, "stream", Status.REJECTED
+                    model_label, endpoint, "stream", Status.REJECTED
                 ).inc()
                 return _error_response(
                     503,
@@ -312,7 +325,7 @@ class HttpService:
                     qos_metrics.batch_shed_total += 1
                 qos_metrics.shed_tenant(tenant)
                 self.metrics.requests_total.labels(
-                    model, endpoint, "stream", Status.REJECTED
+                    model_label, endpoint, "stream", Status.REJECTED
                 ).inc()
                 return _error_response(
                     e.status, e.message, retry_after_s=e.retry_after_s
@@ -358,7 +371,7 @@ class HttpService:
                 # before consuming any capacity — credit it back.
                 self.qos.quotas.refund(tenant)
             self.metrics.requests_total.labels(
-                model, endpoint, "stream", Status.REJECTED
+                model_label, endpoint, "stream", Status.REJECTED
             ).inc()
             # The drain-rate estimate says when a slot frees; a deepening
             # brownout says the estimate is optimistic — back clients off

@@ -22,6 +22,8 @@ from prometheus_client import (
     generate_latest,
 )
 
+from ..labels import escape_label
+
 REQUEST_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 TOKEN_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
 
@@ -510,12 +512,8 @@ class QosMetrics:
             # Tenant ids come off the wire (x-tenant header): escape the
             # Prometheus label syntax so a crafted id cannot inject rows
             # into the exposition.  (Credential-sourced ids are already
-            # hashed at resolution — llm/qos.py _credential_tenant.)
-            safe = (
-                tenant.replace("\\", r"\\")
-                .replace('"', r"\"")
-                .replace("\n", r"\n")
-            )
+            # hashed at resolution — llm/qos.py resolve_tenant.)
+            safe = escape_label(tenant)
             lines.append(f'{ns}_shed_by_tenant_total{{tenant="{safe}"}} {n}')
         return "\n".join(lines) + "\n"
 

@@ -24,6 +24,7 @@ from ..llm.kv_router.publisher import (
     unpack_message,
 )
 from ..llm.kv_router.scheduler import KV_HIT_RATE_SUBJECT
+from ..labels import escape_label
 from ..planner.signals import StalenessTracker, classify_instance
 from ..runtime.component import INSTANCE_PREFIX
 
@@ -143,7 +144,7 @@ class MetricsAggregatorService:
             lines.append(f"# HELP {name} {help_}")
             lines.append(f"# TYPE {name} gauge")
             for wid, m in self._metrics.items():
-                lines.append(f'{name}{{worker_id="{wid}"}} {per_worker(m)}')
+                lines.append(f'{name}{{worker_id="{escape_label(wid)}"}} {per_worker(m)}')
 
         gauge("dynamo_tpu_worker_active_slots", "Active request slots",
               lambda m: m.request_active_slots)

@@ -77,7 +77,12 @@ class SequenceSnapshot:
         return len(self.token_ids) - self.orig_prompt_len
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        # Optional fields ship omit-when-absent (from_dict tolerates the
+        # missing keys): base traffic's snapshots keep the pre-tenancy wire
+        # shape, and consumers that predate a field never see it — the
+        # same wire-compat contract as PreprocessedRequest.grammar
+        # (dynalint DYN302 enforces it for every new optional field).
+        out = {
             "version": self.version,
             "request_id": self.request_id,
             "token_ids": list(self.token_ids),
@@ -85,14 +90,22 @@ class SequenceSnapshot:
             "sampling": dict(self.sampling),
             "stop": dict(self.stop),
             "spec": dict(self.spec),
-            "deadline_s": self.deadline_s,
-            "detok": self.detok,
-            "adapter": self.adapter,
-            "kv_salt": self.kv_salt,
-            "tenant": self.tenant,
-            "priority": self.priority,
-            "grammar": self.grammar,
         }
+        if self.deadline_s is not None:
+            out["deadline_s"] = self.deadline_s
+        if self.detok is not None:
+            out["detok"] = self.detok
+        if self.adapter is not None:
+            out["adapter"] = self.adapter
+        if self.kv_salt is not None:
+            out["kv_salt"] = self.kv_salt
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
+        if self.priority is not None:
+            out["priority"] = self.priority
+        if self.grammar is not None:
+            out["grammar"] = self.grammar
+        return out
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "SequenceSnapshot":

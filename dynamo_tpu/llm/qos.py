@@ -70,10 +70,11 @@ def _credential_tenant(secret: str) -> str:
     """Stable non-secret tenant id for a credential: the raw API key /
     bearer token must never become the tenant string — tenant ids reach
     logs, /metrics labels and scheduler annotations, none of which may
-    carry a secret.  The digest keys buckets/fairness just as well."""
-    import hashlib
+    carry a secret.  One shared derivation (dynamo_tpu.labels) so every
+    layer agrees on the digest."""
+    from ..labels import hash_credential
 
-    return "key:" + hashlib.sha256(secret.encode()).hexdigest()[:12]
+    return hash_credential(secret)
 
 
 def resolve_tenant(headers: Mapping[str, str], body: Mapping[str, Any]) -> str:

@@ -11,6 +11,8 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Optional
 
+from ..labels import escape_label
+
 
 class PlannerMetrics:
     def __init__(self):
@@ -48,7 +50,7 @@ class PlannerMetrics:
         lines.append(f"{ns}_ticks_total {self.ticks_total}")
         emit("decisions_total", "Decisions by action kind", "counter")
         for kind, n in sorted(self.decisions_total.items()):
-            lines.append(f'{ns}_decisions_total{{kind="{kind}"}} {n}')
+            lines.append(f'{ns}_decisions_total{{kind="{escape_label(kind)}"}} {n}')
         emit("actuations_total", "Actuator calls issued", "counter")
         lines.append(f"{ns}_actuations_total {self.actuations_total}")
         emit(
@@ -61,10 +63,10 @@ class PlannerMetrics:
         )
         emit("pool_target", "Most recent per-pool replica target", "gauge")
         for pool, target in sorted(self.pool_targets.items()):
-            lines.append(f'{ns}_pool_target{{pool="{pool}"}} {target}')
+            lines.append(f'{ns}_pool_target{{pool="{escape_label(pool)}"}} {target}')
         emit("pressure", "Per-pool pressure ratio (1.0 = at SLO)", "gauge")
         for pool, p in sorted(self.pressures.items()):
-            lines.append(f'{ns}_pressure{{pool="{pool}"}} {p:.4f}')
+            lines.append(f'{ns}_pressure{{pool="{escape_label(pool)}"}} {p:.4f}')
         return "\n".join(lines) + "\n"
 
     def state(self) -> Dict[str, Any]:

@@ -145,7 +145,15 @@ class DistributedRuntime:
 
     async def service_server(self) -> ServiceServer:
         if self._service_server is None:
-            self._service_server = await ServiceServer(host=self._host).start()
+            server = await ServiceServer(host=self._host).start()
+            if self._service_server is None:  # re-check: bind awaited above
+                self._service_server = server
+            else:
+                # Lost a concurrent lazy-init race while awaiting the bind
+                # (dynalint DYN101): endpoints registered on the duplicate
+                # would be invisible to the advertised address — keep the
+                # winner, close the spare.
+                await server.close()
         return self._service_server
 
     def namespace(self, name: str) -> "Namespace":

@@ -38,6 +38,8 @@ import logging
 import time
 from collections import deque
 from dataclasses import dataclass, field
+
+from ..labels import escape_label
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Set
 
 logger = logging.getLogger(__name__)
@@ -152,9 +154,16 @@ async def probe_address(address: str, timeout_s: float = 1.0) -> bool:
             stream = await RemoteEngine(address, HEALTH_ENDPOINT).generate(
                 Context({})
             )
-            async for item in stream:
-                return bool(item.get("ok")) and int(item.get("endpoints", 0)) > 0
-            return False
+            try:
+                async for item in stream:
+                    return bool(item.get("ok")) and int(item.get("endpoints", 0)) > 0
+                return False
+            finally:
+                # `async for` does not aclose() on early return: without
+                # this, every SUCCESSFUL probe leaked its mux stream slot
+                # and a pending forward_cancel task — one per probe tick,
+                # forever (caught by the suite-wide orphan-task detector).
+                await stream.aclose()
 
         return await asyncio.wait_for(_roundtrip(), timeout_s)
     except asyncio.CancelledError:
@@ -266,7 +275,7 @@ class HealthMetrics:
         lines.append(f"# TYPE {ns}_workers gauge")
         for state in ("healthy", "quarantined", "ejected"):
             lines.append(
-                f'{ns}_workers{{state="{state}"}} '
+                f'{ns}_workers{{state="{escape_label(state)}"}} '
                 f"{self.state_counts.get(state, 0)}"
             )
         return "\n".join(lines) + "\n"

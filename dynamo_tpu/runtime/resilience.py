@@ -37,6 +37,8 @@ import random
 import time
 from collections import deque
 from dataclasses import dataclass
+
+from ..labels import escape_label
 from typing import Any, Callable, Dict, Mapping, Optional, Tuple
 
 
@@ -451,20 +453,22 @@ class ResilienceMetrics:
         lines.append(f"# HELP {ns}_admission_shed_total Requests shed at admission")
         lines.append(f"# TYPE {ns}_admission_shed_total counter")
         for code, n in sorted(self.admission_shed.items()):
-            lines.append(f'{ns}_admission_shed_total{{status="{code}"}} {n}')
+            lines.append(f'{ns}_admission_shed_total{{status="{escape_label(code)}"}} {n}')
         # Breaker state gauge: 0=closed 1=half_open 2=open
         state_code = {"closed": 0, "half_open": 1, "open": 2}
         lines.append(f"# HELP {ns}_breaker_state Circuit state (0=closed 1=half-open 2=open)")
         lines.append(f"# TYPE {ns}_breaker_state gauge")
         for key, b in sorted(self._breakers.items()):
             lines.append(
-                f'{ns}_breaker_state{{worker="{key}"}} {state_code[b.state.value]}'
+                f'{ns}_breaker_state{{worker="{escape_label(key)}"}} '
+                f"{state_code[b.state.value]}"
             )
         lines.append(f"# HELP {ns}_breaker_transitions_total Breaker state transitions")
         lines.append(f"# TYPE {ns}_breaker_transitions_total counter")
         for (key, state), n in sorted(self.breaker_transitions.items()):
             lines.append(
-                f'{ns}_breaker_transitions_total{{worker="{key}",to="{state}"}} {n}'
+                f'{ns}_breaker_transitions_total{{worker="{escape_label(key)}",'
+                f'to="{escape_label(state)}"}} {n}'
             )
         return "\n".join(lines) + "\n"
 

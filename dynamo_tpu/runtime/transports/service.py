@@ -91,8 +91,15 @@ class ServiceServer:
 
     async def start(self) -> "ServiceServer":
         if self._server is None:
-            self._server = await asyncio.start_server(self._handle, self.host, self.port)
-            self.port = self._server.sockets[0].getsockname()[1]
+            server = await asyncio.start_server(self._handle, self.host, self.port)
+            if self._server is not None:
+                # Lost a concurrent-start race while awaiting the bind
+                # (dynalint DYN101): the first starter owns the address;
+                # close the duplicate listener instead of leaking it.
+                server.close()
+            else:
+                self._server = server
+                self.port = server.sockets[0].getsockname()[1]
         return self
 
     def crash(self) -> None:

@@ -29,20 +29,70 @@ if jax.default_backend() != "cpu":
 
 
 def pytest_pyfunc_call(pyfuncitem):
-    """Minimal asyncio test support (pytest-asyncio is not in the image)."""
+    """Minimal asyncio test support (pytest-asyncio is not in the image),
+    plus the suite-wide ORPHAN-TASK DETECTOR — the dynamic companion to
+    dynalint DYN002: any async test that returns while asyncio tasks are
+    still pending fails, because those tasks are exactly the pump/handler
+    leaks the transports promise to reap on close().  ``asyncio.run``
+    silently cancels leftovers, which is how orphans used to hide until a
+    hand-written assertion (test_hub / test_distributed) happened to look.
+
+    Intentional leaks (a test asserting crash behaviour mid-teardown) opt
+    out with ``@pytest.mark.allow_orphan_tasks``.
+    """
     fn = pyfuncitem.obj
-    if inspect.iscoroutinefunction(fn):
-        kwargs = {
-            name: pyfuncitem.funcargs[name]
-            for name in pyfuncitem._fixtureinfo.argnames
-        }
-        asyncio.run(fn(**kwargs))
-        return True
-    return None
+    if not inspect.iscoroutinefunction(fn):
+        return None
+    kwargs = {
+        name: pyfuncitem.funcargs[name]
+        for name in pyfuncitem._fixtureinfo.argnames
+    }
+    allow = pyfuncitem.get_closest_marker("allow_orphan_tasks") is not None
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    orphans = []
+    try:
+        loop.run_until_complete(fn(**kwargs))
+        # Grace ticks: let tasks the test just cancelled actually finish
+        # (the same 3-tick settle the old hand-written assertions used).
+        for _ in range(3):
+            loop.run_until_complete(asyncio.sleep(0))
+        orphans = [
+            getattr(t.get_coro(), "__qualname__", repr(t))
+            for t in asyncio.all_tasks(loop)
+            if not t.done()
+        ]
+    finally:
+        # asyncio.run-equivalent teardown: cancel leftovers, drain, close.
+        pending = [t for t in asyncio.all_tasks(loop) if not t.done()]
+        for t in pending:
+            t.cancel()
+        if pending:
+            loop.run_until_complete(
+                asyncio.gather(*pending, return_exceptions=True)
+            )
+        loop.run_until_complete(loop.shutdown_asyncgens())
+        loop.close()
+        asyncio.set_event_loop(None)
+    if orphans and not allow:
+        import pytest as _pytest
+
+        _pytest.fail(
+            f"test left {len(orphans)} pending asyncio task(s) at teardown "
+            f"(DYN002's dynamic contract — close() must reap every spawned "
+            f"task): {sorted(orphans)}",
+            pytrace=False,
+        )
+    return True
 
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: asynchronous test")
+    config.addinivalue_line(
+        "markers",
+        "allow_orphan_tasks: this test intentionally leaves pending asyncio "
+        "tasks at teardown (exempt from the suite-wide orphan detector)",
+    )
 
 
 @pytest.fixture(scope="session")

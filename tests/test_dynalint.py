@@ -547,3 +547,335 @@ def test_gate_paths_cover_whole_package():
     seen = {f for f in (REPO_ROOT / "dynamo_tpu").rglob("*.py")
             if "__pycache__" not in f.parts}
     assert len(seen) > 60  # 80+ modules today; fail loudly if scope collapses
+
+
+# ======================================================================
+# dynalint 2.0 — DYN1xx async-race, DYN2xx taint, DYN3xx wire-schema
+# ======================================================================
+
+import re
+
+FIXTURE_DIR = REPO_ROOT / "tools" / "dynalint" / "fixtures"
+FAMILY_RULES = {
+    "1": {"DYN101", "DYN102"},
+    "2": {"DYN201", "DYN202", "DYN203", "DYN204"},
+    "3": {"DYN301", "DYN302", "DYN303", "DYN304", "DYN305", "DYN306"},
+}
+
+
+def _fixture_cases():
+    for f in sorted(FIXTURE_DIR.glob("*.py")):
+        src = f.read_text()
+        m = re.search(r"dynalint-fixture:\s*expect=(\S+)", src)
+        assert m, f"{f} lacks a dynalint-fixture header"
+        expect = m.group(1)
+        if expect != "none":
+            rules = FAMILY_RULES[expect[3]]
+        else:
+            rules = FAMILY_RULES[re.match(r"dyn(\d)", f.name).group(1)]
+        yield f.name, src, expect, rules
+
+
+def test_fixture_corpus():
+    """Every offending/clean/suppressed fixture — including the five
+    historical-bug fixtures minimized from CHANGES.md PR 6/7/8 review
+    findings — behaves exactly as its header declares."""
+    names = set()
+    for name, src, expect, rules in _fixture_cases():
+        names.add(name)
+        found = analyze_sources([(name, src)], rules=rules)
+        got = sorted({f.rule for f in found})
+        want = [] if expect == "none" else [expect]
+        assert got == want, f"{name}: expected {want}, got {got}\n" + "\n".join(
+            f"  {f.rule} {f.line}: {f.message}" for f in found
+        )
+    # every new family ships offending+clean+suppressed AND >=1 historical
+    for fam in ("1", "2", "3"):
+        assert any(n.startswith(f"dyn{fam}") and "offending" in n for n in names)
+        assert any(n.startswith(f"dyn{fam}") and "clean" in n for n in names)
+        assert any(n.startswith(f"dyn{fam}") and "suppressed" in n for n in names)
+    hist = {n for n in names if n.startswith("hist_")}
+    assert len(hist) >= 3
+    hist_rules = {
+        expect for n, _s, expect, _r in _fixture_cases() if n.startswith("hist_")
+    }
+    assert {r[3] for r in hist_rules} == {"1", "2", "3"}  # one per family
+
+
+# ---------------------------------------------------------------- DYN101
+
+
+def test_dyn101_aug_assign_without_await_clean():
+    # x += 1 is atomic in asyncio (no suspension inside one statement).
+    src = (
+        "class C:\n"
+        "    async def f(self):\n"
+        "        self.n += 1\n"
+        "        await self.flush()\n"
+    )
+    assert analyze_sources([("x.py", src)], rules={"DYN101"}) == []
+
+
+def test_dyn101_transitive_local_provenance():
+    src = (
+        "class C:\n"
+        "    async def f(self):\n"
+        "        a = self.count\n"
+        "        b = a + 1\n"
+        "        await self.flush()\n"
+        "        self.count = b\n"
+    )
+    found = analyze_sources([("x.py", src)], rules={"DYN101"})
+    assert [f.rule for f in found] == ["DYN101"]
+
+
+def test_dyn101_sync_function_out_of_scope():
+    # The REAL WfqQueue.remove is synchronous: no suspension, no race.
+    src = (
+        "class C:\n"
+        "    def remove(self, seq):\n"
+        "        vt = self._vt\n"
+        "        self._vt = max(vt, seq.vft)\n"
+    )
+    assert analyze_sources([("x.py", src)], rules={"DYN101"}) == []
+
+
+def test_dyn101_global_state():
+    src = (
+        "V = 0\n"
+        "async def f(hub):\n"
+        "    global V\n"
+        "    v = V\n"
+        "    await hub.publish('x', 1)\n"
+        "    V = v + 1\n"
+    )
+    found = analyze_sources([("x.py", src)], rules={"DYN101"})
+    assert [f.rule for f in found] == ["DYN101"]
+
+
+# ---------------------------------------------------------------- DYN102
+
+
+def test_dyn102_cross_function_protocol_out_of_scope():
+    # acquire here, release in another method: a deliberate protocol
+    # (AdmissionController) — same-function releases only.
+    src = (
+        "class C:\n"
+        "    async def begin(self):\n"
+        "        await self._sem.acquire()\n"
+        "    def end(self):\n"
+        "        self._sem.release()\n"
+    )
+    assert analyze_sources([("x.py", src)], rules={"DYN102"}) == []
+
+
+# ---------------------------------------------------------------- DYN2xx
+
+
+def test_dyn201_interprocedural_summary_two_hops():
+    # taint threads resolver -> helper -> sink across three functions
+    src = (
+        "def resolve(body):\n"
+        "    return body.get('tenant')\n"
+        "def describe(t):\n"
+        "    return 'tenant=' + t\n"
+        "def render(body, lines):\n"
+        "    label = describe(resolve(body))\n"
+        "    lines.append(f'shed_total{{tenant=\"{label}\"}} 1')\n"
+    )
+    found = analyze_sources([("x.py", src)], rules={"DYN201"})
+    assert [f.rule for f in found] == ["DYN201"]
+
+
+def test_dyn201_sanitizer_kills_taint_through_summary():
+    src = (
+        "def resolve(body, escape_label):\n"
+        "    return escape_label(body.get('tenant'))\n"
+        "def render(body, lines, escape_label):\n"
+        "    t = resolve(body, escape_label)\n"
+        "    lines.append(f'shed_total{{tenant=\"{t}\"}} 1')\n"
+    )
+    found = analyze_sources([("x.py", src)], rules={"DYN201", "DYN204"})
+    assert found == []
+
+
+def test_dyn202_non_credential_wire_in_logs_clean():
+    # model names in logs are fine; only credentials are findings
+    src = (
+        "def f(body, logger):\n"
+        "    m = body.get('model')\n"
+        "    logger.info(f'serving {m}')\n"
+    )
+    assert analyze_sources([("x.py", src)], rules={"DYN202"}) == []
+
+
+def test_dyn204_format_spec_is_numeric_safe():
+    src = (
+        "def render(lines, p):\n"
+        "    lines.append(f'pressure{{pool=\"{p:.4f}\"}} 1')\n"
+    )
+    assert analyze_sources([("x.py", src)], rules={"DYN204"}) == []
+
+
+# ---------------------------------------------------------------- DYN3xx
+
+
+def test_dyn301_dynamic_from_dict_stands_down():
+    src = (
+        "from dataclasses import dataclass\n"
+        "@dataclass\n"
+        "class M:\n"
+        "    a: int = 0\n"
+        "    b: int = 0\n"
+        "    def to_dict(self):\n"
+        "        return {'a': self.a, 'b': self.b}\n"
+        "    @classmethod\n"
+        "    def from_dict(cls, d):\n"
+        "        return cls(**{k: d.get(k, 0) for k in ('a', 'b')})\n"
+    )
+    assert analyze_sources([("x.py", src)], rules={"DYN301"}) == []
+
+
+def test_dyn304_registry_consistency_against_real_tree():
+    """The committed SNAPSHOT_COVERED/EXEMPT registries exactly tile the
+    real SequenceState, and every mapping lands on a real SequenceSnapshot
+    field — the self-run stays clean AND the registry cannot rot."""
+    findings = analyze_paths(["dynamo_tpu"], root=REPO_ROOT, rules={"DYN304"})
+    assert findings == [], "\n".join(f.message for f in findings)
+
+
+def test_dyn306_against_real_pytree_classes():
+    findings = analyze_paths(
+        ["dynamo_tpu/ops/sampling.py", "dynamo_tpu/models/llama.py"],
+        root=REPO_ROOT,
+        rules={"DYN306"},
+    )
+    assert findings == []
+
+
+# ------------------------------------------------- timings + changed-only
+
+
+def test_timings_out_param():
+    timings = {}
+    analyze_sources([("x.py", "def f():\n    pass\n")], timings=timings)
+    assert "total" in timings and "DYN001-007" in timings
+    assert timings["total"] >= 0
+
+
+def test_changed_only_reverse_closure():
+    from tools.dynalint.core import reverse_dependency_closure
+
+    sources = [
+        ("pkg/base.py", "def helper_fn():\n    return 1\n"),
+        ("pkg/imports_base.py", "from pkg.base import helper_fn\n"),
+        ("pkg/calls_base.py", "def g():\n    return helper_fn()\n"),
+        ("pkg/unrelated.py", "def h():\n    return 2\n"),
+    ]
+    closure = reverse_dependency_closure(sources, {"pkg/base.py"})
+    assert closure == {"pkg/base.py", "pkg/imports_base.py", "pkg/calls_base.py"}
+
+
+def test_changed_only_keeps_dyn000_for_unparseable_changed_file():
+    # A changed file with a syntax error is not in the corpus graph, but a
+    # pre-commit run that reports "clean" on it checks nothing — the
+    # DYN000 finding must survive the scope filter.
+    found = analyze_sources(
+        [("bad.py", "def f(:\n"), ("ok.py", "x = 1\n")],
+        changed_paths={"bad.py"},
+    )
+    assert [f.rule for f in found] == ["DYN000"]
+
+
+def test_changed_only_closure_covers_package_init_importers():
+    # `from .config import C` in pkg/__init__.py resolves against the
+    # PACKAGE, not its parent — the closure must pull the __init__ in.
+    import ast as _ast
+
+    from tools.dynalint.callgraph import CorpusGraph
+
+    srcs = [
+        ("pkg/__init__.py", "from .config import C\n"),
+        ("pkg/config.py", "C = 1\n"),
+        ("pkg/other.py", "y = 2\n"),
+    ]
+    graph = CorpusGraph.build([(p, s, _ast.parse(s)) for p, s in srcs])
+    assert graph.dependents({"pkg/config.py"}) == {
+        "pkg/config.py",
+        "pkg/__init__.py",
+    }
+
+
+def test_changed_only_scopes_findings():
+    # the offending file is NOT in the changed set -> no findings reported,
+    # but the corpus still indexed (the changed file alone is clean)
+    offending = "import time\nasync def f():\n    time.sleep(1)\n"
+    clean = "def g():\n    return 1\n"
+    found = analyze_sources(
+        [("bad.py", offending), ("ok.py", clean)],
+        rules={"DYN001"},
+        only_paths={"ok.py"},
+    )
+    assert found == []
+    found = analyze_sources(
+        [("bad.py", offending), ("ok.py", clean)],
+        rules={"DYN001"},
+        only_paths={"bad.py"},
+    )
+    assert [f.rule for f in found] == ["DYN001"]
+
+
+def test_cli_changed_only_against_head(tmp_path):
+    """End-to-end: --changed-only runs git, reports only the changed
+    slice, and still exits by the same contract."""
+    from tools.dynalint.__main__ import main
+
+    # a ref that exists in this repo; the tree may or may not have changes,
+    # but the run must complete with exit 0 (no new findings in the slice
+    # — the full self-run gate already asserts the tree is clean).
+    empty_baseline = tmp_path / "bl.json"
+    rc = main(
+        ["dynamo_tpu", "--changed-only", "HEAD", "--baseline", str(empty_baseline)]
+    )
+    assert rc == 0
+    # A baseline written from a changed-file slice would silently drop
+    # grandfathered findings in untouched files: the flags are exclusive.
+    assert (
+        main(
+            [
+                "dynamo_tpu",
+                "--changed-only",
+                "--write-baseline",
+                "--baseline",
+                str(empty_baseline),
+            ]
+        )
+        == 2
+    )
+    assert not empty_baseline.exists()
+
+
+# ------------------------------------------------------------ gate v2
+
+
+def test_gate_new_families_have_empty_baseline():
+    """ISSUE 9 discipline: every DYN1xx/2xx/3xx true positive was fixed
+    in-PR; the committed baseline must hold ZERO entries for the new
+    families (and stay within the global 10-entry debt cap)."""
+    baseline = load_baseline(DEFAULT_BASELINE)
+    new_family = [
+        e
+        for e in baseline.values()
+        if e.get("rule", "").startswith(("DYN1", "DYN2", "DYN3"))
+    ]
+    assert new_family == []
+
+
+def test_fixture_dir_not_in_gate_scope():
+    """The self-run gate covers dynamo_tpu/ only — fixtures are test data
+    and must never be able to poison the gate (path-level check: the
+    collector never sees tools/, so no analysis run is needed)."""
+    from tools.dynalint.core import collect_files
+
+    files = collect_files(["dynamo_tpu"], REPO_ROOT)
+    assert files and not any("fixtures" in f.parts for f in files)

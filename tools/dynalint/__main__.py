@@ -57,6 +57,23 @@ def main(argv=None) -> int:
         "--list-rules", action="store_true", help="print the rule catalog"
     )
     ap.add_argument(
+        "--changed-only",
+        nargs="?",
+        const="HEAD",
+        default=None,
+        metavar="REF",
+        help="analyze only files changed vs REF (default HEAD) plus their "
+        "one-hop reverse dependencies — the fast pre-commit mode, ~2s on "
+        "a one-file change vs ~5s full (the whole corpus still feeds "
+        "indexing and taint summaries; incompatible with "
+        "--write-baseline)",
+    )
+    ap.add_argument(
+        "--timings",
+        action="store_true",
+        help="print per-pass wall time (always included in --json)",
+    )
+    ap.add_argument(
         "-v", "--verbose", action="store_true", help="also list baselined"
     )
     args = ap.parse_args(argv)
@@ -64,6 +81,17 @@ def main(argv=None) -> int:
     if args.list_rules:
         print(render_rules())
         return 0
+
+    if args.write_baseline and args.changed_only is not None:
+        # A baseline written from a changed-file slice silently DROPS every
+        # grandfathered finding in untouched files — they would all
+        # resurface as gate-failing "new" findings on the next full run.
+        print(
+            "dynalint: --write-baseline requires a full-scope run; "
+            "drop --changed-only",
+            file=sys.stderr,
+        )
+        return 2
 
     rules = None
     if args.rules:
@@ -76,9 +104,19 @@ def main(argv=None) -> int:
     # Anchor relative paths at the repo root (parent of tools/) so the tool
     # behaves the same from any cwd — fingerprints embed relative paths.
     root = Path(__file__).resolve().parents[2]
+    timings: dict = {}
     try:
-        findings = analyze_paths(args.paths, root=root, rules=rules)
+        findings = analyze_paths(
+            args.paths,
+            root=root,
+            rules=rules,
+            timings=timings,
+            changed_only=args.changed_only,
+        )
     except FileNotFoundError as e:
+        print(f"dynalint: {e}", file=sys.stderr)
+        return 2
+    except RuntimeError as e:  # git failure in --changed-only
         print(f"dynalint: {e}", file=sys.stderr)
         return 2
 
@@ -89,7 +127,15 @@ def main(argv=None) -> int:
 
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
     new, old = split_by_baseline(findings, baseline)
-    print(render_json(new, old) if args.json else render_text(new, old, args.verbose))
+    if args.json:
+        print(render_json(new, old, timings))
+    else:
+        print(render_text(new, old, args.verbose))
+        if args.timings:
+            per = ", ".join(
+                f"{k}={v * 1e3:.0f}ms" for k, v in sorted(timings.items())
+            )
+            print(f"timings: {per}")
     return 1 if new else 0
 
 

@@ -1,0 +1,402 @@
+"""Corpus graph for dynalint 2.0: function units, await/lock-aware
+linearization, call-graph and import-graph edges.
+
+The PR 2 analyzer saw one statement at a time; the 2.0 rule families need
+*order*: "read before an await, write after it" (DYN1xx), "this value flows
+from that call" (DYN2xx), "who depends on the file you changed"
+(``--changed-only``).  Full CFG construction is overkill for a linter that
+must stay sub-second, so this module provides the deliberately simpler
+shape the rules actually consume:
+
+- :class:`FunctionUnit` — every function in the corpus with its enclosing
+  class, qualname, and parse tree, extracted once.
+- :func:`linearize` — a function body flattened to an ordered event stream
+  (reads/writes of ``self.X`` and declared globals, await points, local
+  assignments with provenance, guard tests), each event stamped with the
+  set of enclosing lock-shaped context managers.  Branches contribute their
+  events in source order: an over-approximation of real control flow that
+  errs toward *reporting* a possible interleaving — the right bias for a
+  suppressible linter.
+- :class:`CorpusGraph` — name-keyed call edges and module import edges over
+  the whole corpus, powering interprocedural taint summaries and the
+  reverse-dependency closure of ``--changed-only``.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import call_target, dotted_name
+from .registry import LOCKISH
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    target = expr.func if isinstance(expr, ast.Call) else expr
+    d = (dotted_name(target) or "").lower()
+    return any(tok in d for tok in LOCKISH)
+
+
+@dataclass
+class Event:
+    """One step of a linearized function body."""
+
+    kind: str  # "read" | "write" | "assign" | "await" | "test"
+    key: Optional[str]  # "self.attr" / global name; local name for assign
+    node: ast.AST
+    index: int
+    locks: frozenset  # ids of enclosing lock-shaped with/async-with nodes
+    # assign/write: keys + local names read by the RHS
+    value_reads: Tuple[str, ...] = ()
+    # write: (guard_keys, guard_index) for each enclosing if/while test
+    guards: Tuple[Tuple[Tuple[str, ...], int], ...] = ()
+
+
+@dataclass
+class FunctionUnit:
+    path: str
+    qualname: str
+    name: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    is_async: bool
+    class_name: Optional[str]
+    params: Tuple[str, ...]
+
+
+def collect_functions(path: str, tree: ast.AST) -> List[FunctionUnit]:
+    out: List[FunctionUnit] = []
+
+    def walk(node: ast.AST, prefix: List[str], cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(prefix + [child.name])
+                a = child.args
+                params = tuple(
+                    p.arg
+                    for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)
+                    if p.arg not in ("self", "cls")
+                )
+                out.append(
+                    FunctionUnit(
+                        path=path,
+                        qualname=qual,
+                        name=child.name,
+                        node=child,
+                        is_async=isinstance(child, ast.AsyncFunctionDef),
+                        class_name=cls,
+                        params=params,
+                    )
+                )
+                walk(child, prefix + [child.name], cls)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, prefix + [child.name], child.name)
+            else:
+                walk(child, prefix, cls)
+
+    walk(tree, [], None)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Linearization
+# ---------------------------------------------------------------------------
+
+
+def _state_key(node: ast.AST, globals_: Set[str]) -> Optional[str]:
+    """'self.attr' for self attribute chains (subscripts collapse to their
+    base attribute: ``self._refs[slot]`` is state of ``self._refs``), bare
+    names only when declared ``global``."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return f"self.{node.attr}"
+    if isinstance(node, ast.Name) and node.id in globals_:
+        return node.id
+    return None
+
+
+def _expr_reads(
+    expr: ast.AST, globals_: Set[str]
+) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
+    """(state keys, local names) read by an expression."""
+    keys: List[str] = []
+    locals_: List[str] = []
+    for sub in ast.walk(expr):
+        k = _state_key(sub, globals_)
+        if k is not None and isinstance(getattr(sub, "ctx", None), ast.Load):
+            keys.append(k)
+        elif isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+            locals_.append(sub.id)
+    return tuple(keys), tuple(locals_)
+
+
+class _Linearizer:
+    def __init__(self, fn: ast.AST):
+        self.events: List[Event] = []
+        self.globals_: Set[str] = set()
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Global):
+                self.globals_.update(sub.names)
+
+    def _emit(self, kind, key, node, locks, value_reads=(), guards=()):
+        self.events.append(
+            Event(
+                kind=kind,
+                key=key,
+                node=node,
+                index=len(self.events),
+                locks=frozenset(locks),
+                value_reads=tuple(value_reads),
+                guards=tuple(guards),
+            )
+        )
+
+    # -- expressions --------------------------------------------------------
+
+    def expr(self, node: ast.AST, locks) -> None:
+        """Emit read/await events for an expression subtree, in order
+        (manual in-order pass: ast.walk is BFS and loses sequencing)."""
+        self._expr_inorder(node, locks)
+
+    def _expr_inorder(self, node: ast.AST, locks) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.Await):
+            self._expr_inorder(node.value, locks)
+            self._emit("await", None, node, locks)
+            return
+        key = _state_key(node, self.globals_)
+        if key is not None and isinstance(getattr(node, "ctx", None), ast.Load):
+            self._emit("read", key, node, locks)
+            # still descend (subscript indices may read other state)
+        for child in ast.iter_child_nodes(node):
+            self._expr_inorder(child, locks)
+
+    # -- statements ---------------------------------------------------------
+
+    def body(self, stmts: Sequence[ast.stmt], locks, guards) -> None:
+        for stmt in stmts:
+            self.stmt(stmt, locks, guards)
+
+    def stmt(self, node: ast.stmt, locks, guards) -> None:
+        g = self.globals_
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = getattr(node, "value", None)
+            if value is not None:
+                self.expr(value, locks)
+            keys_read, locals_read = (
+                _expr_reads(value, g) if value is not None else ((), ())
+            )
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for tgt in targets:
+                key = _state_key(tgt, g)
+                if isinstance(node, ast.AugAssign):
+                    # x += v reads then writes x atomically (no await can
+                    # interleave inside one statement) — model as a
+                    # read+write pair at the same index.
+                    if key is not None:
+                        self._emit("read", key, node, locks)
+                if key is not None:
+                    vr = keys_read + locals_read
+                    if isinstance(tgt, ast.Subscript):
+                        # the subscript index is part of the decision
+                        ik, il = _expr_reads(tgt.slice, g)
+                        vr = vr + ik + il
+                    self._emit("write", key, node, locks, vr, guards)
+                elif isinstance(tgt, ast.Name):
+                    self._emit(
+                        "assign", tgt.id, node, locks, keys_read + locals_read
+                    )
+                else:
+                    # tuple unpacking / foreign-object attribute: record
+                    # reads only (already emitted via expr above).
+                    pass
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            test_keys, _ = _expr_reads(node.test, g)
+            self.expr(node.test, locks)
+            guard = guards
+            if test_keys:
+                guard = guards + ((tuple(test_keys), len(self.events) - 1),)
+            self.body(node.body, locks, guard)
+            # The else branch is the same decision on the same read —
+            # `if self.x is None: … else: <use self.x>` is as much a
+            # check-then-act as the then-branch.
+            self.body(node.orelse, locks, guard)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            self.expr(node.iter, locks)
+            if isinstance(node, ast.AsyncFor):
+                self._emit("await", None, node, locks)
+            keys_read, locals_read = _expr_reads(node.iter, g)
+            if isinstance(node.target, ast.Name):
+                self._emit(
+                    "assign", node.target.id, node, locks,
+                    keys_read + locals_read,
+                )
+            self.body(node.body, locks, guards)
+            self.body(node.orelse, locks, guards)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            lock_ids = set(locks)
+            for item in node.items:
+                self.expr(item.context_expr, locks)
+                if _is_lockish(item.context_expr):
+                    lock_ids.add(id(node))
+            if isinstance(node, ast.AsyncWith):
+                self._emit("await", None, node, locks)
+            self.body(node.body, frozenset(lock_ids), guards)
+            return
+        if isinstance(node, ast.Try):
+            self.body(node.body, locks, guards)
+            for h in node.handlers:
+                self.body(h.body, locks, guards)
+            self.body(node.orelse, locks, guards)
+            self.body(node.finalbody, locks, guards)
+            return
+        if isinstance(node, ast.Return) and node.value is not None:
+            self.expr(node.value, locks)
+            return
+        if isinstance(node, ast.Expr):
+            self.expr(node.value, locks)
+            return
+        # generic: visit child expressions/statements in order
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self.stmt(child, locks, guards)
+            elif isinstance(child, ast.expr):
+                self.expr(child, locks)
+
+
+def linearize(fn: ast.AST) -> List[Event]:
+    lin = _Linearizer(fn)
+    lin.body(fn.body, frozenset(), ())
+    return lin.events
+
+
+# ---------------------------------------------------------------------------
+# Call graph + import graph
+# ---------------------------------------------------------------------------
+
+
+def module_name(path: str) -> str:
+    """'dynamo_tpu/llm/qos.py' -> 'dynamo_tpu.llm.qos' (packages resolve
+    their __init__ to the package name)."""
+    mod = path[:-3] if path.endswith(".py") else path
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _resolve_relative(
+    base_module: str, level: int, target: Optional[str], is_package: bool
+) -> str:
+    parts = base_module.split(".")
+    # level 1 = current package.  For a module file that means dropping the
+    # module segment; for a package __init__ (whose module name IS the
+    # package after the .__init__ strip) level 1 is the package itself —
+    # one fewer segment to drop.
+    drop = level - 1 if is_package else level
+    anchor = parts[: max(0, len(parts) - drop)]
+    if target:
+        anchor = anchor + target.split(".")
+    return ".".join(anchor)
+
+
+@dataclass
+class CorpusGraph:
+    """Whole-corpus view shared by the 2.0 rule passes."""
+
+    files: List[Tuple[str, str, ast.AST]] = field(default_factory=list)
+    functions: List[FunctionUnit] = field(default_factory=list)
+    # bare function name -> units (cross-module resolution by unanimity,
+    # same policy as CorpusIndex)
+    by_name: Dict[str, List[FunctionUnit]] = field(default_factory=dict)
+    # path -> imported module names (absolute, after relative resolution)
+    imports: Dict[str, Set[str]] = field(default_factory=dict)
+    # path -> called bare names (tails)
+    calls: Dict[str, Set[str]] = field(default_factory=dict)
+    # bare name -> defining paths
+    def_paths: Dict[str, Set[str]] = field(default_factory=dict)
+
+    @classmethod
+    def build(cls, files: Sequence[Tuple[str, str, ast.AST]]) -> "CorpusGraph":
+        g = cls(files=list(files))
+        for path, _source, tree in files:
+            mod = module_name(path)
+            is_pkg = path.endswith("__init__.py")
+            units = collect_functions(path, tree)
+            g.functions.extend(units)
+            for u in units:
+                g.by_name.setdefault(u.name, []).append(u)
+                g.def_paths.setdefault(u.name, set()).add(path)
+            imps: Set[str] = set()
+            calls: Set[str] = set()
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Import):
+                    imps.update(a.name for a in node.names)
+                elif isinstance(node, ast.ImportFrom):
+                    if node.level:
+                        base = _resolve_relative(
+                            mod, node.level, node.module, is_pkg
+                        )
+                    else:
+                        base = node.module or ""
+                    if base:
+                        imps.add(base)
+                        # `from pkg import mod` also depends on pkg.mod
+                        imps.update(
+                            f"{base}.{a.name}" for a in node.names
+                        )
+                elif isinstance(node, ast.Call):
+                    _, tail = call_target(node)
+                    if tail:
+                        calls.add(tail)
+            g.imports[path] = imps
+            g.calls[path] = calls
+        return g
+
+    def unit_for_name(self, name: str) -> Optional[FunctionUnit]:
+        """The single corpus definition of ``name``, or None when absent or
+        ambiguous (unanimity: ambiguity disables resolution, never guesses)."""
+        units = self.by_name.get(name)
+        if units and len(units) == 1:
+            return units[0]
+        return None
+
+    # -- changed-only closure ----------------------------------------------
+
+    def dependents(self, changed: Set[str]) -> Set[str]:
+        """``changed`` plus every file that imports a changed module or
+        calls a function defined ONLY in changed files — one reverse hop,
+        which is the pre-commit contract (CI runs the full corpus)."""
+        changed_mods = {module_name(p) for p in changed}
+        # names whose every definition lives in a changed file
+        changed_names = {
+            name
+            for name, paths in self.def_paths.items()
+            if paths and paths <= changed
+        }
+        out = set(changed)
+        for path, _s, _t in self.files:
+            if path in out:
+                continue
+            imps = self.imports.get(path, set())
+            if imps & changed_mods:
+                out.add(path)
+                continue
+            if self.calls.get(path, set()) & changed_names:
+                out.add(path)
+        return out

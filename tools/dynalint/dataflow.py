@@ -1,0 +1,416 @@
+"""Taint dataflow core for the DYN2xx family.
+
+A deliberately small model, tuned for this codebase rather than general
+Python:
+
+- **Tags**, not booleans: a value is tainted ``wire`` (any wire-controlled
+  string: headers, nvext, model field, hub payloads) or ``credential``
+  (secret material: API keys, bearer tokens).  Sinks care about the
+  distinction — a model name in a log line is fine, an API key is not.
+- **Forward, any-path, no kill**: one in-order pass per function; once a
+  local is tainted it stays tainted unless REASSIGNED from a clean
+  expression (sanitizer call, constant, untainted value).  Branch merging
+  is union-by-construction.  Over-taints slightly; suppressible where
+  wrong.
+- **Bounded interprocedural summaries**: every function gets a summary —
+  which parameters flow to its return value, and whether the return is
+  wire/credential-tainted regardless of arguments.  Summaries are computed
+  by running the same evaluator with parameters seeded symbolically and
+  iterating the corpus a fixed 3 rounds (call chains deeper than that are
+  out of contract, matching the two-hop reality of this codebase's
+  resolve→use flows).  Resolution is name-keyed with the same unanimity
+  rule as DYN005/6: an ambiguous name yields no summary, never a guess.
+- **Class-attribute taint**: an attribute assigned a tainted expression in
+  any method of a class taints ``self.<attr>`` reads throughout that class
+  (the ``shed_by_tenant``-style store-then-render flows).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Optional, Set, Tuple
+
+from .callgraph import CorpusGraph, FunctionUnit
+from .core import call_target
+from .registry import (
+    CREDENTIAL_KEYS,
+    SANITIZER_TAILS,
+    TAINT_SOURCE_ATTRS,
+    TAINT_SOURCE_CALLS,
+    TAINT_SOURCE_KEYS,
+    TAINT_SOURCE_PARAMS,
+)
+
+WIRE = "wire"
+CREDENTIAL = "credential"
+_REAL = (WIRE, CREDENTIAL)
+
+Tags = FrozenSet[str]
+EMPTY: Tags = frozenset()
+
+
+def _param_tag(i: int) -> str:
+    return f"param:{i}"
+
+
+@dataclass
+class Summary:
+    """What a call to this function returns, taint-wise."""
+
+    ret_params: Set[int] = field(default_factory=set)  # arg i flows to return
+    ret_tags: Set[str] = field(default_factory=set)  # wire/credential always
+    # every return value passes through a sanitizer (wrapper functions like
+    # _credential_tenant): callers may treat the result as label-safe
+    ret_sanitized: bool = False
+
+
+class TaintEvaluator:
+    """Evaluates expression taint inside one function.
+
+    ``env`` maps local names -> tags.  The evaluator is shared between the
+    summary fixpoint (params seeded with symbolic ``param:i`` tags) and the
+    sink pass (params seeded only from the source registry).
+    """
+
+    def __init__(
+        self,
+        unit: FunctionUnit,
+        summaries: Dict[str, Summary],
+        class_attr_tags: Dict[Tuple[str, str], Tags],
+        symbolic_params: bool,
+    ):
+        self.unit = unit
+        self.summaries = summaries
+        self.class_attr_tags = class_attr_tags
+        self.env: Dict[str, Tags] = {}
+        # names last assigned from a sanitizer/numeric call — consumed by
+        # the DYN204 label-hygiene check (rules_taint._is_label_safe)
+        self.sanitized_names: Dict[str, bool] = {}
+        for i, p in enumerate(unit.params):
+            tags: Set[str] = set()
+            if symbolic_params:
+                tags.add(_param_tag(i))
+            if p in TAINT_SOURCE_PARAMS:
+                tags.add(TAINT_SOURCE_PARAMS[p])
+            if tags:
+                self.env[p] = frozenset(tags)
+
+    # -- expression evaluation ---------------------------------------------
+
+    def tags(self, expr: Optional[ast.AST]) -> Tags:
+        if expr is None:
+            return EMPTY
+        if isinstance(expr, ast.Constant):
+            return EMPTY
+        if isinstance(expr, ast.Name):
+            return self.env.get(expr.id, EMPTY)
+        if isinstance(expr, ast.Call):
+            return self._call_tags(expr)
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in TAINT_SOURCE_ATTRS:
+                return frozenset({TAINT_SOURCE_ATTRS[expr.attr]})
+            if (
+                isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and self.unit.class_name
+            ):
+                return self.class_attr_tags.get(
+                    (self.unit.class_name, expr.attr), EMPTY
+                )
+            return self.tags(expr.value)
+        if isinstance(expr, ast.Subscript):
+            key = _const_key(expr.slice)
+            out = set(self.tags(expr.value))
+            if key is not None:
+                out |= _key_tags(key)
+            return frozenset(out)
+        if isinstance(expr, ast.JoinedStr):
+            out: Set[str] = set()
+            for v in expr.values:
+                if isinstance(v, ast.FormattedValue):
+                    out |= self.tags(v.value)
+            return frozenset(out)
+        if isinstance(expr, ast.FormattedValue):
+            return self.tags(expr.value)
+        if isinstance(expr, (ast.BinOp,)):
+            return self.tags(expr.left) | self.tags(expr.right)
+        if isinstance(expr, (ast.BoolOp,)):
+            out = set()
+            for v in expr.values:
+                out |= self.tags(v)
+            return frozenset(out)
+        if isinstance(expr, ast.IfExp):
+            return self.tags(expr.body) | self.tags(expr.orelse)
+        if isinstance(expr, (ast.Compare,)):
+            return EMPTY  # comparisons yield booleans
+        if isinstance(expr, ast.Starred):
+            return self.tags(expr.value)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            out = set()
+            for e in expr.elts:
+                out |= self.tags(e)
+            return frozenset(out)
+        if isinstance(expr, ast.Dict):
+            out = set()
+            for v in expr.values:
+                out |= self.tags(v)
+            return frozenset(out)
+        if isinstance(expr, ast.Await):
+            return self.tags(expr.value)
+        if isinstance(expr, ast.NamedExpr):
+            t = self.tags(expr.value)
+            self.env[expr.target.id] = t
+            return t
+        return EMPTY
+
+    def _call_tags(self, call: ast.Call) -> Tags:
+        dotted, tail = call_target(call)
+        if tail in SANITIZER_TAILS:
+            return EMPTY
+        # .get("key") on anything: dict-key sources (wire payload keys).
+        if tail == "get" and call.args:
+            key = _const_key(call.args[0])
+            base = (
+                self.tags(call.func.value)
+                if isinstance(call.func, ast.Attribute)
+                else EMPTY
+            )
+            out = set(base)
+            if key is not None:
+                out |= _key_tags(key)
+            return frozenset(out)
+        if tail in TAINT_SOURCE_CALLS:
+            return frozenset({TAINT_SOURCE_CALLS[tail]})
+        if tail == "str" and call.args:
+            return self.tags(call.args[0])  # str() preserves content
+        summary = self.summaries.get(tail) if tail else None
+        if summary is not None:
+            out: Set[str] = set(summary.ret_tags)
+            for i in summary.ret_params:
+                if i < len(call.args):
+                    out |= self.tags(call.args[i])
+            # keyword args matched by callee param name
+            unit = None
+            if summary.ret_params:
+                for kw in call.keywords:
+                    if kw.arg is None:
+                        continue
+                    unit = unit or self._summary_unit(tail)
+                    if unit and kw.arg in unit.params:
+                        if unit.params.index(kw.arg) in summary.ret_params:
+                            out |= self.tags(kw.value)
+            return frozenset(out)
+        return EMPTY
+
+    def _summary_unit(self, name: str) -> Optional[FunctionUnit]:
+        return self._graph.unit_for_name(name) if self._graph else None
+
+    _graph: Optional[CorpusGraph] = None
+
+    # -- statement walk ----------------------------------------------------
+
+    def assign(
+        self, target: ast.AST, tags: Tags, value: Optional[ast.AST] = None
+    ) -> None:
+        if isinstance(target, ast.Name):
+            if tags:
+                self.env[target.id] = tags
+            else:
+                self.env.pop(target.id, None)  # reassignment kills taint
+            self.sanitized_names.pop(target.id, None)
+            if value is not None and isinstance(value, ast.Call):
+                from .registry import LABEL_SAFE_CALLS
+
+                _, tail = call_target(value)
+                summary = self.summaries.get(tail) if tail else None
+                if tail in LABEL_SAFE_CALLS or (
+                    summary is not None and summary.ret_sanitized
+                ):
+                    self.sanitized_names[target.id] = True
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self.assign(e, tags)
+
+
+def _const_key(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _key_tags(key: str) -> Set[str]:
+    out: Set[str] = set()
+    lk = key.lower()
+    if lk in CREDENTIAL_KEYS:
+        out.add(CREDENTIAL)
+        out.add(WIRE)
+    elif lk in TAINT_SOURCE_KEYS:
+        out.add(WIRE)
+    return out
+
+
+def real_tags(tags: Tags) -> Tags:
+    """Drop symbolic param tags, keep wire/credential."""
+    return frozenset(t for t in tags if t in _REAL)
+
+
+# ---------------------------------------------------------------------------
+# Corpus-level computation
+# ---------------------------------------------------------------------------
+
+
+class TaintModel:
+    """Summaries + class-attribute taint for a whole corpus."""
+
+    ROUNDS = 3  # bounded fixpoint: resolve→thread→use is ≤3 hops here
+
+    def __init__(self, graph: CorpusGraph):
+        self.graph = graph
+        self.summaries: Dict[str, Summary] = {}
+        self.class_attr_tags: Dict[Tuple[str, str], Tags] = {}
+        self._compute()
+
+    # Walk a function in source order, updating env at assignments and
+    # invoking ``visit(stmt_or_expr, evaluator)`` so callers can hook sinks.
+    def walk_function(
+        self,
+        unit: FunctionUnit,
+        symbolic_params: bool,
+        visit=None,
+    ) -> TaintEvaluator:
+        ev = TaintEvaluator(
+            unit, self.summaries, self.class_attr_tags, symbolic_params
+        )
+        ev._graph = self.graph
+        returns: Set[str] = set()
+
+        def do_stmt(stmt: ast.stmt) -> None:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                return
+            if visit is not None:
+                visit(stmt, ev)
+            if isinstance(stmt, ast.Assign):
+                t = ev.tags(stmt.value)
+                for tgt in stmt.targets:
+                    ev.assign(tgt, t, stmt.value)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                ev.assign(stmt.target, ev.tags(stmt.value), stmt.value)
+            elif isinstance(stmt, ast.AugAssign):
+                t = ev.tags(stmt.value)
+                if isinstance(stmt.target, ast.Name) and t:
+                    ev.env[stmt.target.id] = (
+                        ev.env.get(stmt.target.id, EMPTY) | t
+                    )
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                ev.assign(stmt.target, ev.tags(stmt.iter))
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    if item.optional_vars is not None:
+                        ev.assign(
+                            item.optional_vars, ev.tags(item.context_expr)
+                        )
+            elif isinstance(stmt, ast.Return):
+                returns.update(ev.tags(stmt.value))
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt):
+                    do_stmt(child)
+                elif isinstance(child, (ast.excepthandler,)):
+                    for s in child.body:
+                        do_stmt(s)
+
+        for stmt in unit.node.body:
+            do_stmt(stmt)
+        ev.return_tags = frozenset(returns)  # type: ignore[attr-defined]
+        return ev
+
+    def _returns_sanitized(self, unit: FunctionUnit) -> bool:
+        """Every return statement's value is a sanitizer call (directly,
+        or a call to an already-known sanitizing wrapper)."""
+        returns = [
+            n
+            for n in ast.walk(unit.node)
+            if isinstance(n, ast.Return)
+        ]
+        if not returns:
+            return False
+        for r in returns:
+            if not isinstance(r.value, ast.Call):
+                return False
+            from .core import call_target as _ct
+
+            _, tail = _ct(r.value)
+            summary = self.summaries.get(tail) if tail else None
+            if tail not in SANITIZER_TAILS and not (
+                summary is not None and summary.ret_sanitized
+            ):
+                return False
+        return True
+
+    def _compute(self) -> None:
+        # Which names are unambiguous (unanimity rule)?
+        resolvable = [
+            units[0]
+            for name, units in self.graph.by_name.items()
+            if len(units) == 1
+        ]
+        for _round in range(self.ROUNDS):
+            changed = False
+            for unit in resolvable:
+                ev = self.walk_function(unit, symbolic_params=True)
+                rt: Tags = ev.return_tags  # type: ignore[attr-defined]
+                summary = Summary(
+                    ret_params={
+                        int(t.split(":", 1)[1])
+                        for t in rt
+                        if t.startswith("param:")
+                    },
+                    ret_tags=set(real_tags(rt)),
+                    ret_sanitized=self._returns_sanitized(unit),
+                )
+                old = self.summaries.get(unit.name)
+                if (
+                    old is None
+                    or old.ret_params != summary.ret_params
+                    or old.ret_tags != summary.ret_tags
+                    or old.ret_sanitized != summary.ret_sanitized
+                ):
+                    self.summaries[unit.name] = summary
+                    changed = True
+            # class-attribute taint: attrs assigned tainted exprs anywhere
+            for unit in self.graph.functions:
+                if not unit.class_name:
+                    continue
+                ev = TaintEvaluator(
+                    unit, self.summaries, self.class_attr_tags, False
+                )
+                ev._graph = self.graph
+                for node in ast.walk(unit.node):
+                    if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                        continue
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    t = real_tags(ev.tags(node.value))
+                    if not t:
+                        continue
+                    for tgt in targets:
+                        base = tgt
+                        while isinstance(base, ast.Subscript):
+                            base = base.value
+                        if (
+                            isinstance(base, ast.Attribute)
+                            and isinstance(base.value, ast.Name)
+                            and base.value.id == "self"
+                        ):
+                            key = (unit.class_name, base.attr)
+                            merged = self.class_attr_tags.get(key, EMPTY) | t
+                            if merged != self.class_attr_tags.get(key):
+                                self.class_attr_tags[key] = merged
+                                changed = True
+            if not changed:
+                break

@@ -1,0 +1,24 @@
+# dynalint-fixture: expect=none
+"""The three sanctioned shapes: a lock covering the span, a re-check after
+the await, and the stop()-teardown None-clear."""
+
+
+class Registry:
+    async def bump_locked(self, slot):
+        async with self._claim_lock:
+            refs = self._refs[slot]
+            await self._apply(slot)
+            self._refs[slot] = refs + 1  # lock held across the span
+
+    async def lazy_init(self):
+        if self._server is None:
+            server = await self._start()
+            if self._server is None:  # re-check after the await
+                self._server = server
+        return self._server
+
+    async def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+            await self._gather(self._task)
+            self._task = None  # teardown clear: derives from nothing stale

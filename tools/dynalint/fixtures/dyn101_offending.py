@@ -1,0 +1,10 @@
+# dynalint-fixture: expect=DYN101
+"""Refcount read-modify-write spanning an await: the value captured before
+the suspension point is stale by the time the write lands."""
+
+
+class Registry:
+    async def bump(self, slot):
+        refs = self._refs[slot]  # read shared state
+        await self._apply(slot)  # suspension: peers can run
+        self._refs[slot] = refs + 1  # stale write clobbers their update

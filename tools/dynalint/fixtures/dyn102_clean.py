@@ -1,0 +1,15 @@
+# dynalint-fixture: expect=none
+"""Exception-safe shapes: finally-release and async with."""
+
+
+class Pump:
+    async def drain(self):
+        await self._lock.acquire()
+        try:
+            await self._flush()
+        finally:
+            self._lock.release()
+
+    async def drain_ctx(self):
+        async with self._lock:
+            await self._flush()

@@ -1,0 +1,10 @@
+# dynalint-fixture: expect=DYN102
+"""Manual acquire/release without a finally: an exception in flush leaks
+the lock and wedges every waiter."""
+
+
+class Pump:
+    async def drain(self):
+        await self._lock.acquire()
+        await self._flush()
+        self._lock.release()  # skipped when _flush raises

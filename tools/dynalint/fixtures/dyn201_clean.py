@@ -1,0 +1,10 @@
+# dynalint-fixture: expect=none
+"""Sanitized at the sink: escape_label for wire strings, hash_credential
+for secrets."""
+
+
+def render_sheds(body, headers, lines, escape_label, hash_credential):
+    tenant = body.get("tenant")
+    lines.append(f'qos_shed_by_tenant_total{{tenant="{escape_label(tenant)}"}} 1')
+    key = hash_credential(headers.get("x-api-key") or "")
+    lines.append(f'qos_keys_total{{key="{key}"}} 1')

@@ -1,0 +1,7 @@
+# dynalint-fixture: expect=DYN201
+"""Wire-controlled tenant id interpolated into a Prometheus label."""
+
+
+def render_sheds(body, lines):
+    tenant = body.get("tenant")
+    lines.append(f'qos_shed_by_tenant_total{{tenant="{tenant}"}} 1')

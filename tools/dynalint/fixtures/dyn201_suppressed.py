@@ -1,0 +1,7 @@
+# dynalint-fixture: expect=none
+
+
+def render_sheds(body, lines):
+    tenant = body.get("tenant")
+    # reviewed: tenant already validated against a closed allowlist
+    lines.append(f'qos_shed_total{{tenant="{tenant}"}} 1')  # dynalint: disable=DYN201,DYN204

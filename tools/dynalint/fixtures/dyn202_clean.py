@@ -1,0 +1,6 @@
+# dynalint-fixture: expect=none
+
+
+def admit(headers, logger, hash_credential):
+    key = hash_credential(headers.get("x-api-key") or "")
+    logger.warning(f"quota exceeded for {key}")

@@ -1,0 +1,7 @@
+# dynalint-fixture: expect=DYN202
+"""Credential-grade wire value (API key) reaching a log line."""
+
+
+def admit(headers, logger):
+    key = headers.get("x-api-key")
+    logger.warning(f"quota exceeded for {key}")

@@ -1,0 +1,6 @@
+# dynalint-fixture: expect=none
+
+
+async def register(hub, body, safe_key_component):
+    name = safe_key_component(body.get("metadata").get("name"))
+    await hub.kv_put("deployments/" + name, body)

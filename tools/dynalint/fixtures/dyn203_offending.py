@@ -1,0 +1,8 @@
+# dynalint-fixture: expect=DYN203
+"""Wire-controlled name formatted into a hub key: 'a/b' escapes the
+store's prefix."""
+
+
+async def register(hub, body):
+    name = body.get("metadata").get("name")
+    await hub.kv_put("deployments/" + name, body)

@@ -1,0 +1,9 @@
+# dynalint-fixture: expect=none
+
+
+class WorkerMetrics:
+    def render(self, lines, escape_label):
+        for wid, m in self._metrics.items():
+            lines.append(
+                f'worker_active_slots{{worker_id="{escape_label(wid)}"}} {m}'
+            )

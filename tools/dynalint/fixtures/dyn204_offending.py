@@ -1,0 +1,9 @@
+# dynalint-fixture: expect=DYN204
+"""Label value of unprovable provenance: the dataflow cannot see through
+the dict, so hygiene demands the escape anyway."""
+
+
+class WorkerMetrics:
+    def render(self, lines):
+        for wid, m in self._metrics.items():
+            lines.append(f'worker_active_slots{{worker_id="{wid}"}} {m}')

@@ -1,0 +1,24 @@
+# dynalint-fixture: expect=none
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class WireMsg:
+    kind: str
+    payload: dict
+    trace_id: Optional[str] = None
+
+    def to_dict(self):
+        out = {"kind": self.kind, "payload": self.payload}
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
+        return out
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(
+            kind=d["kind"],
+            payload=dict(d.get("payload") or {}),
+            trace_id=d.get("trace_id"),
+        )

@@ -1,0 +1,19 @@
+# dynalint-fixture: expect=DYN301
+"""A wire dataclass whose newest field never makes it into to_dict: it
+silently stops traveling."""
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class WireMsg:
+    kind: str
+    payload: dict
+    trace_id: Optional[str] = None
+
+    def to_dict(self):
+        return {"kind": self.kind, "payload": self.payload}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(kind=d["kind"], payload=dict(d.get("payload") or {}))

@@ -1,0 +1,17 @@
+# dynalint-fixture: expect=none
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class WireMsg:
+    kind: str
+    # in-memory handle, never serialized (reviewed)
+    trace_id: Optional[str] = None  # dynalint: disable=DYN301
+
+    def to_dict(self):
+        return {"kind": self.kind}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(kind=d["kind"])

@@ -1,0 +1,26 @@
+# dynalint-fixture: expect=none
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class WireReq:
+    token_ids: list
+    grammar: Optional[dict] = None
+    priority: Optional[str] = None
+
+    def to_dict(self):
+        out = {"token_ids": self.token_ids}
+        if self.grammar is not None:
+            out["grammar"] = self.grammar
+        if self.priority is not None:
+            out["priority"] = self.priority
+        return out
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(
+            token_ids=list(d["token_ids"]),
+            grammar=d.get("grammar"),
+            priority=d.get("priority"),
+        )

@@ -1,0 +1,27 @@
+# dynalint-fixture: expect=DYN302
+"""The class adopted omit-when-absent (grammar is conditional) but ships
+the newer optional field unconditionally — old consumers now see a key
+they predate."""
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class WireReq:
+    token_ids: list
+    grammar: Optional[dict] = None
+    priority: Optional[str] = None
+
+    def to_dict(self):
+        out = {"token_ids": self.token_ids, "priority": self.priority}
+        if self.grammar is not None:
+            out["grammar"] = self.grammar
+        return out
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(
+            token_ids=list(d["token_ids"]),
+            grammar=d.get("grammar"),
+            priority=d.get("priority"),
+        )

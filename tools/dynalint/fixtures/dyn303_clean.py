@@ -1,0 +1,15 @@
+# dynalint-fixture: expect=none
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class WireStop:
+    max_tokens: Optional[int] = None
+
+    def to_dict(self):
+        return {"max_tokens": self.max_tokens}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(max_tokens=d.get("max_tokens"))

@@ -1,0 +1,17 @@
+# dynalint-fixture: expect=DYN303
+"""from_dict KeyErrors on old-wire dicts: the defaulted field must be
+read with .get()."""
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class WireStop:
+    max_tokens: Optional[int] = None
+
+    def to_dict(self):
+        return {"max_tokens": self.max_tokens}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(max_tokens=d["max_tokens"])

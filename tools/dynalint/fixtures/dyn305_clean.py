@@ -1,0 +1,10 @@
+# dynalint-fixture: expect=none
+
+
+def shape(body):
+    nvext = body.get("nvext")
+    if not isinstance(nvext, dict):
+        nvext = {}
+        body["nvext"] = nvext
+    nvext["spec_decode"] = False
+    return body

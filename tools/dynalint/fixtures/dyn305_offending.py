@@ -1,0 +1,8 @@
+# dynalint-fixture: expect=DYN305
+"""setdefault on a nullable wire key: a client-sent '"nvext": null'
+satisfies it and the rewrite is silently skipped."""
+
+
+def shape(body):
+    body.setdefault("nvext", {})["spec_decode"] = False
+    return body

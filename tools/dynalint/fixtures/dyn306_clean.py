@@ -1,0 +1,15 @@
+# dynalint-fixture: expect=none
+from typing import NamedTuple
+
+
+class SamplingParams(NamedTuple):
+    seeds: object
+    steps: object
+    temperature: object
+    top_k: object
+    top_p: object
+    freq_penalty: object
+    pres_penalty: object
+    counts: object
+    need_logprobs: object
+    mask_words: object = None  # appended, defaulted: treedef-stable

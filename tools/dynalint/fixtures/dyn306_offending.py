@@ -1,0 +1,17 @@
+# dynalint-fixture: expect=DYN306
+"""A field inserted into SamplingParams' frozen prefix: every cached jit
+program recompiles and wire'd tuples unpack shifted."""
+from typing import NamedTuple
+
+
+class SamplingParams(NamedTuple):
+    seeds: object
+    steps: object
+    mask_words: object  # inserted mid-prefix — breaks treedef stability
+    temperature: object
+    top_k: object
+    top_p: object
+    freq_penalty: object
+    pres_penalty: object
+    counts: object
+    need_logprobs: object

@@ -1,0 +1,13 @@
+# dynalint-fixture: expect=DYN101
+"""PR 6/8 idiom, minimized: AdapterRegistry promotion decided by a
+pre-await residency check.  The real registry holds _claim_lock across the
+span — remove the lock (as the first draft did) and two concurrent
+acquires double-promote into the same slot."""
+
+
+class AdapterSlots:
+    async def ensure_resident(self, name):
+        if self._slot_of.get(name) is None:  # decision from pre-await state
+            await self._promote(name)  # suspension: a peer can promote too
+            self._slot_of[name] = self._pick_slot()  # double-claim
+        return self._slot_of[name]

@@ -1,0 +1,11 @@
+# dynalint-fixture: expect=DYN305
+"""PR 8 review finding, minimized: the brownout rung-2 spec stand-down
+used setdefault, so a request carrying an explicit '"nvext": null' kept
+its speculative drafts during overload — and a batch row could launder
+into the protected class the same way on the priority-threading path."""
+
+
+def apply_rung(body, rung):
+    if rung >= 2:
+        body.setdefault("nvext", {})["spec_decode"] = False
+    return body

@@ -1,0 +1,17 @@
+# dynalint-fixture: expect=DYN201
+"""PR 8 review finding, minimized: the tenant resolver returned the RAW
+API key on the credential path, and the QoS metrics rendered tenant ids
+as labels — a secret one hop from /metrics.  The interprocedural summary
+carries the credential taint through the resolver into the sink."""
+
+
+def resolve_tenant_id(headers, body):
+    key = headers.get("x-api-key")
+    if key:
+        return key  # the bug: raw credential becomes the tenant id
+    return body.get("model") or "anonymous"
+
+
+def render(headers, body, lines):
+    tenant = resolve_tenant_id(headers, body)
+    lines.append(f'qos_shed_by_tenant_total{{tenant="{tenant}"}} 1')

@@ -1,0 +1,73 @@
+# dynalint-fixture: expect=DYN304
+"""PR 6 review finding, minimized: SequenceState grew tenancy fields
+(grammar/adapter here: a hypothetical reasoning_budget) without a
+SequenceSnapshot counterpart or an explicit exemption — a migrated
+sequence silently resumed without the state and the spliced stream
+diverged.  The field lists mirror the real classes so only the GAP field
+trips the registry."""
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class SequenceState:
+    request_id: str
+    prompt: List[int]
+    block_seq: Any
+    sampling_temperature: float = 0.0
+    sampling_top_k: int = 0
+    sampling_top_p: float = 1.0
+    sampling_seed: int = 0
+    freq_penalty: float = 0.0
+    pres_penalty: float = 0.0
+    logprobs: Optional[int] = None
+    max_new_tokens: Optional[int] = None
+    min_new_tokens: Optional[int] = None
+    stop_token_ids: frozenset = frozenset()
+    ignore_eos: bool = False
+    output: List[int] = field(default_factory=list)
+    pin_ids: Optional[List[int]] = None
+    awaiting_fetch: bool = False
+    frozen: bool = False
+    orig_prompt_len: int = 0
+    block_ids: List[int] = field(default_factory=list)
+    num_computed: int = 0
+    num_cached_prompt: int = 0
+    finished: bool = False
+    num_sealed_blocks: int = 0
+    enqueue_t: float = 0.0
+    spec_enabled: bool = True
+    spec_k: int = -1
+    spec_ewma: float = 1.0
+    spec_bench_until: int = -1
+    spec_next_try: int = 0
+    spec_miss: int = 0
+    kv_salt: Optional[str] = None
+    adapter: Optional[str] = None
+    adapter_slot: int = -1
+    adapter_released: bool = False
+    grammar: Any = None
+    grammar_state: int = 0
+    tenant: str = ""
+    priority: str = "interactive"
+    # THE GAP: consumed by the sampler, absent from the snapshot AND from
+    # both registry tables — the PR 6 bug shape.
+    reasoning_budget: int = 0
+
+
+@dataclass
+class SequenceSnapshot:
+    request_id: str
+    token_ids: List[int]
+    orig_prompt_len: int
+    sampling: Dict[str, Any] = field(default_factory=dict)
+    stop: Dict[str, Any] = field(default_factory=dict)
+    spec: Dict[str, Any] = field(default_factory=dict)
+    deadline_s: Optional[float] = None
+    detok: Optional[Dict[str, Any]] = None
+    adapter: Optional[str] = None
+    kv_salt: Optional[str] = None
+    tenant: Optional[str] = None
+    priority: Optional[str] = None
+    grammar: Optional[Dict[str, Any]] = None
+    version: int = 1

@@ -1,0 +1,15 @@
+# dynalint-fixture: expect=DYN101
+"""PR 8 review finding, minimized: WfqQueue.remove() advanced the queue's
+virtual time from a cancelled entry's far-future finish stamp.  In the
+synchronous scheduler the review caught it by hand; transplanted into the
+async hub-coordinated drain, the same idiom is a stale-fairness-state
+write the moment a publish sits between read and write."""
+
+
+class WfqDrain:
+    async def remove(self, seq):
+        vt = self._vt  # read the fairness clock
+        await self._hub.publish("cancel", seq.request_id)
+        # Stale: admissions during the publish already advanced _vt; this
+        # write rolls the clock back (or jumps it past the backlog).
+        self._vt = max(vt, seq.vft)

@@ -1,0 +1,276 @@
+"""dynalint 2.0 registries: taint sources/sinks/sanitizers, wire-schema
+classes and exemptions.
+
+The dataflow rules (DYN1xx/2xx/3xx) are only as good as their model of
+*this* codebase; that model lives here, in one reviewable place, instead of
+being scattered through rule logic.  Three registry groups:
+
+- **Taint** (DYN2xx): which expressions produce wire-controlled data
+  (sources), which calls neutralize it (sanitizers), and which calls/format
+  positions must never receive it raw (sinks).
+- **Wire schema** (DYN3xx): which dataclasses cross process boundaries,
+  which of their fields are deliberately exempt from a check, and the
+  frozen field prefixes of the jit-pytree classes whose treedef must stay
+  byte-stable.
+- **Snapshot threading** (DYN304): the explicit SequenceState →
+  SequenceSnapshot coverage map — every engine-consumed decode-state field
+  either travels in the snapshot or is consciously exempted here.
+
+Every entry is a claim that someone thought about the case; deleting an
+entry re-surfaces the finding, so the registries are self-auditing: stale
+entries (naming fields/classes that no longer exist) are themselves
+reported by the schema pass.
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------------------------------
+# DYN2xx taint model
+# ---------------------------------------------------------------------------
+
+# Dict keys whose values are wire-controlled wherever they are read:
+# request bodies, nvext extensions, hub-delivered registration payloads.
+# Reading `<anything>.get("model")` / `<anything>["model"]` taints.
+TAINT_SOURCE_KEYS = {
+    "model",
+    "nvext",
+    "tenant",
+    "adapter",
+    "priority",
+    "x-tenant",
+    "x-priority",
+    "x-api-key",
+    "worker_id",
+    "metadata",
+}
+
+# Keys that carry CREDENTIALS (secret material): stronger taint — reaching
+# a log line is already a finding (DYN202), not just a label.
+CREDENTIAL_KEYS = {
+    "x-api-key",
+    "authorization",
+    "api_key",
+    "bearer",
+}
+
+# Parameters that are wire-controlled by naming convention at the HTTP /
+# hub edge (`headers` is the aiohttp-style mapping every edge handler
+# threads through).
+TAINT_SOURCE_PARAMS = {
+    "headers": "wire",
+}
+
+# Attribute reads that produce wire data regardless of the base object.
+TAINT_SOURCE_ATTRS = {
+    "headers": "wire",
+}
+
+# Calls whose RESULT is wire-controlled (beyond what summaries derive).
+# resolve_tenant: x-tenant / nvext.tenant / model pass through verbatim
+# (credentials are hashed inside, but the common paths are raw wire).
+TAINT_SOURCE_CALLS = {
+    "resolve_tenant": "wire",
+}
+
+# Calls that neutralize taint: hashing, numeric coercion, Prometheus label
+# escaping, and the project's own credential digest.  A sanitizer's return
+# value is clean no matter what went in.
+SANITIZER_TAILS = {
+    "escape_label",
+    "hash_credential",
+    "safe_key_component",
+    "bounded_label",
+    "_credential_tenant",
+    "sha256",
+    "sha1",
+    "md5",
+    "blake2b",
+    "crc32",
+    "hexdigest",
+    "normalize_priority",
+    "int",
+    "float",
+    "bool",
+    "len",
+    "round",
+    "abs",
+    "hash",
+    "id",
+    "ord",
+}
+
+# Lock-shaped names (DYN101 protection detection in callgraph.py AND
+# DYN102 acquire/release matching in rules_race.py read THIS tuple — one
+# list, so the two rules can never disagree about what counts as a lock).
+LOCKISH = ("lock", "mutex", "sem")
+
+# Prometheus-client metric objects: `<metric>.labels(...)` is a label sink.
+LABEL_SINK_TAILS = {"labels"}
+
+# Logging sinks: `logger.<x>(...)`.
+LOG_SINK_TAILS = {"debug", "info", "warning", "error", "exception", "critical"}
+LOG_RECEIVERS = {"logger", "logging", "log", "LOGGER"}
+
+# Hub-key sinks: the FIRST positional argument is a key/subject in the
+# shared control-plane namespace; wire data formatted into it un-escaped
+# can escape its prefix ("tenant/x" vs "tenant/../quarantine").
+HUB_KEY_SINK_TAILS = {
+    "kv_put",
+    "kv_get",
+    "kv_get_prefix",
+    "kv_delete",
+    "kv_list",
+    "watch",
+    "watch_prefix",
+    "q_push",
+    "q_pop",
+    "queue_push",
+    "publish",
+    "subscribe",
+}
+
+# Calls that are *safe enough* in a label position for DYN204 even though
+# they are not sanitizers (they render numbers).
+LABEL_SAFE_CALLS = SANITIZER_TAILS | {"min", "max", "sum", "format"}
+
+# (path, symbol) pairs exempt from DYN204 — each entry documents why the
+# interpolated value is provably not wire-controlled.  Keep EMPTY unless
+# an escape-at-render fix is genuinely wrong (for internal strings the
+# escape is the identity, so the bar for exempting is high; the one real
+# hazard is double-escaping a value a helper already escaped — fix THAT
+# by making the helper hand raw values to the render).
+LABEL_HYGIENE_EXEMPT: set = set()
+
+# ---------------------------------------------------------------------------
+# DYN3xx wire-schema model
+# ---------------------------------------------------------------------------
+
+# Classes checked even without a to_dict/from_dict pair, and classes with
+# serialization helpers that are deliberately NOT wire schemas.
+WIRE_CLASS_EXTRA: set = set()
+WIRE_CLASS_EXEMPT = {
+    # Engine-internal report types whose dicts never cross a version
+    # boundary (rebuilt from source every run) go here if they ever trip
+    # DYN301.  Empty today: every to_dict class in dynamo_tpu is wire.
+}
+
+# (class, field): fields deliberately absent from to_dict / from_dict.
+WIRE_FIELD_EXEMPT = {
+    # ModelDeploymentCard.tokenizer_obj style in-memory handles would go
+    # here; none exist on current wire classes.
+}
+
+# Classes that adopted omit-when-absent for OPTIONAL fields (wire compat:
+# pre-existing consumers must never see keys they predate).  A class also
+# auto-adopts the moment its to_dict emits any field conditionally.
+OMIT_WHEN_ABSENT_CLASSES = {
+    "PreprocessedRequest",
+    "SequenceSnapshot",
+}
+
+# (class, field): Optional fields that MAY ship unconditionally despite
+# the class adopting omit-when-absent — grandfathered keys consumers
+# already rely on being present.
+OMIT_WHEN_ABSENT_EXEMPT = {
+    # "model" predates the convention: recorded streams and pre-tenancy
+    # consumers read the key unconditionally (None means base model).
+    ("PreprocessedRequest", "model"),
+}
+
+# Wire-optional keys where a client-sent explicit ``null`` satisfies
+# ``setdefault`` and silently skips the rewrite path (the PR 8
+# ``"nvext": null`` bug class) — DYN305 flags setdefault on these.
+NULLABLE_WIRE_KEYS = {
+    "nvext",
+    "annotations",
+    "sampling_options",
+    "stop_conditions",
+}
+
+# jit-pytree NamedTuples whose treedef must stay byte-stable: the FROZEN
+# field prefix (wire/compile compatibility) — new fields must append after
+# it with defaults, never reorder or insert (DYN306).
+TREEDEF_FROZEN_PREFIX = {
+    "SamplingParams": (
+        "seeds",
+        "steps",
+        "temperature",
+        "top_k",
+        "top_p",
+        "freq_penalty",
+        "pres_penalty",
+        "counts",
+        "need_logprobs",
+    ),
+    "RaggedBatch": (
+        "token_ids",
+        "positions",
+        "slot_mapping",
+        "kv_lens",
+        "page_indices",
+        "cu_q_lens",
+        "num_seqs",
+    ),
+}
+
+# ---------------------------------------------------------------------------
+# DYN304: SequenceState -> SequenceSnapshot threading map
+# ---------------------------------------------------------------------------
+
+# Decode-state fields the sampler/pipeline consumes and HOW each travels in
+# the snapshot ("field" or "field.sub" of SequenceSnapshot).  A new
+# SequenceState field must land in exactly one of these two tables or
+# DYN304 fails the gate — the PR 6 bug class (grammar/adapter added to the
+# state but not the snapshot ⇒ migrated streams silently diverged).
+SNAPSHOT_STATE_CLASS = "SequenceState"
+SNAPSHOT_CLASS = "SequenceSnapshot"
+
+SNAPSHOT_COVERED = {
+    "request_id": "request_id",
+    "prompt": "token_ids",
+    "output": "token_ids",  # folded: snapshot ships prompt+output
+    "orig_prompt_len": "orig_prompt_len",
+    "sampling_temperature": "sampling.temperature",
+    "sampling_top_k": "sampling.top_k",
+    "sampling_top_p": "sampling.top_p",
+    "sampling_seed": "sampling.seed",
+    "freq_penalty": "sampling.frequency_penalty",
+    "pres_penalty": "sampling.presence_penalty",
+    "logprobs": "sampling.logprobs",
+    "spec_enabled": "sampling.spec_decode",
+    "max_new_tokens": "stop.max_tokens",
+    "min_new_tokens": "stop.min_tokens",
+    "stop_token_ids": "stop.stop_token_ids",
+    "ignore_eos": "stop.ignore_eos",
+    "spec_k": "spec.k",
+    "spec_ewma": "spec.ewma",
+    "spec_bench_until": "spec.bench_until",
+    "spec_next_try": "spec.next_try",
+    "spec_miss": "spec.miss",
+    "kv_salt": "kv_salt",
+    "adapter": "adapter",
+    "grammar": "grammar",
+    "tenant": "tenant",
+    "priority": "priority",
+}
+
+# Fields that deliberately do NOT travel, with the reason recorded:
+SNAPSHOT_EXEMPT = {
+    # KV/block bookkeeping: the target re-derives all of it when the
+    # transferred blocks admit as a prefix hit.
+    "block_seq": "rebuilt from token_ids on the target",
+    "block_ids": "target-side allocation",
+    "num_computed": "target-side admission state",
+    "num_cached_prompt": "target-side admission metric",
+    "num_sealed_blocks": "target-side sealing cursor",
+    "pin_ids": "pre-admission pin never outlives the source scheduler",
+    # Transient scheduler/engine flags that must NOT travel:
+    "awaiting_fetch": "in-flight fetch is quiesced before freeze",
+    "frozen": "migration-local flag",
+    "finished": "finished sequences are not migrated",
+    "enqueue_t": "per-queue latency bookkeeping",
+    # Tenancy handles resolved per engine:
+    "adapter_slot": "target resolves its own resident slot",
+    "adapter_released": "source-side release idempotency flag",
+    "grammar_state": "re-derived by advancing through resumed output",
+}

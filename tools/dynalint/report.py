@@ -39,7 +39,9 @@ def render_text(
 
 
 def render_json(
-    new: Sequence[Finding], baselined: Sequence[Finding]
+    new: Sequence[Finding],
+    baselined: Sequence[Finding],
+    timings: dict = None,
 ) -> str:
     return json.dumps(
         {
@@ -47,6 +49,11 @@ def render_json(
             "baselined": [f.to_dict() for f in baselined],
             "counts": dict(Counter(f.rule for f in new)),
             "ok": not new,
+            # per-pass wall time (seconds) — the CI budget gate reads
+            # timings.total; per-family numbers size future optimization.
+            "timings": {
+                k: round(v, 4) for k, v in (timings or {}).items()
+            },
         },
         indent=2,
     )

@@ -21,6 +21,10 @@ from .core import (
     iter_names,
 )
 
+# DYN001-007 run in the per-file FileChecker below; DYN1xx/2xx/3xx are the
+# 2.0 corpus passes (rules_race / rules_taint / rules_schema) built on the
+# dataflow core — one ALL_RULES tuple so --rules and suppressions see one
+# namespace.
 ALL_RULES = (
     "DYN001",
     "DYN002",
@@ -29,6 +33,18 @@ ALL_RULES = (
     "DYN005",
     "DYN006",
     "DYN007",
+    "DYN101",
+    "DYN102",
+    "DYN201",
+    "DYN202",
+    "DYN203",
+    "DYN204",
+    "DYN301",
+    "DYN302",
+    "DYN303",
+    "DYN304",
+    "DYN305",
+    "DYN306",
 )
 
 RULE_TITLES = {
@@ -39,6 +55,18 @@ RULE_TITLES = {
     "DYN005": "coroutine-returning call is never awaited",
     "DYN006": "request ctx/deadline not forwarded to downstream call",
     "DYN007": "host coercion / side effect inside a jitted function",
+    "DYN101": "read-modify-write of shared state spans an await (TOCTOU)",
+    "DYN102": "async lock release not exception-safe (no finally)",
+    "DYN201": "wire-controlled value reaches a Prometheus label unsanitized",
+    "DYN202": "credential-grade wire value reaches a log call",
+    "DYN203": "wire-controlled value reaches a hub key/subject unsanitized",
+    "DYN204": "Prometheus label interpolation not provably sanitized",
+    "DYN301": "wire dataclass field missing from to_dict/from_dict",
+    "DYN302": "optional wire field emitted unconditionally (omit-when-absent)",
+    "DYN303": "from_dict reads a defaulted field with d[...] not .get()",
+    "DYN304": "SequenceState field not threaded through SequenceSnapshot",
+    "DYN305": "setdefault on a nullable wire key (null skips the rewrite)",
+    "DYN306": "pytree treedef stability: frozen prefix / trailing defaults",
 }
 
 # DYN001 — calls that park the whole event loop.  Dotted names only: a bare

@@ -1,0 +1,234 @@
+"""DYN1xx — async-race rules.
+
+The scheduler, hub and adapter registry are single-threaded but NOT
+single-flow: every ``await`` is a scheduling point where another task can
+observe and mutate the same object.  Rust's ``&mut`` makes this class of
+bug unrepresentable in the reference Dynamo; here the linter encodes the
+two shapes that actually bite:
+
+- **DYN101** — a read-modify-write of ``self.<attr>`` / a declared global
+  that *spans* an await without a shared lock: the value (or branch
+  decision) captured before the await is stale by the time the write
+  lands.  The WfqQueue virtual-time and AdapterRegistry refcount idioms
+  are the motivating sites — both are only correct because nothing awaits
+  between read and write (WfqQueue) or because a claim lock covers the
+  span (AdapterRegistry).
+- **DYN102** — ``lock.acquire()`` in async code whose ``release()`` is not
+  in a ``finally``: an exception (or an early return added later) between
+  them leaks the lock and every other task wedges.  ``async with`` makes
+  the hazard unrepresentable; the rule only fires when both calls are in
+  the same function, so cross-function acquire/release protocols (the
+  admission controller) stay out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import CorpusGraph, FunctionUnit, linearize
+from .core import Finding, call_target, dotted_name, make_finding
+from .registry import LOCKISH
+
+RACE_RULES = ("DYN101", "DYN102")
+
+
+def _finding(
+    rule: str, unit: FunctionUnit, node: ast.AST, message: str, lines: List[str]
+) -> Finding:
+    return make_finding(rule, unit.path, unit.qualname, node, message, lines)
+
+
+# ---------------------------------------------------------------------------
+# DYN101
+# ---------------------------------------------------------------------------
+
+
+def _check_dyn101(unit: FunctionUnit, lines: List[str]) -> List[Finding]:
+    events = linearize(unit.node)
+    if not any(e.kind == "await" for e in events):
+        return []
+    findings: List[Finding] = []
+    await_indices = [e.index for e in events if e.kind == "await"]
+    # last read index per key, and local provenance:
+    # local -> (origin state keys, assign index)
+    reads: Dict[str, List[Tuple[int, frozenset]]] = {}
+    provenance: Dict[str, Tuple[Set[str], int]] = {}
+    flagged: Set[Tuple[str, int]] = set()
+
+    def awaits_between(a: int, b: int) -> bool:
+        return any(a < j < b for j in await_indices)
+
+    for e in events:
+        if e.kind == "read" and e.key:
+            reads.setdefault(e.key, []).append((e.index, e.locks))
+        elif e.kind == "assign" and e.key:
+            origins: Set[str] = set()
+            for r in e.value_reads:
+                if "." in r or r.isupper():
+                    origins.add(r)
+                prev = provenance.get(r)
+                if prev is not None:
+                    origins |= prev[0]
+            # state keys read directly by the RHS
+            origins |= {r for r in e.value_reads if r.startswith("self.")}
+            provenance[e.key] = (origins, e.index)
+        elif e.kind == "write" and e.key:
+            key = e.key
+            stale_at: Optional[int] = None
+            why = ""
+            # (a) value provenance: a local derived from `key` assigned
+            #     before an await that precedes this write
+            for r in e.value_reads:
+                prev = provenance.get(r)
+                if (
+                    prev is not None
+                    and key in prev[0]
+                    and awaits_between(prev[1], e.index)
+                ):
+                    stale_at, why = prev[1], f"via local `{r}`"
+                    break
+            # (b) guard provenance: the write sits under an if/while that
+            #     tested `key` before an await.  Writing the CONSTANT None
+            #     is exempt — `if self._task: …cancel(); await …;
+            #     self._task = None` is the project's stop() teardown idiom
+            #     (DYN003's stop-pattern sibling): the cleared value derives
+            #     from nothing stale.  Claims/sets of real values
+            #     (refcounts, lazy-created handles) stay flagged.
+            if stale_at is None and not (
+                isinstance(e.node, ast.Assign)
+                and isinstance(e.node.value, ast.Constant)
+                and e.node.value.value is None
+            ):
+                # A guard on the same key with NO await between it and the
+                # write is a RE-CHECK — the fix idiom the finding itself
+                # recommends ("re-read after the await") — and clears the
+                # hazard for this write.
+                recheck = any(
+                    key in gk and not awaits_between(gi, e.index)
+                    for gk, gi in e.guards
+                )
+                if not recheck:
+                    for guard_keys, guard_idx in e.guards:
+                        if key in guard_keys and awaits_between(
+                            guard_idx, e.index
+                        ):
+                            stale_at, why = guard_idx, "via the guarding test"
+                            break
+            if stale_at is None:
+                continue
+            # shared lock covering both the stale read and the write?
+            read_locks = frozenset()
+            for idx, locks in reads.get(key, []):
+                if idx <= stale_at:
+                    read_locks = locks
+            if read_locks & e.locks:
+                continue
+            dedupe = (key, getattr(e.node, "lineno", 0))
+            if dedupe in flagged:
+                continue
+            flagged.add(dedupe)
+            findings.append(
+                _finding(
+                    "DYN101",
+                    unit,
+                    e.node,
+                    f"read-modify-write of `{key}` spans an await "
+                    f"({why}): another task can mutate it at the "
+                    "suspension point and this write clobbers the update "
+                    "(TOCTOU) — hold one asyncio.Lock across the span or "
+                    "re-read after the await",
+                    lines,
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# DYN102
+# ---------------------------------------------------------------------------
+
+
+def _lock_name(call: ast.Call) -> Optional[str]:
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    base = dotted_name(call.func.value)
+    if base and any(tok in base.lower() for tok in LOCKISH):
+        return base
+    return None
+
+
+def _check_dyn102(unit: FunctionUnit, lines: List[str]) -> List[Finding]:
+    acquires: Dict[str, ast.Call] = {}
+    releases: List[Tuple[str, ast.Call, bool]] = []  # (name, node, in_finally)
+
+    def walk(node: ast.AST, in_finally: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.Try):
+            for s in node.body:
+                walk(s, in_finally)
+            for h in node.handlers:
+                for s in h.body:
+                    walk(s, in_finally)
+            for s in node.orelse:
+                walk(s, in_finally)
+            for s in node.finalbody:
+                walk(s, True)
+            return
+        if isinstance(node, ast.Call):
+            _, tail = call_target(node)
+            name = _lock_name(node)
+            if name is not None:
+                if tail == "acquire":
+                    acquires.setdefault(name, node)
+                elif tail == "release":
+                    releases.append((name, node, in_finally))
+        for child in ast.iter_child_nodes(node):
+            walk(child, in_finally)
+
+    for stmt in unit.node.body:
+        walk(stmt, False)
+
+    findings: List[Finding] = []
+    for name, rel, in_finally in releases:
+        if name in acquires and not in_finally:
+            findings.append(
+                _finding(
+                    "DYN102",
+                    unit,
+                    rel,
+                    f"`{name}.release()` is not in a `finally`: any "
+                    "exception (or a later early return) between acquire "
+                    "and release leaks the lock and wedges every waiter — "
+                    f"use `async with {name}` or move the release into "
+                    "a finally block",
+                    lines,
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+
+def check_race(
+    graph: CorpusGraph,
+    rules: Set[str],
+    lines_of: Dict[str, List[str]],
+    scope: Optional[Set[str]] = None,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for unit in graph.functions:
+        if not unit.is_async:
+            continue
+        if scope is not None and unit.path not in scope:
+            continue
+        lines = lines_of[unit.path]
+        if "DYN101" in rules:
+            findings.extend(_check_dyn101(unit, lines))
+        if "DYN102" in rules:
+            findings.extend(_check_dyn102(unit, lines))
+    return findings

@@ -1,0 +1,493 @@
+"""DYN3xx — wire-schema rules.
+
+Every serialized dataclass in this codebase is an implicit protocol with
+three failure modes the last PRs hit by hand: a field added to the class
+but not the wire dict (PR 6: ``SequenceSnapshot`` missing grammar/adapter
+⇒ migrated streams diverged), an optional field shipped unconditionally
+(breaking omit-when-absent wire compat), and a parse that KeyErrors on
+old-wire dicts.  These checks read the *classes themselves* — no runtime
+round-trip needed:
+
+- **DYN301** — wire-field completeness: every dataclass/NamedTuple field
+  of a wire class appears as a key in ``to_dict`` and is consumed by
+  ``from_dict`` (registry ``WIRE_FIELD_EXEMPT`` for deliberate omissions).
+- **DYN302** — omit-when-absent: in a class that adopted conditional
+  emission (or is registered ``OMIT_WHEN_ABSENT_CLASSES``), every
+  ``Optional=None`` field must be emitted conditionally — pre-existing
+  consumers must never see keys they predate.
+- **DYN303** — parse stability: ``from_dict`` must read DEFAULTED fields
+  with ``d.get(...)``, never ``d["k"]`` — an old-wire dict without the key
+  is valid input by construction.
+- **DYN304** — snapshot threading completeness: every ``SequenceState``
+  field is either mapped into ``SequenceSnapshot`` or explicitly exempted
+  (registry ``SNAPSHOT_COVERED`` / ``SNAPSHOT_EXEMPT``); stale registry
+  entries are findings too, so the map cannot rot.
+- **DYN305** — ``setdefault`` on a nullable wire key: a client-sent
+  ``"nvext": null`` satisfies ``setdefault`` and silently skips the
+  rewrite (the PR 8 bug) — test ``isinstance(..., dict)`` instead.
+- **DYN306** — pytree treedef stability: the registered jit-crossing
+  NamedTuples must keep their frozen field prefix in order with all later
+  fields defaulted — inserting a field recompiles every cached program
+  and breaks wire'd SamplingParams consumers.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import CorpusGraph
+from .core import Finding, call_target, dotted_name, make_finding
+from .registry import (
+    NULLABLE_WIRE_KEYS,
+    OMIT_WHEN_ABSENT_CLASSES,
+    OMIT_WHEN_ABSENT_EXEMPT,
+    SNAPSHOT_CLASS,
+    SNAPSHOT_COVERED,
+    SNAPSHOT_EXEMPT,
+    SNAPSHOT_STATE_CLASS,
+    TREEDEF_FROZEN_PREFIX,
+    WIRE_CLASS_EXEMPT,
+    WIRE_CLASS_EXTRA,
+    WIRE_FIELD_EXEMPT,
+)
+
+SCHEMA_RULES = ("DYN301", "DYN302", "DYN303", "DYN304", "DYN305", "DYN306")
+
+
+@dataclass
+class FieldInfo:
+    name: str
+    has_default: bool
+    optional: bool  # Optional[...] annotation or a None default
+    node: ast.AST
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    path: str
+    node: ast.ClassDef
+    fields: List[FieldInfo] = field(default_factory=list)
+    is_dataclass: bool = False
+    is_namedtuple: bool = False
+    to_dict: Optional[ast.AST] = None
+    from_dict: Optional[ast.AST] = None
+
+
+def _is_optional_ann(ann: Optional[ast.AST]) -> bool:
+    if ann is None:
+        return False
+    if isinstance(ann, ast.Subscript):
+        d = dotted_name(ann.value) or ""
+        if d.split(".")[-1] == "Optional":
+            return True
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        # X | None
+        for side in (ann.left, ann.right):
+            if isinstance(side, ast.Constant) and side.value is None:
+                return True
+    return False
+
+
+def collect_classes(graph: CorpusGraph) -> Dict[str, ClassInfo]:
+    """Name-keyed dataclass/NamedTuple definitions with field lists.  A
+    name defined twice keeps the FIRST definition (fixture corpora are
+    analyzed standalone, so collisions only matter for self-analysis)."""
+    out: Dict[str, ClassInfo] = {}
+    for path, _source, tree in graph.files:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            is_dc = any(
+                (dotted_name(d.func if isinstance(d, ast.Call) else d) or "")
+                .split(".")[-1]
+                == "dataclass"
+                for d in node.decorator_list
+            )
+            is_nt = any(
+                (dotted_name(b) or "").split(".")[-1] == "NamedTuple"
+                for b in node.bases
+            )
+            if not (is_dc or is_nt) and node.name not in WIRE_CLASS_EXTRA:
+                continue
+            info = ClassInfo(
+                name=node.name,
+                path=path,
+                node=node,
+                is_dataclass=is_dc,
+                is_namedtuple=is_nt,
+            )
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    ann_d = (
+                        dotted_name(stmt.annotation) or ""
+                        if stmt.annotation is not None
+                        else ""
+                    )
+                    if ann_d.split(".")[-1] == "ClassVar":
+                        continue
+                    optional = _is_optional_ann(stmt.annotation) or (
+                        isinstance(stmt.value, ast.Constant)
+                        and stmt.value.value is None
+                    )
+                    info.fields.append(
+                        FieldInfo(
+                            name=stmt.target.id,
+                            has_default=stmt.value is not None,
+                            optional=optional,
+                            node=stmt,
+                        )
+                    )
+                elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if stmt.name == "to_dict":
+                        info.to_dict = stmt
+                    elif stmt.name == "from_dict":
+                        info.from_dict = stmt
+            if node.name not in out:
+                out[node.name] = info
+    return out
+
+
+# ---------------------------------------------------------------------------
+# to_dict / from_dict key extraction
+# ---------------------------------------------------------------------------
+
+
+def emitted_keys(fn: ast.AST) -> Tuple[Set[str], Set[str]]:
+    """(all emitted keys, conditionally-emitted keys) of a to_dict body.
+
+    Handles dict literals (including ``**({...} if cond else {})``),
+    ``out["k"] = ...`` assignments (conditional when nested under an If),
+    and ``dict(k=...)`` calls."""
+    keys: Set[str] = set()
+    conditional: Set[str] = set()
+
+    def literal_keys(d: ast.Dict, cond: bool) -> None:
+        for k, v in zip(d.keys, d.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys.add(k.value)
+                if cond:
+                    conditional.add(k.value)
+            elif k is None:
+                # **expansion: {..} if cond else {}, or a nested literal
+                inner = v
+                if isinstance(inner, ast.IfExp):
+                    for side in (inner.body, inner.orelse):
+                        if isinstance(side, ast.Dict):
+                            literal_keys(side, True)
+                elif isinstance(inner, ast.Dict):
+                    literal_keys(inner, cond)
+
+    def walk(node: ast.AST, cond: bool) -> None:
+        if isinstance(node, ast.Dict):
+            literal_keys(node, cond)
+            return
+        if isinstance(node, ast.If):
+            for s in node.body:
+                walk(s, True)
+            for s in node.orelse:
+                walk(s, True)
+            return
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if (
+                isinstance(tgt, ast.Subscript)
+                and isinstance(tgt.slice, ast.Constant)
+                and isinstance(tgt.slice.value, str)
+            ):
+                keys.add(tgt.slice.value)
+                if cond:
+                    conditional.add(tgt.slice.value)
+        if isinstance(node, ast.Call):
+            _, tail = call_target(node)
+            if tail == "update":
+                # d.update(pool=..., delta=...) — kwargs are emitted keys
+                for kw in node.keywords:
+                    if kw.arg is not None:
+                        keys.add(kw.arg)
+                        if cond:
+                            conditional.add(kw.arg)
+        for child in ast.iter_child_nodes(node):
+            walk(child, cond)
+
+    for stmt in fn.body:
+        walk(stmt, False)
+    return keys, conditional
+
+
+def consumed_keys(fn: ast.AST) -> Tuple[Set[str], Set[str], bool]:
+    """(keys read via .get, keys read via subscript, dynamic) in a
+    from_dict body.  ``dynamic`` marks comprehension-style parses —
+    ``cls(**{k: d.get(k) for k in …})`` — which consume every field; the
+    per-key checks stand down for them."""
+    via_get: Set[str] = set()
+    via_sub: Set[str] = set()
+    dynamic = False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            _, tail = call_target(node)
+            if tail == "get" and node.args:
+                k = node.args[0]
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    via_get.add(k.value)
+                else:
+                    dynamic = True  # variable key: iterating the schema
+        elif isinstance(node, ast.Subscript) and isinstance(
+            getattr(node, "ctx", None), ast.Load
+        ):
+            if isinstance(node.slice, ast.Constant) and isinstance(
+                node.slice.value, str
+            ):
+                via_sub.add(node.slice.value)
+    return via_get, via_sub, dynamic
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+
+def _finding(
+    rule: str,
+    path: str,
+    node: ast.AST,
+    symbol: str,
+    message: str,
+    lines_of: Dict[str, List[str]],
+) -> Finding:
+    return make_finding(rule, path, symbol, node, message, lines_of.get(path, []))
+
+
+def check_schema(
+    graph: CorpusGraph,
+    rules: Set[str],
+    lines_of: Dict[str, List[str]],
+) -> List[Finding]:
+    findings: List[Finding] = []
+    classes = collect_classes(graph)
+
+    for cls in classes.values():
+        if cls.name in WIRE_CLASS_EXEMPT:
+            continue
+        is_wire = cls.to_dict is not None or cls.name in WIRE_CLASS_EXTRA
+        if is_wire and cls.to_dict is not None:
+            keys, conditional = emitted_keys(cls.to_dict)
+            field_names = {f.name for f in cls.fields}
+            if "DYN301" in rules:
+                for f in cls.fields:
+                    if (cls.name, f.name) in WIRE_FIELD_EXEMPT:
+                        continue
+                    if f.name not in keys:
+                        findings.append(
+                            _finding(
+                                "DYN301",
+                                cls.path,
+                                f.node,
+                                f"{cls.name}.to_dict",
+                                f"wire field `{f.name}` of `{cls.name}` is "
+                                "never emitted by to_dict() — it silently "
+                                "stops traveling (the SequenceSnapshot "
+                                "PR 6 bug class); emit it or register the "
+                                "exemption in WIRE_FIELD_EXEMPT",
+                                lines_of,
+                            )
+                        )
+            if cls.from_dict is not None and "DYN301" in rules:
+                via_get, via_sub, dynamic = consumed_keys(cls.from_dict)
+                consumed = via_get | via_sub
+                for f in cls.fields:
+                    if dynamic:
+                        break
+                    if (cls.name, f.name) in WIRE_FIELD_EXEMPT:
+                        continue
+                    if f.name in keys and f.name not in consumed:
+                        findings.append(
+                            _finding(
+                                "DYN301",
+                                cls.path,
+                                f.node,
+                                f"{cls.name}.from_dict",
+                                f"wire field `{f.name}` of `{cls.name}` is "
+                                "emitted by to_dict() but never read by "
+                                "from_dict() — round-trips drop it",
+                                lines_of,
+                            )
+                        )
+            if "DYN302" in rules:
+                adopted = bool(conditional) or cls.name in OMIT_WHEN_ABSENT_CLASSES
+                if adopted:
+                    for f in cls.fields:
+                        if not f.optional or f.name not in keys:
+                            continue
+                        if f.name in conditional:
+                            continue
+                        if (cls.name, f.name) in OMIT_WHEN_ABSENT_EXEMPT:
+                            continue
+                        findings.append(
+                            _finding(
+                                "DYN302",
+                                cls.path,
+                                f.node,
+                                f"{cls.name}.to_dict",
+                                f"optional wire field `{f.name}` of "
+                                f"`{cls.name}` is emitted unconditionally "
+                                "but the class ships omit-when-absent — "
+                                "pre-existing consumers must never see "
+                                "keys they predate; emit only when set "
+                                "(or grandfather it in "
+                                "OMIT_WHEN_ABSENT_EXEMPT)",
+                                lines_of,
+                            )
+                        )
+            if cls.from_dict is not None and "DYN303" in rules:
+                _via_get, via_sub, _dynamic = consumed_keys(cls.from_dict)
+                defaulted = {f.name for f in cls.fields if f.has_default}
+                for key in sorted(via_sub & defaulted & field_names):
+                    findings.append(
+                        _finding(
+                            "DYN303",
+                            cls.path,
+                            cls.from_dict,
+                            f"{cls.name}.from_dict",
+                            f"from_dict reads defaulted field `{key}` with "
+                            "d[...] — an old-wire dict without the key is "
+                            "valid input and must parse; use "
+                            f'd.get("{key}", ...) instead',
+                            lines_of,
+                        )
+                    )
+
+        if "DYN306" in rules and cls.name in TREEDEF_FROZEN_PREFIX:
+            frozen = TREEDEF_FROZEN_PREFIX[cls.name]
+            names = [f.name for f in cls.fields]
+            if tuple(names[: len(frozen)]) != frozen:
+                findings.append(
+                    _finding(
+                        "DYN306",
+                        cls.path,
+                        cls.node,
+                        cls.name,
+                        f"pytree class `{cls.name}` no longer starts with "
+                        f"its frozen field prefix {frozen} — inserting/"
+                        "reordering fields changes the jit treedef and "
+                        "recompiles every cached program; append new "
+                        "fields at the end with defaults (and update "
+                        "TREEDEF_FROZEN_PREFIX only on a deliberate "
+                        "compile-break)",
+                        lines_of,
+                    )
+                )
+            else:
+                for f in cls.fields[len(frozen):]:
+                    if not f.has_default:
+                        findings.append(
+                            _finding(
+                                "DYN306",
+                                cls.path,
+                                f.node,
+                                cls.name,
+                                f"field `{f.name}` appended to pytree "
+                                f"class `{cls.name}` has no default — "
+                                "pre-existing constructors (and wire "
+                                "peers) break; trailing fields must "
+                                "default to None",
+                                lines_of,
+                            )
+                        )
+
+    # ----------------------------------------------------------- DYN304
+    if "DYN304" in rules:
+        state = classes.get(SNAPSHOT_STATE_CLASS)
+        snap = classes.get(SNAPSHOT_CLASS)
+        if state is not None and snap is not None:
+            snap_fields = {f.name for f in snap.fields}
+            state_fields = {f.name for f in state.fields}
+            for f in state.fields:
+                if f.name in SNAPSHOT_EXEMPT:
+                    continue
+                target = SNAPSHOT_COVERED.get(f.name)
+                if target is None:
+                    findings.append(
+                        _finding(
+                            "DYN304",
+                            state.path,
+                            f.node,
+                            SNAPSHOT_STATE_CLASS,
+                            f"`{SNAPSHOT_STATE_CLASS}.{f.name}` is neither "
+                            f"mapped into {SNAPSHOT_CLASS} "
+                            "(SNAPSHOT_COVERED) nor exempted "
+                            "(SNAPSHOT_EXEMPT) — a migrated sequence "
+                            "would silently resume without it (the PR 6 "
+                            "grammar/adapter gap); thread it through the "
+                            "snapshot or record why it must not travel",
+                            lines_of,
+                        )
+                    )
+                elif target.split(".")[0] not in snap_fields:
+                    findings.append(
+                        _finding(
+                            "DYN304",
+                            snap.path,
+                            snap.node,
+                            SNAPSHOT_CLASS,
+                            f"SNAPSHOT_COVERED maps "
+                            f"`{SNAPSHOT_STATE_CLASS}.{f.name}` to "
+                            f"`{target}` but `{SNAPSHOT_CLASS}` has no "
+                            f"field `{target.split('.')[0]}` — the "
+                            "registry is stale; fix the map or the class",
+                            lines_of,
+                        )
+                    )
+            # stale registry entries: names that left SequenceState
+            for name in sorted(
+                (set(SNAPSHOT_COVERED) | set(SNAPSHOT_EXEMPT)) - state_fields
+            ):
+                findings.append(
+                    _finding(
+                        "DYN304",
+                        state.path,
+                        state.node,
+                        SNAPSHOT_STATE_CLASS,
+                        f"snapshot registry names `{name}` but "
+                        f"`{SNAPSHOT_STATE_CLASS}` has no such field — "
+                        "delete the stale entry so the map stays "
+                        "trustworthy",
+                        lines_of,
+                    )
+                )
+
+    # ----------------------------------------------------------- DYN305
+    if "DYN305" in rules:
+        for path, _source, tree in graph.files:
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                _, tail = call_target(node)
+                if tail != "setdefault" or not node.args:
+                    continue
+                k = node.args[0]
+                if (
+                    isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                    and k.value in NULLABLE_WIRE_KEYS
+                ):
+                    findings.append(
+                        _finding(
+                            "DYN305",
+                            path,
+                            node,
+                            "<module>",
+                            f'setdefault("{k.value}", ...) on a nullable '
+                            "wire key: a client-sent explicit null "
+                            "satisfies setdefault and the rewrite is "
+                            "silently skipped (the PR 8 `\"nvext\": null` "
+                            "bug) — test isinstance(..., dict) and "
+                            "replace instead",
+                            lines_of,
+                        )
+                    )
+    return findings

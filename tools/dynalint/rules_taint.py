@@ -1,0 +1,243 @@
+"""DYN2xx — wire-taint rules.
+
+PR 8's review pass caught an API key one hop away from a Prometheus label;
+the fix (hash at resolution, escape at render) was manual.  These rules
+make the class mechanical: wire-controlled values (HTTP headers, ``nvext``
+fields, the OpenAI ``model`` field, hub-delivered payloads) must pass a
+sanitizer (``escape_label`` / ``hash_credential`` / hashing / numeric
+coercion — registry.py SANITIZER_TAILS) before reaching:
+
+- **DYN201** — a Prometheus label: ``metric.labels(...)`` arguments and
+  f-string label positions (``…{name="{value}"}…``) in exposition text.
+  Unescaped labels are cardinality bombs and exposition-injection vectors.
+- **DYN202** — a log call, when the taint is CREDENTIAL-grade (API key /
+  bearer token).  Model names in logs are fine; secrets are not.
+- **DYN203** — a hub key/subject (``kv_put``/``queue_push``/…, first
+  argument): un-escaped wire data in a shared-namespace key can escape its
+  prefix.
+- **DYN204** — label hygiene, the dataflow-free backstop: EVERY f-string
+  label interpolation must be a sanitizer call / numeric expression,
+  whether or not taint can be proven (render methods typically read from
+  dicts the dataflow cannot see through).  The fix is to escape at the
+  render site — exactly once: helpers must hand RAW values to the render
+  (escape_label is not idempotent; double-wrapping corrupts the label).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import CorpusGraph, FunctionUnit
+from .core import Finding, call_target, make_finding
+from .dataflow import CREDENTIAL, TaintEvaluator, TaintModel, real_tags
+from .registry import (
+    HUB_KEY_SINK_TAILS,
+    LABEL_HYGIENE_EXEMPT,
+    LABEL_SAFE_CALLS,
+    LABEL_SINK_TAILS,
+    LOG_RECEIVERS,
+    LOG_SINK_TAILS,
+)
+
+TAINT_RULES = ("DYN201", "DYN202", "DYN203", "DYN204")
+
+
+def _finding(
+    rule: str, unit: FunctionUnit, node: ast.AST, message: str, lines: List[str]
+) -> Finding:
+    return make_finding(rule, unit.path, unit.qualname, node, message, lines)
+
+
+# ---------------------------------------------------------------------------
+# label-position detection in f-strings
+# ---------------------------------------------------------------------------
+
+
+def label_values(js: ast.JoinedStr) -> List[ast.FormattedValue]:
+    """FormattedValues sitting in a Prometheus label position: the literal
+    chunk immediately before ends with ``="`` and the exposition shape
+    (``{`` earlier in the literal text) is present.  ``f'..._total{{t="{x}"}} …'``
+    parses to chunks ``…_total{t="`` / ``"}} …`` — the ``{{`` escape is
+    already unescaped in the Constant."""
+    out: List[ast.FormattedValue] = []
+    seen_brace = False
+    prev_literal: Optional[str] = None
+    for v in js.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            if "{" in v.value:
+                seen_brace = True
+            prev_literal = v.value
+        elif isinstance(v, ast.FormattedValue):
+            if seen_brace and prev_literal is not None and prev_literal.endswith('="'):
+                out.append(v)
+            prev_literal = None
+    return out
+
+
+def _is_label_safe(expr: ast.AST, ev: Optional[TaintEvaluator]) -> bool:
+    """Sanitizer call / numeric / constant — safe in a label position."""
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.Call):
+        _, tail = call_target(expr)
+        return tail in LABEL_SAFE_CALLS
+    if isinstance(expr, (ast.BinOp, ast.UnaryOp, ast.Compare)):
+        return True  # arithmetic/boolean — numbers, not wire strings
+    if isinstance(expr, ast.Name) and ev is not None:
+        src = ev.sanitized_names.get(expr.id)
+        if src:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# sink pass
+# ---------------------------------------------------------------------------
+
+
+class _SinkVisitor:
+    def __init__(
+        self,
+        unit: FunctionUnit,
+        rules: Set[str],
+        lines: List[str],
+        findings: List[Finding],
+    ):
+        self.unit = unit
+        self.rules = rules
+        self.lines = lines
+        self.findings = findings
+
+    def __call__(self, stmt: ast.stmt, ev: TaintEvaluator) -> None:
+        # Only this statement's OWN expressions: nested statements get
+        # their own visit after the walker has processed the assignments
+        # between here and there (a sink inside a loop body must see the
+        # loop body's sanitizer assignments in env).
+        stack = [
+            c
+            for c in ast.iter_child_nodes(stmt)
+            if not isinstance(c, (ast.stmt, ast.excepthandler))
+        ]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Call):
+                self._call(node, ev)
+            elif isinstance(node, ast.JoinedStr):
+                self._fstring(node, ev)
+            stack.extend(
+                c
+                for c in ast.iter_child_nodes(node)
+                if not isinstance(c, (ast.stmt, ast.excepthandler))
+            )
+
+    def _call(self, call: ast.Call, ev: TaintEvaluator) -> None:
+        dotted, tail = call_target(call)
+        if tail in LABEL_SINK_TAILS and "DYN201" in self.rules:
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                tags = real_tags(ev.tags(arg))
+                if tags:
+                    self.findings.append(
+                        _finding(
+                            "DYN201",
+                            self.unit,
+                            arg,
+                            "wire-controlled value reaches a Prometheus "
+                            "label via .labels(...) without a sanitizer — "
+                            "escape_label()/hash_credential() it first "
+                            f"(taint: {', '.join(sorted(tags))})",
+                            self.lines,
+                        )
+                    )
+        if (
+            tail in LOG_SINK_TAILS
+            and "DYN202" in self.rules
+            and isinstance(call.func, ast.Attribute)
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id in LOG_RECEIVERS
+        ):
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                if CREDENTIAL in ev.tags(arg):
+                    self.findings.append(
+                        _finding(
+                            "DYN202",
+                            self.unit,
+                            arg,
+                            "credential-grade wire value (API key / bearer "
+                            "token) reaches a log call — hash_credential() "
+                            "at resolution; raw secrets must never be "
+                            "logged",
+                            self.lines,
+                        )
+                    )
+        if tail in HUB_KEY_SINK_TAILS and "DYN203" in self.rules and call.args:
+            tags = real_tags(ev.tags(call.args[0]))
+            if tags:
+                self.findings.append(
+                    _finding(
+                        "DYN203",
+                        self.unit,
+                        call.args[0],
+                        f"wire-controlled value formatted into a hub "
+                        f"key/subject (`{tail}`) without a sanitizer — a "
+                        "crafted id ('../', spaces) escapes its namespace "
+                        "prefix; hash or escape it first "
+                        f"(taint: {', '.join(sorted(tags))})",
+                        self.lines,
+                    )
+                )
+
+    def _fstring(self, js: ast.JoinedStr, ev: TaintEvaluator) -> None:
+        for fv in label_values(js):
+            tags = real_tags(ev.tags(fv.value))
+            if tags and "DYN201" in self.rules:
+                self.findings.append(
+                    _finding(
+                        "DYN201",
+                        self.unit,
+                        fv.value,
+                        "wire-controlled value interpolated into a "
+                        "Prometheus label position without a sanitizer — "
+                        "wrap in escape_label() "
+                        f"(taint: {', '.join(sorted(tags))})",
+                        self.lines,
+                    )
+                )
+                continue
+            if "DYN204" not in self.rules:
+                continue
+            if fv.format_spec is not None:
+                continue  # numeric format specs ({p:.4f}) render numbers
+            if _is_label_safe(fv.value, ev):
+                continue
+            if (self.unit.path, self.unit.qualname) in LABEL_HYGIENE_EXEMPT:
+                continue
+            self.findings.append(
+                _finding(
+                    "DYN204",
+                    self.unit,
+                    fv.value,
+                    "f-string Prometheus label interpolation is not "
+                    "provably sanitized — escape_label() it HERE at the "
+                    "render site (exactly once: upstream helpers must hand "
+                    "raw values; registry LABEL_HYGIENE_EXEMPT for the "
+                    "rare provably-internal case)",
+                    self.lines,
+                )
+            )
+
+
+def check_taint(
+    graph: CorpusGraph,
+    model: TaintModel,
+    rules: Set[str],
+    lines_of: Dict[str, List[str]],
+    scope: Optional[Set[str]] = None,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for unit in graph.functions:
+        if scope is not None and unit.path not in scope:
+            continue
+        visitor = _SinkVisitor(unit, rules, lines_of[unit.path], findings)
+        model.walk_function(unit, symbolic_params=False, visit=visitor)
+    return findings
