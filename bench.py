@@ -267,6 +267,138 @@ def _spec_bench(cfg, model_cfg) -> None:
     )
 
 
+def _churn_bench(cfg, model_cfg) -> None:
+    """BENCH_CHURN=1: continuous-batching churn trace — rows finishing at
+    staggered lengths plus late arrivals landing inside a live fused
+    session — run with in-loop admission/retirement ON (default) and OFF
+    (``_continuous_decode = False``, the legacy drain-on-any-change
+    control).  Asserts byte-identical token streams and zero new compiles,
+    then prints one JSON line with rebuild counts, in-loop churn counters,
+    host-gap fraction and per-kind dispatch percentiles — the CI smoke
+    (tools/ci.sh) bars on it.  Env: BENCH_CHURN_ISL / BENCH_CHURN_REQUESTS.
+    """
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.llm.protocols import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context, collect
+
+    isl = int(os.environ.get("BENCH_CHURN_ISL", "24"))
+    n = int(os.environ.get("BENCH_CHURN_REQUESTS", "10"))
+    vocab = model_cfg.vocab_size
+    results: dict = {}
+
+    async def run_mode(continuous: bool) -> None:
+        engine = TpuEngine(cfg)
+        engine._continuous_decode = continuous
+        compiles0 = engine.warmup()
+        try:
+
+            async def one(i: int, osl: int, late: bool):
+                if late:
+                    # Land INSIDE a live fused session, not merely "later":
+                    # wait until the pipeline actually has members (both
+                    # modes use the same trigger, so the traces compare).
+                    for _ in range(2000):
+                        if engine._pipeline_members:
+                            break
+                        await asyncio.sleep(0.002)
+                prompt = [(i * 7919 + j * 104729) % vocab for j in range(isl)]
+                req = PreprocessedRequest(
+                    token_ids=prompt,
+                    stop_conditions=StopConditions(
+                        max_tokens=osl, ignore_eos=True
+                    ),
+                    sampling_options=SamplingOptions(
+                        temperature=0.9, seed=i + 1
+                    ),
+                )
+                items = await collect(
+                    await engine.generate(Context(req.to_dict()))
+                )
+                return [t for it in items for t in it["token_ids"]]
+
+            jobs = []
+            for i in range(n):
+                # Staggered budgets: short rows retire while long ones keep
+                # the session alive; the back half arrives late.
+                late = i >= (n + 1) // 2
+                osl = (16 + 8 * (i % 3)) if not late else (6 + 3 * (i % 4))
+                jobs.append(one(i, osl, late))
+            t0 = time.perf_counter()
+            streams = await asyncio.gather(*jobs)
+            dt = time.perf_counter() - t0
+            results[continuous] = {
+                "streams": streams,
+                "tok_s": sum(len(s) for s in streams) / dt,
+                "compiles_stable": engine.compile_counts() == compiles0,
+                "summary": engine.dispatch_summary(),
+            }
+        finally:
+            await engine.close()
+
+    for mode in (True, False):
+        # One asyncio.run per engine: its queues/events bind to the loop.
+        asyncio.run(run_mode(mode))
+    on, off = results[True], results[False]
+    if on["streams"] != off["streams"]:
+        raise RuntimeError(
+            "continuous batching changed the token streams — the "
+            "exact-stream equivalence invariant is broken"
+        )
+    print("bench[churn]: token streams identical on/off", file=sys.stderr)
+    pipe_on, pipe_off = on["summary"]["pipeline"], off["summary"]["pipeline"]
+    for mode, r, pipe in (("on", on, pipe_on), ("off", off, pipe_off)):
+        print(
+            f"bench[churn]: continuous={mode} {r['tok_s']:.1f} tok/s "
+            f"sessions={pipe['sessions']} rebuilds={pipe['rebuilds']} "
+            f"admissions={pipe['continuous_admissions']} "
+            f"retired={pipe['continuous_retired']} "
+            f"host_gap={pipe['host_gap_frac']}",
+            file=sys.stderr,
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "continuous_decode_rebuilds",
+                "value": pipe_on["rebuilds"],
+                "unit": "rebuilds",
+                "vs_baseline": round(
+                    pipe_on["rebuilds"] / max(1, pipe_off["rebuilds"]), 3
+                ),
+                "rebuilds": {
+                    "continuous": pipe_on["rebuilds"],
+                    "forced": pipe_off["rebuilds"],
+                },
+                "sessions": {
+                    "continuous": pipe_on["sessions"],
+                    "forced": pipe_off["sessions"],
+                },
+                "continuous_admissions": pipe_on["continuous_admissions"],
+                "continuous_retired": pipe_on["continuous_retired"],
+                "host_gap_frac": pipe_on["host_gap_frac"],
+                "compile_counts_stable": bool(
+                    on["compiles_stable"] and off["compiles_stable"]
+                ),
+                "dispatch": {
+                    k: {
+                        "dispatches": v["dispatches"],
+                        "p50_ms": v["p50_ms"],
+                        "p99_ms": v["p99_ms"],
+                    }
+                    for k, v in on["summary"]["kinds"].items()
+                },
+                "tok_s": {
+                    "continuous": round(on["tok_s"], 2),
+                    "forced": round(off["tok_s"], 2),
+                },
+            }
+        )
+    )
+
+
 def main() -> None:
     from dynamo_tpu.engine.engine import TpuEngine
     from dynamo_tpu.models import get_config
@@ -301,6 +433,11 @@ def main() -> None:
         # Speculative-decoding mode: repetitive + random workloads, spec
         # off vs on, stream-identity asserted (see _spec_bench).
         _spec_bench(cfg, model_cfg)
+        return
+    if os.environ.get("BENCH_CHURN"):
+        # Continuous-batching churn mode: staggered finishes + late
+        # arrivals, continuous vs forced-rebuild (see _churn_bench).
+        _churn_bench(cfg, model_cfg)
         return
     engine = TpuEngine(cfg)
 
@@ -359,12 +496,17 @@ def main() -> None:
         )
         return
 
+    extras: dict = {}
+
     async def bench() -> float:
         # Short warm pass at the timed run's concurrency (host-path warmup;
         # all device programs are already compiled above).
         await _run(engine, wl["isl"], 4, wl["requests"], model_cfg.vocab_size)
         baseline_compiles = engine.compile_counts()
-        engine.step_trace.clear()
+        # Scope the trace, session counters AND host-gap accounting to the
+        # timed window together — mixed warm-pass counters would make the
+        # JSON's pipeline block internally inconsistent.
+        engine.reset_dispatch_stats()
         t0 = time.perf_counter()
         total = await _run(
             engine, wl["isl"], wl["osl"], wl["requests"], model_cfg.vocab_size
@@ -378,6 +520,7 @@ def main() -> None:
             )
         print(f"bench: compile counts stable at {after}", file=sys.stderr)
         summary = engine.step_summary()
+        dispatch = engine.dispatch_summary()
         await engine.close()
         print(
             f"bench: {total} output tokens in {dt:.2f}s "
@@ -410,6 +553,23 @@ def main() -> None:
             f"bench: ~{n_params/1e9:.2f}B params, decode MFU {mfu*100:.2f}%{note}",
             file=sys.stderr,
         )
+        # Machine-readable trajectory (ISSUE 11): until now only tok/s was
+        # parseable and the ROADMAP quoted MFU/host-gap by hand from stderr.
+        extras.update(
+            {
+                "decode_mfu": round(mfu, 4),
+                "host_gap_frac": round(max(0.0, dt - device_s) / dt, 4),
+                "dispatch": {
+                    k: {
+                        "dispatches": v["dispatches"],
+                        "p50_ms": v["p50_ms"],
+                        "p99_ms": v["p99_ms"],
+                    }
+                    for k, v in summary.items()
+                },
+                "pipeline": dispatch["pipeline"],
+            }
+        )
         return total / dt
 
     tps = asyncio.run(bench())
@@ -441,6 +601,7 @@ def main() -> None:
                 "value": round(tps, 2),
                 "unit": "tokens/s",
                 "vs_baseline": round(tps / prior, 3) if prior > 0 else 1.0,
+                **extras,
             }
         )
     )

@@ -260,6 +260,12 @@ async def _run(args) -> None:
             if hasattr(engine, "metrics")
             else None
         )
+        # ... and its decode-dispatch health to /metrics
+        # (dynamo_tpu_engine_dispatch_*; llm/metrics.py).
+        if hasattr(engine, "dispatch_summary"):
+            from .llm.metrics import engine_dispatch_metrics
+
+            engine_dispatch_metrics.set_source(engine.dispatch_summary)
         service = HttpService(
             host=args.host, port=args.port,
             qos=_edge_qos(args), kv_usage_fn=kv_usage_fn,

@@ -155,9 +155,27 @@ class TpuEngine(
         # mid-concurrency wall time.
         self._pending_fetches: List[Tuple] = []
         # Request ids with fused-pipeline dispatches potentially in flight
-        # (set for the duration of each _decode_pipeline run); live
-        # migration's freeze waits until its sequence leaves this set.
+        # (maintained DYNAMICALLY across each _decode_pipeline session —
+        # continuous admission adds ids as sequences join, retirement
+        # removes them once the write barrier passes); live migration's
+        # freeze waits until its sequence leaves this set.
         self._pipeline_members: set = set()
+        # Continuous-batching pipeline health (engine/pipeline.py): how
+        # often fused sessions start/drain, and how much membership churn
+        # the in-loop paths absorbed without a drain.  Exported on /metrics
+        # as dynamo_tpu_engine_dispatch_* (llm/metrics.py) and folded into
+        # the bench JSON.
+        self.pipeline_sessions = 0       # _decode_pipeline runs begun
+        self.pipeline_rebuilds = 0       # sessions drained by a rebuild event
+        self.continuous_admissions = 0   # sequences admitted in-loop
+        self.continuous_retired = 0      # rows retired in-loop (no drain)
+        self.pipeline_wall_s = 0.0       # cumulative fused-session wall
+        # Device-busy wall accumulated INSIDE fused sessions (decode
+        # dispatch/wait + interleaved admission-prefill steps).  Unbounded
+        # like pipeline_wall_s — host_gap_frac must never be derived from
+        # the BOUNDED step_trace, whose eviction after 65k entries would
+        # drift the ratio toward 1.0 on a long-lived server.
+        self.decode_busy_s = 0.0
         # Multi-tenancy (llm/tenancy): LoRA adapter registry (None = LoRA
         # disabled), optional served-model allowlist (unknown names →
         # ModelNotFoundError → 404 at the edge), and the deserialized
@@ -435,6 +453,15 @@ class TpuEngine(
             self._sp_fn = jax.jit(_sp)
         else:
             self._sp_fn = None
+        # copy_to_host_async capability, probed ONCE on a real device array:
+        # the per-dispatch ``except AttributeError: pass`` it replaces could
+        # mask a genuine attribute error raised INSIDE the logprobs D2H path
+        # (a renamed SampleOut field, a None leaf) — silently degrading
+        # every fetch to a synchronous round trip instead of failing loudly
+        # (engine/pipeline.py _start_d2h).
+        self._copy_async = hasattr(
+            jnp.zeros((1,), jnp.int32), "copy_to_host_async"
+        )
         # Cached all-zeros penalty-counts buffer (see _sampling_arrays).
         self._zero_counts = jnp.zeros(
             (S, self.model_config.vocab_size), jnp.int16
@@ -1300,5 +1327,48 @@ class TpuEngine(
                 "p99_ms": round(times[min(m - 1, int(m * 0.99))] * 1e3, 2),
             }
         return out
+
+    def reset_dispatch_stats(self) -> None:
+        """Zero the dispatch trace AND the session counters together (the
+        bench's timed window): mixing warm-pass counters with timed-window
+        wall time would make rebuilds-per-session vs wall_s internally
+        inconsistent in BENCH_r*.json."""
+        self.step_trace.clear()
+        self.pipeline_sessions = 0
+        self.pipeline_rebuilds = 0
+        self.continuous_admissions = 0
+        self.continuous_retired = 0
+        self.pipeline_wall_s = 0.0
+        self.decode_busy_s = 0.0
+
+    def dispatch_summary(self) -> Dict[str, Any]:
+        """Machine-readable decode-pipeline health: the per-kind dispatch
+        trace (step_summary — over the BOUNDED trace window, so its counts
+        and percentiles are gauges, not counters) plus session/rebuild/
+        churn counters and the fused-loop host-gap fraction — what the
+        planner and bench read off ``/metrics`` (llm/metrics.py
+        engine_dispatch_metrics) instead of parsing bench stderr.
+
+        ``host_gap_frac`` is scoped to fused decode sessions: the fraction
+        of pipeline wall NOT covered by in-session device work (decode
+        dispatch/wait + the interleaved admission-prefill steps) — the
+        host-side planning/accept share the continuous pipeline exists to
+        shrink.  Both terms accumulate unbounded (never derived from the
+        bounded trace).  0.0 when no session has run."""
+        wall = self.pipeline_wall_s
+        gap = (
+            max(0.0, wall - self.decode_busy_s) / wall if wall > 0 else 0.0
+        )
+        return {
+            "kinds": self.step_summary(),
+            "pipeline": {
+                "sessions": self.pipeline_sessions,
+                "rebuilds": self.pipeline_rebuilds,
+                "continuous_admissions": self.continuous_admissions,
+                "continuous_retired": self.continuous_retired,
+                "wall_s": round(wall, 4),
+                "host_gap_frac": round(gap, 4),
+            },
+        }
 
 

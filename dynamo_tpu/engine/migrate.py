@@ -127,10 +127,17 @@ class MigrationMixin:
         self, request_id: str, timeout: float = 10.0
     ) -> Optional[SequenceState]:
         """Stop planning ``request_id`` and wait until no in-flight dispatch
-        can still advance it (deferred fetches harvested, fused pipeline
-        drained).  Returns the quiescent SequenceState, or None if the
-        sequence is gone/finished or quiescence didn't land in ``timeout``
-        (the flag is cleared again — the sequence keeps decoding)."""
+        can still advance it (deferred fetches harvested; fused-pipeline
+        membership released).  Under the continuous pipeline
+        (docs/decode_pipeline.md) the frozen row is parked OUT of a live
+        fused session at its write barrier — ``_pipeline_members`` drops
+        the id a few chunks later while the session keeps fusing for
+        everyone else, and any not-yet-harvested chunk tokens for the row
+        are dropped (recomputed identically on resume: seeded sampler), so
+        the snapshot frontier always equals the emitted stream.  Returns
+        the quiescent SequenceState, or None if the sequence is
+        gone/finished or quiescence didn't land in ``timeout`` (the flag
+        is cleared again — the sequence keeps decoding)."""
         seq = self.find_sequence(request_id)
         if seq is None or seq.finished:
             return None

@@ -24,7 +24,7 @@ from collections import deque
 from ..llm.metrics import tenancy_metrics
 from ..llm.protocols import FinishReason, LLMEngineOutput
 from ..ops.sampling import SamplingParams
-from .scheduler import SequenceState, StepPlan
+from .scheduler import RowSlots, SequenceState, StepPlan
 from ..models.llama import RaggedBatch
 
 _FINISHED = object()  # queue sentinel (engine.py imports this)
@@ -34,10 +34,32 @@ class DecodePipelineMixin:
     # Numpy fast path for per-chunk token acceptance (_accept_chunk); tests
     # flip this off to prove equivalence against the scalar loop.
     _vectorized_accept = True
+    # Continuous batching in the fused decode loop: retire finished rows and
+    # admit waiting sequences between chunk dispatches instead of draining
+    # the whole pipeline on every membership change.  Tests and the churn
+    # bench flip this off to run the legacy drain-on-any-change behaviour
+    # as the exact-stream control (both modes are token-identical; only the
+    # scheduling shape differs).
+    _continuous_decode = True
+
+    def _start_d2h(self, out, need_lp: bool) -> None:
+        """Start the sampled-output device→host copies for a dispatched
+        step.  Capability is probed ONCE at engine init (``_copy_async``,
+        engine.py): the per-dispatch ``except AttributeError: pass`` this
+        replaces could mask a real attribute error raised inside the
+        logprobs path (a renamed SampleOut field would silently turn every
+        fetch into a synchronous round trip instead of failing loudly)."""
+        if not self._copy_async:
+            return
+        out.tokens.copy_to_host_async()
+        if need_lp:
+            out.logprob.copy_to_host_async()
+            out.top_ids.copy_to_host_async()
+            out.top_logprobs.copy_to_host_async()
 
     def _sampling_arrays(
         self,
-        seqs: List[SequenceState],
+        seqs: List[Optional[SequenceState]],
         step_offsets: Optional[List[int]] = None,
         grammar_states: Optional[List[Optional[int]]] = None,
     ) -> SamplingParams:
@@ -69,7 +91,12 @@ class DecodePipelineMixin:
         ppen = np.zeros((S,), np.float32)
         need_lp = False
         any_pen = False
+        # ``seqs[i] is None`` marks a free/retired row slot (the continuous
+        # decode pipeline passes its RowSlots.rows directly): the row keeps
+        # the same greedy defaults as padding rows past len(seqs).
         for i, seq in enumerate(seqs):
+            if seq is None:
+                continue
             seeds[i] = seq.sampling_seed
             steps[i] = seq.num_output_tokens + (
                 step_offsets[i] if step_offsets is not None else 0
@@ -84,6 +111,8 @@ class DecodePipelineMixin:
         if any_pen:
             counts_np = np.zeros((S, V), np.int16)
             for i, seq in enumerate(seqs):
+                if seq is None:
+                    continue
                 # Generated tokens since the ORIGINAL prompt: preemption and
                 # migration-resume fold output into ``prompt``, and counting
                 # ``output`` alone would silently drop the folded tokens'
@@ -105,7 +134,8 @@ class DecodePipelineMixin:
         masked_rows = [
             i
             for i, seq in enumerate(seqs)
-            if seq.grammar is not None
+            if seq is not None
+            and seq.grammar is not None
             and (grammar_states is None or grammar_states[i] != -1)
         ]
         if masked_rows:
@@ -131,7 +161,8 @@ class DecodePipelineMixin:
         if self._lora_registry is not None:
             aslots: Any = np.full((S,), -1, np.int32)
             for i, seq in enumerate(seqs):
-                aslots[i] = seq.adapter_slot
+                if seq is not None:
+                    aslots[i] = seq.adapter_slot
         else:
             aslots = None
         return SamplingParams(
@@ -235,14 +266,7 @@ class DecodePipelineMixin:
             if need_tokens:
                 # Start the D2H now; the accept is deferred to a harvest
                 # point so the round trip overlaps later dispatches.
-                try:
-                    out.tokens.copy_to_host_async()
-                    if need_lp:
-                        out.logprob.copy_to_host_async()
-                        out.top_ids.copy_to_host_async()
-                        out.top_logprobs.copy_to_host_async()
-                except AttributeError:
-                    pass
+                self._start_d2h(out, need_lp)
             return out
 
         t0 = time.perf_counter()
@@ -284,24 +308,29 @@ class DecodePipelineMixin:
         if pending_rows:
             self._stash_fetch("first", out, need_lp, pending_rows)
 
+    @staticmethod
+    def _fetch_outs(out, need_lp: bool):
+        """Materialize a step's sampled outputs on host (ONE definition of
+        the SampleOut fetch shape — the stash path and the fused pipeline
+        both use it, so a payload change cannot silently diverge them)."""
+        if need_lp:
+            return (
+                np.asarray(out.tokens),
+                np.asarray(out.logprob),
+                np.asarray(out.top_ids),
+                np.asarray(out.top_logprobs),
+            )
+        return np.asarray(out.tokens), None, None, None
+
     def _stash_fetch(self, kind: str, out, need_lp: bool, *meta) -> None:
         """Park a dispatched step's token fetch: the np.asarray runs on a
         worker thread STARTING NOW (the D2H was already initiated with
         copy_to_host_async), and the loop applies the result at a harvest
         point once the task completes — the device round trip never blocks
         dispatching."""
-
-        def fetch():
-            if need_lp:
-                return (
-                    np.asarray(out.tokens),
-                    np.asarray(out.logprob),
-                    np.asarray(out.top_ids),
-                    np.asarray(out.top_logprobs),
-                )
-            return np.asarray(out.tokens), None, None, None
-
-        task = asyncio.get_running_loop().create_task(asyncio.to_thread(fetch))
+        task = asyncio.get_running_loop().create_task(
+            asyncio.to_thread(self._fetch_outs, out, need_lp)
+        )
         self._pending_fetches.append((kind, task, *meta))
 
     async def _harvest_pending(self, all_pending: bool = False) -> None:
@@ -335,222 +364,528 @@ class DecodePipelineMixin:
                 self._harvest_spec(entry, sampled, logp, top_ids, top_lp)
             else:  # burst
                 members, pos0 = entry[2], entry[3]
+                chained = entry[4] if len(entry) > 4 else False
                 finished: List[SequenceState] = []
                 self._accept_chunk(
                     members, pos0, sampled, logp, top_ids, top_lp, finished
                 )
-                for seq in finished:
-                    self.scheduler.remove(seq)
+                if chained:
+                    # A chained burst chunk for these rows is still in
+                    # flight (_decode_burst's pipelined shape): keep them
+                    # parked — freeze_sequence's quiescence poll must see
+                    # the in-flight tokens — and defer removals to the
+                    # final chunk's harvest, so no member's blocks are
+                    # freed while a dispatch that writes them is in flight.
+                    for seq in members:
+                        if not seq.finished:
+                            seq.awaiting_fetch = True
+                else:
+                    # Sweep by flag, not the local ``finished`` list: a row
+                    # that stopped in the FIRST chunk of a chained burst is
+                    # skipped by this chunk's accept and must still be
+                    # removed here.
+                    for seq in members:
+                        if seq.finished and any(
+                            s is seq for s in self.scheduler.running
+                        ):
+                            self.scheduler.remove(seq)
             if not all_pending:
                 break
 
     async def _decode_pipeline(self, members: List[SequenceState]) -> bool:
-        """Steady-state decode: fused multi-step dispatches with the token
-        carry on device, up to cfg.pipeline_depth dispatches in flight, host
-        readback overlapped.  Runs until membership must change (a sequence
-        finished/cancelled, a new request arrived, or blocks ran out), then
-        drains in-flight work before returning so the scheduler can rebuild.
+        """Continuous fused decode: multi-step dispatches with the token
+        carry on device, up to cfg.pipeline_depth dispatches in flight,
+        host readback overlapped — and CONTINUOUS membership:
+
+        - **In-loop retirement**: a row that stops (or whose client
+          cancels) is excluded from further dispatches immediately
+          (``pos_disp = -1``) and its slot + KV blocks are released once
+          the write barrier passes — every chunk dispatched while it was
+          active has been harvested — while the session keeps fusing for
+          everyone else.
+        - **In-loop admission**: compatible waiting sequences are admitted
+          into free row slots mid-session; their prompts prefill through
+          ordinary unified steps INTERLEAVED between fused chunks (the
+          fused cadence never stops), and once the first token lands they
+          join the chain at the next chain-break merge — a drain of
+          in-flight chunks only, never an exit to the scheduler and the
+          mixed-phase single-step regime.
+        - **Double-buffered dispatch**: the oldest chunk's token fetch runs
+          in a worker thread while the next chunk's host-side planning
+          (slot ensure, table rows), the admission prefill dispatch and
+          completed first-token harvests all proceed — the host never
+          plans on the critical path (``decode_wait`` measures device
+          compute, not host work).
+
+        ``want_rebuild`` fires only for genuinely incompatible changes:
+        engine close, a frozen (mid-migration) row, a waiting head the
+        fused loop cannot host (grammar-constrained), KV exhaustion, or a
+        speculation-session flip.  Everything else is absorbed in-loop.
+
+        Exactness: samples depend only on (seed, rng-step, committed
+        prefix), and a chain-break merge re-seeds the device carry with
+        exactly the values it already holds — so continuous and
+        drain-rebuild scheduling produce byte-identical streams at any
+        temperature (tests/test_continuous_batching.py gates it, spec
+        on/off; ``_continuous_decode = False`` is the legacy control).
 
         Invariant: no member's KV blocks are freed while any dispatch that
-        writes them is in flight — finishes are deferred to the drain point.
+        writes them is in flight — retirement defers the release to the
+        per-row write barrier (the legacy path deferred ALL finishes to
+        the full drain).
         """
         cfg = self.cfg
         bs = cfg.block_size
         S, T = cfg.max_batch, cfg.decode_steps
-        n = len(members)
-        # Visible to freeze_sequence (engine/migrate.py): a member may have
-        # fused chunks in flight until this pipeline run drains and returns.
+        continuous = self._continuous_decode
+        # Visible to freeze_sequence (engine/migrate.py) BEFORE the first
+        # suspension point; maintained as membership changes below.
         self._pipeline_members = {s.request_id for s in members}
-
-        tok0 = np.zeros((S,), np.int32)
-        pos_disp = np.full((S,), -1, np.int32)  # dispatch frontier (-1 = pad)
-        for i, seq in enumerate(members):
-            all_toks = seq.prompt + seq.output
-            tok0[i] = all_toks[seq.num_computed]
-            pos_disp[i] = seq.num_computed
-        tables = np.zeros((S, cfg.max_blocks_per_seq), np.int32)
-        for i, seq in enumerate(members):
-            self._tables_row(tables, i, seq)
-        samp = self._sampling_arrays(members)
-        # Host copy only needed for the follower broadcast — np.asarray on
-        # samp.counts would otherwise drag the [S, V] device buffer to host
-        # on every pipeline build.
-        samp_np = (
-            jax.tree_util.tree_map(np.asarray, samp)
-            if self._publisher is not None
-            else None
-        )
-        need_lp = bool(samp.need_logprobs)
-        # (token, rng-step, penalty-counts) carry: numpy seeds for the first
-        # dispatch, then the previous dispatch's on-device outputs.
-        carry: Optional[Tuple[Any, Any, Any]] = None
+        self.pipeline_sessions += 1
+        session_t0 = time.perf_counter()
         multi = self._multi_fn
 
-        inflight: deque = deque()
-        finished_members: List[SequenceState] = []
+        tok0 = np.zeros((S,), np.int32)
+        pos_disp = np.full((S,), -1, np.int32)  # dispatch frontier (-1 = free)
+        tables = np.zeros((S, cfg.max_blocks_per_seq), np.int32)
+        limits = np.zeros((S,), np.int32)
+        slots = RowSlots(S)
+        samp: Optional[SamplingParams] = None
+        samp_np: Any = None
+        need_lp = False
+        # (token, rng-step, penalty-counts) carry: host seeds at each chain
+        # break, then the previous dispatch's on-device outputs.
+        carry: Optional[Tuple[Any, Any, Any]] = None
+
+        inflight: deque = deque()  # (outs, pos0, chunk_id, need_lp)
+        chunk_id = 0   # monotone dispatch counter — the write-barrier clock
+        harvested = 0  # highest chunk id applied so far
+        # (seq, slot, barrier, remove): remove=False parks a FROZEN row out
+        # of the session (migration quiescence) without releasing it from
+        # the scheduler — the row stays resident, just unplanned.
+        retired: List[Tuple[SequenceState, int, int, bool]] = []
+        prefilling: List[SequenceState] = []  # admitted in-loop, prompt computing
+        # Sequences joining the fused chain at the next chain-break merge.
+        # The INITIAL members seed through the same merge: one code path
+        # for session start and mid-session joins.
+        ready: List[SequenceState] = list(members)
         rebuild = False
         dispatched_any = False
 
-        def want_rebuild() -> bool:
-            # Waiting requests only force a rebuild when one could actually
-            # be ADMITTED (free slot + blocks).  At oversubscription the
-            # queue is never empty; gating on num_waiting alone would keep
-            # the fused pipeline permanently disabled (round-3 saturation
-            # collapse: conc 32 throughput below conc 16).
-            return (
-                self._closed
-                or self.scheduler.admission_ready()
-                or any(s.finished or s.frozen for s in members)
-                or any(
-                    (c := self._contexts.get(s.request_id)) is not None
-                    and c.is_stopped
-                    for s in members
-                )
+        def merge_ready() -> None:
+            """Chain-break merge: assign slots to joining sequences and
+            re-seed the whole chain from host state.  Only legal with
+            nothing in flight — exactly then the continuing rows' frontier
+            tokens are host-known (accepted == dispatched), and the host
+            (steps, counts) equal the device carry they replace."""
+            nonlocal samp, samp_np, need_lp, carry
+            for seq in ready:
+                slots.assign(seq)
+            ready.clear()
+            for i, seq in slots.active():
+                all_toks = seq.prompt + seq.output
+                tok0[i] = all_toks[seq.num_computed]
+                # Rows whose frontier overshot a wall earlier re-dispatch
+                # those positions; the recomputed (seeded) samples are
+                # identical — same as a full rebuild.
+                pos_disp[i] = seq.num_computed
+            samp = self._sampling_arrays(slots.rows)
+            # Host copy only needed for the follower broadcast — np.asarray
+            # on samp.counts would otherwise drag the [S, V] device buffer
+            # to host on every merge.
+            samp_np = (
+                jax.tree_util.tree_map(np.asarray, samp)
+                if self._publisher is not None
+                else None
             )
+            need_lp = bool(samp.need_logprobs)
+            carry = None  # next dispatch re-seeds (tok, steps, counts)
+
+        def sweep_retire() -> int:
+            """Retire finished (and, in continuous mode, client-cancelled
+            and migration-frozen) rows: excluded from future dispatches
+            NOW; slot (+ blocks, unless frozen) released once the write
+            barrier passes."""
+            m = 0
+            for i, seq in slots.active():
+                if continuous and not seq.finished:
+                    c = self._contexts.get(seq.request_id)
+                    if c is not None and c.is_stopped:
+                        # In-loop cancellation IS retirement — the stream
+                        # is dead; nobody needs a whole-pipeline drain.
+                        seq.finished = True
+                        self._finish(seq, FinishReason.CANCELLED)
+                if seq.finished:
+                    slots.retire(i)
+                    pos_disp[i] = -1
+                    retired.append((seq, i, chunk_id, True))
+                    if continuous:
+                        self.continuous_retired += 1
+                    m += 1
+                elif continuous and seq.frozen:
+                    # Migration freeze: park the row OUT of the session.
+                    # Its slot goes None, so any not-yet-harvested chunk
+                    # tokens for the row are DROPPED at accept (recomputed
+                    # identically on resume — seeded sampler), keeping the
+                    # snapshot frontier equal to the emitted stream; the
+                    # barrier hands quiescence to freeze_sequence via the
+                    # _pipeline_members discard — the session keeps fusing
+                    # for everyone else.  Legacy mode drains instead
+                    # (want_rebuild).
+                    slots.retire(i)
+                    pos_disp[i] = -1
+                    retired.append((seq, i, chunk_id, False))
+                    m += 1
+            return m
+
+        def flush_retired() -> None:
+            """Release retirements whose write barrier has passed: every
+            chunk dispatched while the row was active has been harvested,
+            so nothing in flight can still write its blocks (or, for a
+            frozen row, still advance it — quiescence)."""
+            while retired and retired[0][2] <= harvested:
+                seq, i, _, remove = retired.pop(0)
+                if remove:
+                    self.scheduler.remove(seq)
+                self._pipeline_members.discard(seq.request_id)
+                slots.free(i)
+
+        def rejoin_strays() -> None:
+            """Running decode rows OUTSIDE the session rejoin at the next
+            chain break — a migration rollback's unfreeze is the one way a
+            planned row falls out of membership, and with long-lived
+            continuous sessions it would otherwise starve until the
+            session ends (legacy sessions rebuilt constantly, so schedule()
+            picked such rows up within a few chunks)."""
+            nonlocal rebuild
+            known = (
+                slots.num_active
+                + len(prefilling)
+                + len(ready)
+                + len(retired)
+            )
+            if len(self.scheduler.running) == known:
+                return
+            in_session = (
+                {id(s) for _, s in slots.active()}
+                | {id(s) for s in prefilling}
+                | {id(s) for s in ready}
+                | {id(s) for s, _, _, _ in retired}
+            )
+            for seq in self.scheduler.running:
+                if (
+                    id(seq) in in_session
+                    or seq.frozen
+                    or seq.finished
+                    or seq.awaiting_fetch  # parked: its fetch lands first
+                ):
+                    continue
+                if seq.grammar is not None:
+                    # Constrained rows can't ride fused chunks: drain for
+                    # the scheduler's unified-step routing.
+                    rebuild = True
+                    continue
+                if seq.in_prefill:
+                    prefilling.append(seq)  # froze mid-prefill: resume it
+                else:
+                    ready.append(seq)
+                self._pipeline_members.add(seq.request_id)
+
+        def want_rebuild() -> bool:
+            if self._closed:
+                return True
+            if any(s.frozen for s in prefilling) or any(
+                s.frozen for s in ready
+            ):
+                # A freeze landing in the join window (rare): drain — the
+                # joining row has no slot to park out of.
+                return True
+            if not continuous:
+                # Legacy static membership: ANY change drains the session —
+                # a frozen member (quiescence needs the full drain), an
+                # admissible waiting head, a finish, or a cancellation.
+                # Waiting requests only force a rebuild when one could
+                # actually be ADMITTED (free slot + blocks) — at
+                # oversubscription the queue is never empty, and gating on
+                # num_waiting alone kept the fused pipeline permanently
+                # disabled (round-3 saturation collapse).
+                return (
+                    any(s.frozen for _, s in slots.active())
+                    or
+                    self.scheduler.admission_ready()
+                    or any(s.finished for _, s in slots.active())
+                    or any(
+                        (c := self._contexts.get(s.request_id)) is not None
+                        and c.is_stopped
+                        for _, s in slots.active()
+                    )
+                )
+            # Continuous: only a head the fused loop cannot host (grammar-
+            # constrained — its mask advances host-side per token) still
+            # needs the full scheduler rebuild.
+            return (
+                self.scheduler.admission_ready()
+                and not self.scheduler.waiting_head_compatible()
+            )
+
+        def admit() -> None:
+            if not continuous or rebuild:
+                return
+            room = slots.capacity_left - len(prefilling) - len(ready)
+            if room <= 0 or not self.scheduler.admission_ready():
+                return
+            if not self.scheduler.waiting_head_compatible():
+                return
+            for seq in self.scheduler.admit_continuous(room):
+                self._pipeline_members.add(seq.request_id)
+                self.continuous_admissions += 1
+                prefilling.append(seq)
+
+        async def prefill_step() -> bool:
+            """One unified step advancing every in-loop-admitted prompt by
+            a chunk (ordinary _run_unified: chunked prefill, deferred
+            first-token fetch, block sealing).  Fused chunks around it
+            touch disjoint rows and blocks."""
+            budget = cfg.prefill_chunk
+            items: List[Tuple[SequenceState, int, int]] = []
+            for seq in prefilling:
+                if budget <= 0:
+                    break
+                if (
+                    seq.finished
+                    or seq.frozen
+                    or seq.awaiting_fetch
+                    or not seq.in_prefill
+                ):
+                    continue
+                chunk = min(budget, len(seq.prompt) - seq.num_computed)
+                items.append((seq, seq.num_computed, chunk))
+                budget -= chunk
+            if not items:
+                return False
+            # Counted as in-session DEVICE work for host_gap_frac: an
+            # admitted prompt's prefill dispatches run inside the session
+            # wall, and excluding them would read as a host-side gap
+            # exactly when in-loop admission is active.
+            t0 = time.perf_counter()
+            await self._run_unified(StepPlan(items))
+            self.decode_busy_s += time.perf_counter() - t0
+            return True
+
+        def promote_ready() -> None:
+            for seq in list(prefilling):
+                if seq.finished:
+                    # First token hit a stop / the client cancelled:
+                    # _accept_token already removed it — it never joins.
+                    prefilling.remove(seq)
+                    self._pipeline_members.discard(seq.request_id)
+                elif not seq.in_prefill and not seq.awaiting_fetch:
+                    # Prompt computed AND first token harvested: joins the
+                    # fused chain at the next chain break.
+                    prefilling.remove(seq)
+                    ready.append(seq)
+
+        def plan_chunk() -> Optional[np.ndarray]:
+            """Host-side planning for one fused chunk: KV slot ensure,
+            table refresh, per-row write limits.  None = nothing worth
+            dispatching (or KV exhausted → rebuild)."""
+            nonlocal rebuild
+            # Don't dispatch chunks no row can still use — checked BEFORE
+            # allocating lookahead blocks: a never-dispatched chunk must
+            # not take KV capacity from other sequences.
+            if not self._any_useful_rows(slots.rows, pos_disp):
+                return None
+            ok = True
+            for i, seq in slots.active():
+                need = int(pos_disp[i]) + T - seq.num_computed
+                if not self.scheduler._ensure_slot(seq, lookahead=need):
+                    ok = False
+                self._tables_row(tables, i, seq)
+                limits[i] = min(
+                    len(seq.block_ids) * bs, cfg.max_blocks_per_seq * bs
+                )
+            if not ok:
+                # Out of KV headroom: drain any in-flight work, then return
+                # so schedule() can preempt with nothing pending.
+                rebuild = True
+                return None
+            return pos_disp.copy()
+
+        async def dispatch_chunk(pos0: np.ndarray) -> None:
+            nonlocal carry, chunk_id, dispatched_any
+            first = carry is None
+            n_active = slots.num_active
+            pub_payload = (
+                tok0 if first else None,  # None → follower's own carry
+                pos0,
+                tables.copy(),
+                limits.copy(),
+                samp_np,
+            )
+            if first:
+                c_tok, c_steps, c_counts = tok0, samp.steps, samp.counts
+                if self._rep_sharding is not None:
+                    c_tok, c_steps = self._prep((c_tok, c_steps))
+            else:
+                c_tok, c_steps, c_counts = carry
+            if self._rep_sharding is not None:
+                d_args = self._prep((pos0, tables.copy(), limits.copy(), samp))
+            else:
+                d_args = (pos0, tables, limits, samp)
+
+            def run(args=d_args, tok_in=c_tok, st=c_steps, ct=c_counts):
+                outs, last, steps_f, counts_f, self.cache = multi(
+                    self.params, self.cache, tok_in, st, ct, *args
+                )
+                return outs, (last, steps_f, counts_f)
+
+            t0 = time.perf_counter()
+            async with self._device_lock:
+                # Broadcast order must equal device enqueue order (see
+                # _run_unified) — publish under the device lock.
+                if self._publisher is not None:
+                    await self._publisher.publish("multi", pub_payload)
+                outs, new_carry = await asyncio.to_thread(run)
+            carry = new_carry
+            wall = time.perf_counter() - t0
+            self.decode_busy_s += wall  # unbounded host-gap accounting
+            self.step_trace.append(
+                ("decode_dispatch", wall, n_active, n_active * T)
+            )
+            # Start the D2H copy NOW: it proceeds in the background while
+            # later chunks compute, so the wait below pays ~zero round trip
+            # instead of compute + full link latency.
+            self._start_d2h(outs, need_lp)
+            chunk_id += 1
+            inflight.append((outs, pos0, chunk_id, need_lp))
+            dispatched_any = True
+            pos_disp[:] = np.where(pos_disp >= 0, pos_disp + T, pos_disp)
 
         while True:
-            # Top up the dispatch window.  With requests queued, cap the
-            # in-flight depth at 2 (enough to overlap fetch with compute) so
-            # the drain a newcomer's admission must wait for stays bounded.
-            depth = (
-                min(cfg.pipeline_depth, 2)
-                if self.scheduler.num_waiting
-                else cfg.pipeline_depth
-            )
-            while not rebuild and len(inflight) < depth:
-                # Don't dispatch chunks no row can still use: once every
-                # member's in-flight frontier covers its remaining token
-                # budget, further chunks are pure waste (their tokens would
-                # all be discarded host-side).  Checked BEFORE allocating
-                # lookahead blocks below — a never-dispatched chunk must not
-                # take KV capacity from other sequences.
-                if not self._any_useful_rows(members, pos_disp):
-                    rebuild = True
-                    break
-                # Ensure every active member has KV room for this chunk.
-                limits = np.zeros((S,), np.int32)
-                ok = True
-                for i, seq in enumerate(members):
-                    if seq.finished:
-                        pos_disp[i] = -1
-                        continue
-                    need = int(pos_disp[i]) + T - seq.num_computed
-                    if not self.scheduler._ensure_slot(seq, lookahead=need):
-                        ok = False
-                    self._tables_row(tables, i, seq)
-                    limits[i] = min(
-                        len(seq.block_ids) * bs,
-                        cfg.max_blocks_per_seq * bs,
-                    )
-                if not ok:
-                    # Out of KV headroom: drain any in-flight work, then
-                    # return so schedule() can preempt with nothing pending.
-                    rebuild = True
-                    break
-                pos0 = pos_disp.copy()
-                first = carry is None
-                pub_payload = (
-                    tok0 if first else None,  # None → follower's own carry
-                    pos0,
-                    tables.copy(),
-                    limits,
-                    samp_np,
-                )
-                if first:
-                    c_tok, c_steps, c_counts = tok0, samp.steps, samp.counts
-                    if self._rep_sharding is not None:
-                        c_tok, c_steps = self._prep((c_tok, c_steps))
-                else:
-                    c_tok, c_steps, c_counts = carry
-                if self._rep_sharding is not None:
-                    d_args = self._prep((pos0, tables.copy(), limits, samp))
-                else:
-                    d_args = (pos0, tables, limits, samp)
-
-                def dispatch(args=d_args, tok_in=c_tok, st=c_steps, ct=c_counts):
-                    outs, last, steps_f, counts_f, self.cache = multi(
-                        self.params, self.cache, tok_in, st, ct, *args
-                    )
-                    return outs, (last, steps_f, counts_f)
-
-                t0 = time.perf_counter()
-                async with self._device_lock:
-                    # Broadcast order must equal enqueue order (see
-                    # _run_unified) — publish under the device lock.
-                    if self._publisher is not None:
-                        await self._publisher.publish("multi", pub_payload)
-                    outs, carry = await asyncio.to_thread(dispatch)
-                self.step_trace.append(
-                    ("decode_dispatch", time.perf_counter() - t0, n, n * T)
-                )
-                # Start the D2H copy NOW: it proceeds in the background while
-                # later chunks compute, so the drain fetch below pays ~zero
-                # round-trip instead of compute + full link latency (round-2
-                # measured 323ms per serial fetch over the tunneled chip).
-                try:
-                    outs.tokens.copy_to_host_async()
-                    if need_lp:
-                        outs.logprob.copy_to_host_async()
-                        outs.top_ids.copy_to_host_async()
-                        outs.top_logprobs.copy_to_host_async()
-                except AttributeError:
-                    pass
-                inflight.append((outs, pos0))
-                dispatched_any = True
-                pos_disp = np.where(pos_disp >= 0, pos_disp + T, pos_disp)
-                if want_rebuild():
-                    rebuild = True
-
-            if not inflight:
-                break
-
-            # Await the oldest chunk's tokens and apply them.
-            outs, pos0 = inflight.popleft()
-            t0 = time.perf_counter()
-
-            def fetch(o=outs):
-                if need_lp:
-                    return (
-                        np.asarray(o.tokens),
-                        np.asarray(o.logprob),
-                        np.asarray(o.top_ids),
-                        np.asarray(o.top_logprobs),
-                    )
-                return np.asarray(o.tokens), None, None, None
-
-            sampled, logp, top_ids, top_lp = await asyncio.to_thread(fetch)
-            self.step_trace.append(
-                # "wait" not "fetch": the D2H copy was started at dispatch,
-                # so this wall is dominated by the chunk's device compute.
-                ("decode_wait", time.perf_counter() - t0, n, n * T)
-            )
-            self._accept_chunk(
-                members, pos0, sampled, logp, top_ids, top_lp, finished_members
-            )
-            if not rebuild and self._spec_session_probe(members):
-                # Output grew repetitive enough that in-step speculation
-                # now beats the fused chunks: drain and let schedule()
-                # re-propose for real (engine/spec.py).
+            if sweep_retire() and not continuous:
                 rebuild = True
+            flush_retired()
+            if continuous and not rebuild:
+                rejoin_strays()
             if want_rebuild():
                 rebuild = True
+            if ready and not inflight and not rebuild:
+                merge_ready()
+
+            # Pop the oldest chunk and start its fetch FIRST: everything
+            # below — next-chunk planning + dispatch, admission, the
+            # interleaved prefill, completed first-token harvests —
+            # overlaps the D2H running in the fetch thread.
+            fetch_task = None
+            if inflight:
+                outs, pos0_c, cid, lp = inflight.popleft()
+                wait_t0 = time.perf_counter()
+                fetch_task = asyncio.get_running_loop().create_task(
+                    asyncio.to_thread(self._fetch_outs, outs, lp)
+                )
+
+            # Top up the dispatch window.  With anyone waiting to join
+            # (queued, prefilling, or merge-pending), cap the in-flight
+            # depth at 2 — enough to overlap fetch with compute — so the
+            # drain a join must wait for stays bounded.  A pending merge
+            # holds fused dispatch entirely: the chain must break first.
+            depth = (
+                min(cfg.pipeline_depth, 2)
+                if (self.scheduler.num_waiting or prefilling or ready)
+                else cfg.pipeline_depth
+            )
+            in_flight_now = len(inflight) + (1 if fetch_task is not None else 0)
+            progressed = False
+            while (
+                not rebuild
+                and not ready
+                and samp is not None
+                and in_flight_now < depth
+            ):
+                pos0 = plan_chunk()
+                if pos0 is None:
+                    break
+                await dispatch_chunk(pos0)
+                in_flight_now += 1
+                progressed = True
+                if want_rebuild():
+                    rebuild = True
+            if not rebuild:
+                admit()
+                if await prefill_step():
+                    dispatched_any = True
+                    progressed = True
+            # Completed deferred fetches (admitted rows' first tokens)
+            # apply for free while the oldest chunk is still in flight.
+            while self._pending_fetches and self._pending_fetches[0][1].done():
+                await self._harvest_pending()
+                progressed = True
+
+            if fetch_task is not None:
+                sampled, logp, top_ids, top_lp = await fetch_task
+                wait_wall = time.perf_counter() - wait_t0
+                self.decode_busy_s += wait_wall
+                self.step_trace.append(
+                    # "wait" not "fetch": the D2H copy started at dispatch,
+                    # so this wall is dominated by the chunk's device
+                    # compute.
+                    (
+                        "decode_wait",
+                        wait_wall,
+                        slots.num_active,
+                        slots.num_active * T,
+                    )
+                )
+                self._accept_chunk(
+                    slots.rows, pos0_c, sampled, logp, top_ids, top_lp, []
+                )
+                harvested = cid
+                if not rebuild and self._spec_session_probe(
+                    [s for _, s in slots.active()]
+                ):
+                    # Output grew repetitive enough that in-step speculation
+                    # now beats the fused chunks: drain and let schedule()
+                    # re-propose for real (engine/spec.py).
+                    rebuild = True
+            elif not progressed:
+                if self._pending_fetches:
+                    # Nothing dispatchable until a first-token fetch lands:
+                    # block on the oldest instead of spinning.
+                    await self._harvest_pending()
+                else:
+                    promote_ready()
+                    if ready and not rebuild:
+                        continue  # late joiners: merge next iteration
+                    # Nothing in flight, nothing to dispatch, nothing
+                    # pending: drained for a rebuild, or every member
+                    # finished — the session is over.
+                    break
+            promote_ready()
             if rebuild and not inflight:
                 break
             await asyncio.sleep(0)  # let ingress/egress run between chunks
 
-        # Drained: now it is safe to release finished members' blocks.
+        # Drained: every dispatched chunk was harvested, so every write
+        # barrier has passed — release whatever retirement is pending.
+        sweep_retire()
+        flush_retired()
         self._pipeline_members = set()
-        for seq in finished_members:
-            self.scheduler.remove(seq)
+        self.pipeline_wall_s += time.perf_counter() - session_t0
+        if rebuild:
+            self.pipeline_rebuilds += 1
         return dispatched_any
 
     async def _decode_burst(self, members: List[SequenceState]) -> bool:
-        """ONE fused multi-step dispatch for ``members`` (all decoding):
-        decode_steps tokens per row for a single device round trip, used in
-        mixed phases where prefill rows keep the full pipeline from
-        engaging.  Same discard semantics as the pipeline: tokens past a
-        row's stop/limit are dropped host-side.  Returns False (dispatching
-        nothing) when KV headroom for a full burst is missing."""
+        """Fused multi-step dispatch(es) for ``members`` (all decoding),
+        used in mixed phases where prefill rows keep the full pipeline from
+        engaging.  Pipelined shape (ISSUE 11): when KV headroom covers TWO
+        chunks and some row can still use the second, a second dispatch is
+        CHAINED off the first's on-device token carry — two in-flight
+        chunks (2 × decode_steps tokens per row) for the same host-side
+        planning cost, matching the full pipeline's double-buffered shape.
+        Same discard semantics as the pipeline: tokens past a row's
+        stop/limit are dropped host-side.  Returns False (dispatching
+        nothing) when KV headroom for even one full burst is missing."""
         cfg = self.cfg
         bs = cfg.block_size
         S, T = cfg.max_batch, cfg.decode_steps
@@ -559,6 +894,7 @@ class DecodePipelineMixin:
         pos0 = np.full((S,), -1, np.int32)
         tables = np.zeros((S, cfg.max_blocks_per_seq), np.int32)
         limits = np.zeros((S,), np.int32)
+        chain = True  # headroom for a second chained chunk on every row?
         for i, seq in enumerate(members):
             if seq.finished or seq.frozen:
                 return False  # membership changed under us: replan
@@ -569,12 +905,23 @@ class DecodePipelineMixin:
                 return False
             if not self.scheduler._ensure_slot(seq, lookahead=T):
                 return False
+            # Second-chunk headroom is best-effort: blocks the 2T ensure
+            # allocates stay with the row either way (used by later steps).
+            if chain and not self.scheduler._ensure_slot(seq, lookahead=2 * T):
+                chain = False
             all_toks = seq.prompt + seq.output
             tok0[i] = all_toks[seq.num_computed]
             pos0[i] = seq.num_computed
             self._tables_row(tables, i, seq)
             limits[i] = min(
                 len(seq.block_ids) * bs, cfg.max_blocks_per_seq * bs
+            )
+        # A second chunk no row can still use is pure waste (all its tokens
+        # would be discarded host-side): chain only when some member's
+        # budget reaches past the first chunk's frontier.
+        if chain:
+            chain = self._any_useful_rows(
+                members, np.where(pos0 >= 0, pos0 + T, pos0)
             )
         # Park BEFORE the first suspension point (see _run_unified):
         # quiescence pollers must count the burst's in-flight tokens from
@@ -586,6 +933,11 @@ class DecodePipelineMixin:
             await self._harvest_pending()  # free: task already complete
         samp = self._sampling_arrays(members)
         need_lp = bool(samp.need_logprobs)
+        samp_np = (
+            jax.tree_util.tree_map(np.asarray, samp)
+            if self._publisher is not None
+            else None
+        )
         c_tok, c_steps = tok0, samp.steps
         if self._rep_sharding is not None:
             c_tok, c_steps = self._prep((c_tok, c_steps))
@@ -595,51 +947,71 @@ class DecodePipelineMixin:
         multi = self._multi_fn
 
         def run():
-            outs, _last, _steps, _counts, self.cache = multi(
+            outs, last, steps_f, counts_f, self.cache = multi(
                 self.params, self.cache, c_tok, c_steps, samp.counts, *d_args
             )
             # Async D2H + deferred accept: the burst's tokens are only
             # needed at the next harvest point (its rows are parked), so
             # the round trip overlaps the following prefill chunks instead
             # of stalling behind the device queue.
-            try:
-                outs.tokens.copy_to_host_async()
-                if need_lp:
-                    outs.logprob.copy_to_host_async()
-                    outs.top_ids.copy_to_host_async()
-                    outs.top_logprobs.copy_to_host_async()
-            except AttributeError:
-                pass
-            return outs
+            self._start_d2h(outs, need_lp)
+            return outs, (last, steps_f, counts_f)
 
         t0 = time.perf_counter()
         async with self._device_lock:
             if self._publisher is not None:
                 await self._publisher.publish(
                     "multi",
-                    (
-                        tok0,
-                        pos0,
-                        tables.copy(),
-                        limits,
-                        jax.tree_util.tree_map(np.asarray, samp),
-                    ),
+                    (tok0, pos0, tables.copy(), limits, samp_np),
                 )
-            outs = await asyncio.to_thread(run)
+            outs, carry = await asyncio.to_thread(run)
         self.step_trace.append(
             ("decode_burst", time.perf_counter() - t0, n, n * T)
         )
-        self._stash_fetch("burst", outs, need_lp, members, pos0)
+        self._stash_fetch("burst", outs, need_lp, members, pos0, chain)
+        if not chain:
+            return True
+
+        # Chained second chunk: the carry (token, rng step, penalty counts)
+        # stays ON DEVICE — warmup pre-compiles this exact device-carry
+        # variant, so no new program is reachable here.
+        pos0b = np.where(pos0 >= 0, pos0 + T, pos0)
+        if self._rep_sharding is not None:
+            d_args_b = self._prep((pos0b, tables, limits, samp))
+        else:
+            d_args_b = (pos0b, tables, limits, samp)
+
+        def run_b():
+            outs, last, steps_f, counts_f, self.cache = multi(
+                self.params, self.cache, *carry, *d_args_b
+            )
+            self._start_d2h(outs, need_lp)
+            return outs
+
+        t0 = time.perf_counter()
+        async with self._device_lock:
+            if self._publisher is not None:
+                # tok None → follower chains its own mirror carry.
+                await self._publisher.publish(
+                    "multi",
+                    (None, pos0b, tables.copy(), limits, samp_np),
+                )
+            outs_b = await asyncio.to_thread(run_b)
+        self.step_trace.append(
+            ("decode_burst", time.perf_counter() - t0, n, n * T)
+        )
+        self._stash_fetch("burst", outs_b, need_lp, members, pos0b, False)
         return True
 
     def _any_useful_rows(
-        self, members: List[SequenceState], pos_disp: np.ndarray
+        self, members: List[Optional[SequenceState]], pos_disp: np.ndarray
     ) -> bool:
         """True if any active member could still accept a token from one more
         fused chunk, given how far its dispatch frontier already overshoots
-        its accepted position (in-flight tokens count against the budget)."""
+        its accepted position (in-flight tokens count against the budget).
+        ``None`` entries are free/retired row slots."""
         for i, seq in enumerate(members):
-            if seq.finished or pos_disp[i] < 0:
+            if seq is None or seq.finished or pos_disp[i] < 0:
                 continue
             overshoot = int(pos_disp[i]) - seq.num_computed
             budget = self.cfg.max_model_len - seq.total_tokens
@@ -685,6 +1057,8 @@ class DecodePipelineMixin:
         T = int(sampled.shape[0])
         bs = self.cfg.block_size
         for i, seq in enumerate(members):
+            if seq is None:
+                continue  # free/retired row slot (continuous pipeline)
             seq.awaiting_fetch = False
             if seq.finished or pos0[i] < 0:
                 continue

@@ -452,14 +452,9 @@ class SpecDecodeMixin:
 
         def run():
             out, self.cache = step(self.params, self.cache, rb_d, samp_d)
-            try:
-                out.tokens.copy_to_host_async()
-                if need_lp:
-                    out.logprob.copy_to_host_async()
-                    out.top_ids.copy_to_host_async()
-                    out.top_logprobs.copy_to_host_async()
-            except AttributeError:
-                pass
+            # Capability probed once at engine init (pipeline._start_d2h) —
+            # no per-dispatch AttributeError swallowing.
+            self._start_d2h(out, need_lp)
             return out
 
         t0 = time.perf_counter()

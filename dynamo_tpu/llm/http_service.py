@@ -230,7 +230,12 @@ class HttpService:
         # colocated (llm/metrics.py spec_metrics).
         from ..planner.pmetrics import metrics as planner_metrics
         from ..runtime.health import health_metrics
-        from .metrics import migration_metrics, spec_metrics, tenancy_metrics
+        from .metrics import (
+            engine_dispatch_metrics,
+            migration_metrics,
+            spec_metrics,
+            tenancy_metrics,
+        )
 
         body = (
             self.metrics.render()
@@ -241,6 +246,7 @@ class HttpService:
             + tenancy_metrics.render(self._metrics_prefix).encode()
             + health_metrics.render(self._metrics_prefix).encode()
             + qos_metrics.render(self._metrics_prefix).encode()
+            + engine_dispatch_metrics.render(self._metrics_prefix).encode()
         )
         return web.Response(body=body, content_type="text/plain")
 

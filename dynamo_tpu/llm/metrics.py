@@ -521,6 +521,96 @@ class QosMetrics:
 qos_metrics = QosMetrics()
 
 
+class EngineDispatchMetrics:
+    """Decode-pipeline dispatch health (engine/pipeline.py): per-kind
+    dispatch counts/wall/percentiles from the engine's step_trace, plus the
+    continuous-batching session gauges (sessions, rebuilds, in-loop
+    admissions/retirements, fused-loop host-gap fraction).
+
+    The engine owns the trace, so this singleton holds a SOURCE callable
+    (``engine.dispatch_summary``) wired by whoever colocates an engine with
+    the HTTP edge (cli ``run in=http out=tpu`` — same pattern as the
+    brownout ladder's ``kv_usage_fn``); rendered as Prometheus text and
+    appended to ``/metrics`` like the other module singletons.  Without a
+    source it renders nothing, so remote-engine edges are unaffected."""
+
+    def __init__(self):
+        self._source = None
+
+    def set_source(self, source) -> None:
+        """``source() -> engine.dispatch_summary()`` dict, or None to
+        detach."""
+        self._source = source
+
+    def reset(self) -> None:
+        self.__init__()
+
+    def render(self, prefix: str = "dynamo_tpu") -> str:
+        if self._source is None:
+            return ""
+        try:
+            s = self._source()
+        except Exception:  # engine mid-teardown: drop this scrape's section
+            return ""
+        ns = f"{prefix}_engine_dispatch"
+        # Per-kind stats come from the engine's BOUNDED step_trace window
+        # (deque maxlen) — they can shrink as old entries evict, so they
+        # are gauges, never counters (a decreasing counter breaks rate()).
+        lines = [
+            f"# HELP {ns}_window_dispatches Device dispatches per step "
+            "kind over the bounded trace window",
+            f"# TYPE {ns}_window_dispatches gauge",
+        ]
+        kinds = sorted(s.get("kinds", {}).items())
+        for kind, v in kinds:
+            lines.append(
+                f'{ns}_window_dispatches{{kind="{escape_label(kind)}"}} '
+                f'{v["dispatches"]}'
+            )
+        lines.append(f"# HELP {ns}_window_wall_seconds Wall per step kind "
+                     "over the bounded trace window")
+        lines.append(f"# TYPE {ns}_window_wall_seconds gauge")
+        for kind, v in kinds:
+            lines.append(f'{ns}_window_wall_seconds{{kind="'
+                         f'{escape_label(kind)}"}} {v["wall_s"]}')
+        for q in ("p50", "p99"):
+            lines.append(f"# HELP {ns}_{q}_ms {q} dispatch latency per "
+                         "step kind (over the bounded trace window)")
+            lines.append(f"# TYPE {ns}_{q}_ms gauge")
+            for kind, v in kinds:
+                lines.append(f'{ns}_{q}_ms{{kind="{escape_label(kind)}"}} '
+                             f'{v[f"{q}_ms"]}')
+        pipe = s.get("pipeline", {})
+
+        def emit(name: str, kind: str, help_: str, value) -> None:
+            lines.append(f"# HELP {ns}_{name} {help_}")
+            lines.append(f"# TYPE {ns}_{name} {kind}")
+            lines.append(f"{ns}_{name} {value}")
+
+        emit("pipeline_sessions_total", "counter",
+             "Fused decode pipeline sessions begun",
+             pipe.get("sessions", 0))
+        emit("pipeline_rebuilds_total", "counter",
+             "Sessions drained by a rebuild event (incompatible change)",
+             pipe.get("rebuilds", 0))
+        emit("continuous_admissions_total", "counter",
+             "Sequences admitted into a live fused session (no drain)",
+             pipe.get("continuous_admissions", 0))
+        emit("continuous_retired_total", "counter",
+             "Rows retired from a live fused session (no drain)",
+             pipe.get("continuous_retired", 0))
+        emit("pipeline_wall_seconds_total", "counter",
+             "Cumulative fused-session wall time",
+             pipe.get("wall_s", 0.0))
+        emit("host_gap_frac", "gauge",
+             "Fraction of fused-session wall not covered by decode "
+             "dispatch/wait device work", pipe.get("host_gap_frac", 0.0))
+        return "\n".join(lines) + "\n"
+
+
+engine_dispatch_metrics = EngineDispatchMetrics()
+
+
 class InflightGuard:
     """Tracks one request: inflight gauge, duration, TTFT, ITL, final status.
 

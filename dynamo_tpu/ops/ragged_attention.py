@@ -85,6 +85,125 @@ def write_kv_ragged(
     return flat.reshape(P, ps, KV2, D)
 
 
+def _decode_block_hints(pages: jnp.ndarray, page_indices: jnp.ndarray):
+    """Pallas block/grid hints for decode-shaped dispatches (every row one
+    query token).  The kernel's default KV block spans all of pages_per_seq;
+    at long context its double-buffered VMEM scratch exceeds the 16MB scoped
+    limit, and decode steps measured 2x faster with explicit 16-query blocks
+    + a ~4MB-budget KV block (18-layer chain at batch 256: 14.2 -> 7.9ms on
+    v5e).  Tunable for hardware sweeps: DYN_DECODE_NQ query block,
+    DYN_DECODE_NKV_MB KV block budget."""
+    import os
+
+    ps, KV2, hd = pages.shape[1], pages.shape[2], pages.shape[3]
+    budget = int(os.environ.get("DYN_DECODE_NKV_MB", "4")) << 20
+    nkv = max(1, budget // max(1, 2 * ps * KV2 * hd * 2))
+    nkv = min(page_indices.shape[1], nkv)
+    nq = int(os.environ.get("DYN_DECODE_NQ", "16"))
+    return nq, nkv
+
+
+def ragged_decode_attention(
+    q: jnp.ndarray,  # [S, num_heads, head_dim] — ONE query token per row
+    pages: jnp.ndarray,  # [num_pages, page_size, 2*kv_heads, head_dim]
+    kv_lens: jnp.ndarray,  # [S] int32 context length per row
+    page_indices: jnp.ndarray,  # [S, pages_per_seq] int32
+    num_seqs: jnp.ndarray,  # [1] int32 valid rows
+    *,
+    sm_scale: float,
+    impl: str = "xla",  # "tpu" | "xla"
+    kv_scale: float | None = None,
+) -> jnp.ndarray:
+    """Decode-specialized attention: every row is exactly ONE query token
+    (the fused multi-step decode program's shape — engine/pipeline.py).
+
+    The unified entry (``ragged_attention``) must handle arbitrary
+    prefill/decode mixes, which costs it per-token ``cu_q_lens``
+    bookkeeping: a searchsorted row lookup and tail-position arithmetic per
+    query token.  Here row ``i``'s single query sits at context position
+    ``kv_lens[i] - 1`` by construction, so the row map is the identity and
+    the causal mask is just ``ctx < kv_len``.
+
+    - TPU: the same pallas kernel, always with the decode-tuned block/grid
+      hints (``_decode_block_hints``).
+    - XLA fallback (CPU tier-1): a direct [S, W] row gather — no
+      searchsorted, no cu_q_lens — numerically identical to the unified
+      fallback on decode shapes (same einsums, same operand order), so the
+      fused-vs-unified exact-stream gates keep holding bit-for-bit.
+    """
+    S, H, D = q.shape
+    if impl == "tpu":
+        from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
+            ragged_paged_attention,
+        )
+
+        nq, nkv = _decode_block_hints(pages, page_indices)
+        # One token per row: cumulative query lengths are the identity.
+        cu = jnp.arange(S + 1, dtype=jnp.int32)
+        # Unit scale for quantized pages without an explicit one — see the
+        # matching comment in ragged_attention.
+        unit = 1.0 if pages.dtype.itemsize == 1 and kv_scale is None else kv_scale
+        try:
+            return ragged_paged_attention(
+                q,
+                pages,
+                kv_lens,
+                page_indices,
+                cu,
+                num_seqs,
+                sm_scale=sm_scale,
+                num_queries_per_block=nq,
+                num_kv_pages_per_block=nkv,
+                vmem_limit_bytes=64 << 20,
+                k_scale=unit,
+                v_scale=unit,
+            )
+        except Exception as e:  # trace-time rejection (see ragged_attention)
+            if pages.shape[3] >= 128:
+                raise
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "pallas ragged kernel rejected toy decode shapes q=%s "
+                "pages=%s (%s); using the XLA fallback",
+                q.shape, pages.shape, e,
+            )
+            impl = "xla"
+    if impl != "xla":
+        raise ValueError(f"unknown ragged attention impl {impl!r}")
+
+    kv_lens = jnp.asarray(kv_lens)
+    page_indices = jnp.asarray(page_indices)
+    num_seqs = jnp.asarray(num_seqs)
+
+    ps = pages.shape[1]
+    KV = pages.shape[2] // 2
+    G = H // KV
+    W = page_indices.shape[1] * ps
+
+    ctx = jnp.arange(W, dtype=jnp.int32)
+    # Row map is the identity: gather each row's context directly.
+    slots = page_indices[:, ctx // ps] * ps + ctx % ps  # [S, W]
+    kv = pages.reshape(-1, 2 * KV, D)[slots]  # [S, W, 2KV, D]
+    k = kv[:, :, 0::2].astype(jnp.float32)  # [S, W, KV, D]
+    v = kv[:, :, 1::2].astype(jnp.float32)
+    if kv_scale is not None and kv_scale != 1.0:
+        k = k * kv_scale
+        v = v * kv_scale
+
+    valid = jnp.arange(S, dtype=jnp.int32) < num_seqs[0]
+    qf = q.reshape(S, KV, G, D).astype(jnp.float32) * sm_scale
+    logits = jnp.einsum("skgd,swkd->skgw", qf, k)  # [S, KV, G, W]
+    mask = (ctx[None, :] < kv_lens[:, None]) & valid[:, None]  # [S, W]
+    logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m) * mask[:, None, None, :]
+    out = jnp.einsum("skgw,swkd->skgd", p, v) / (
+        jnp.sum(p, axis=-1, keepdims=True) + 1e-30
+    )
+    return out.reshape(S, H, D).astype(q.dtype)
+
+
 def ragged_attention(
     q: jnp.ndarray,  # [T, num_heads, head_dim]
     pages: jnp.ndarray,  # [num_pages, page_size, 2*kv_heads, head_dim]
@@ -108,36 +227,36 @@ def ragged_attention(
     ``kv_scale`` supports quantized (fp8/int8) page dtypes with one static
     per-tensor scale — the TPU kernel's native k_scale/v_scale contract;
     the write side stores value/scale (write_kv_ragged).
+
+    ``decode=True`` routes to ``ragged_decode_attention``: the fused
+    multi-step decode program's shape (one query token per row) skips the
+    cu_q_lens generality entirely and always gets the decode-tuned pallas
+    block hints.
     """
+    if decode:
+        return ragged_decode_attention(
+            q,
+            pages,
+            kv_lens,
+            page_indices,
+            num_seqs,
+            sm_scale=sm_scale,
+            impl=impl,
+            kv_scale=kv_scale,
+        )
     if impl == "tpu":
         from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
             ragged_paged_attention,
         )
 
-        # The kernel's default KV block spans all of pages_per_seq; at long
-        # context (e.g. 256 pages = 4k tokens) its double-buffered VMEM
-        # scratch exceeds the 16MB scoped limit.  Cap the per-block page
-        # count so 2 x nkv x page_size x 2KV x head_dim x 2B stays ~4MB.
-        ps, KV2, hd = pages.shape[1], pages.shape[2], pages.shape[3]
         # Block sizing: the kernel replaces BOTH block params with its tuned
         # table whenever EITHER is None — a partial override is silently
-        # discarded.  Decode steps (the engine passes decode=True from the
-        # fused multi-step program, where every row is one token) measured
-        # 2x faster with explicit 16-query blocks + a ~4MB-budget KV block
-        # (18-layer chain at batch 256: 14.2 -> 7.9ms on v5e); prefill and
-        # mixed shapes run the kernel's tuned table (59-83% MFU measured)
-        # under the raised vmem limit.
-        if decode:
-            import os
-
-            # Tunable for hardware sweeps (defaults are the measured-best):
-            # DYN_DECODE_NQ query block, DYN_DECODE_NKV_MB KV block budget.
-            budget = int(os.environ.get("DYN_DECODE_NKV_MB", "4")) << 20
-            nkv = max(1, budget // max(1, 2 * ps * KV2 * hd * 2))
-            nkv = min(page_indices.shape[1], nkv)
-            nq = int(os.environ.get("DYN_DECODE_NQ", "16"))
-        else:
-            nkv = nq = None
+        # discarded.  Prefill and mixed shapes run the kernel's tuned table
+        # (59-83% MFU measured) under the raised vmem limit; decode shapes
+        # never reach here (routed to ragged_decode_attention above, which
+        # passes the measured-best decode hints).
+        hd = pages.shape[3]
+        nkv = nq = None
         # Quantized (1-byte) pages: real scaling is folded around this call
         # by the model (q pre-scaled, output post-scaled — models/llama.py),
         # but the kernel only CASTS fp8/int8 K/V up to q's dtype inside its

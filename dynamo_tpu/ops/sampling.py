@@ -161,9 +161,15 @@ def sample_tokens(
 
     greedy = jnp.argmax(eff, axis=-1).astype(jnp.int32)
     temp = jnp.maximum(temperature, 1e-6)[:, None]
-    keys = _row_keys(seeds, steps)
 
     def cat(scaled: jnp.ndarray) -> jnp.ndarray:
+        # Key derivation lives INSIDE the sampling branches: on an
+        # all-greedy step (the decode hot path for benchmark and batch
+        # traffic) the outer lax.cond takes the greedy branch and the
+        # per-row threefry fold_in work is skipped entirely — at batch 256
+        # x decode_steps per fused dispatch that was real device work spent
+        # deriving keys nothing consumed.
+        keys = _row_keys(seeds, steps)
         return jax.vmap(
             lambda k, row: jax.random.categorical(k, row)
         )(keys, scaled).astype(jnp.int32)

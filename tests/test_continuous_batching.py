@@ -1,0 +1,345 @@
+"""Continuous fused decode gates (ISSUE 11).
+
+The load-bearing property is EXACT-STREAM EQUIVALENCE: in-loop
+admission/retirement is a SCHEDULING change, never a token change — the
+seeded sampler keys on (seed, output-index) over the committed prefix, so
+the continuous pipeline and the legacy drain-on-any-change control
+(``_continuous_decode = False``) must produce byte-identical streams at
+any temperature, spec on or off.  Also covered: migration freeze
+quiescence while the session keeps fusing for other rows (the
+``_pipeline_members`` accounting under dynamic membership), the
+zero-new-compiles gate (in-loop admission reaches no program warmup did
+not), and the scheduler-side RowSlots/admit_continuous primitives.
+
+Engine economics: every TpuEngine pays its XLA compiles (the CPU
+persistent cache is deliberately off), so tests share one config and keep
+engine counts minimal; seeded sampling makes control streams independent
+of which engine computed them (same config/seed ⇒ same weights).
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, KvBlockManager
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.engine.scheduler import (
+    RowSlots,
+    Scheduler,
+    SequenceState,
+)
+from dynamo_tpu.llm.protocols import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context, collect
+from dynamo_tpu.tokens import TokenBlockSequence
+
+CFG = dict(
+    model="debug-tiny",
+    block_size=4,
+    num_blocks=256,
+    max_batch=4,
+    max_model_len=256,
+    prefill_chunk=16,
+    dtype="float32",
+    decode_steps=4,
+    pipeline_depth=2,
+)
+
+
+def _req(tokens, max_tokens=8, seed=None, temperature=0.0):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=temperature, seed=seed),
+    ).to_dict()
+
+
+def _prompt(i, n=12):
+    return [(i * 7919 + j * 104729) % 251 + 1 for j in range(n)]
+
+
+async def _one(engine, i, osl, temperature, late=False):
+    if late:
+        # Land INSIDE a live fused session: the whole point of the churn
+        # trace is admission while the pipeline is running.
+        for _ in range(4000):
+            if engine._pipeline_members:
+                break
+            await asyncio.sleep(0.002)
+    req = _req(_prompt(i), max_tokens=osl, seed=i + 1, temperature=temperature)
+    items = await collect(await engine.generate(Context(req)))
+    return [t for it in items for t in it["token_ids"]]
+
+
+async def _churn(engine, temperature, n=8):
+    """Staggered finishes + late arrivals: first wave keeps the session
+    alive while short rows retire; back half arrives mid-session."""
+    jobs = []
+    for i in range(n):
+        late = i >= (n + 1) // 2
+        osl = (24 + 8 * (i % 2)) if not late else (5 + 3 * (i % 3))
+        jobs.append(_one(engine, i, osl, temperature, late=late))
+    return await asyncio.gather(*jobs)
+
+
+def _run_modes(temperature, spec=None):
+    """Same churn trace on a continuous engine and a forced-rebuild
+    control; returns (streams_on, streams_off, engine_stats)."""
+
+    results = {}
+
+    async def mode(continuous: bool):
+        cfg = dict(CFG)
+        if spec is not None:
+            cfg["spec_decode"] = spec
+        engine = TpuEngine(EngineConfig(**cfg))
+        engine._continuous_decode = continuous
+        try:
+            streams = await _churn(engine, temperature)
+            results[continuous] = (
+                streams,
+                {
+                    "rebuilds": engine.pipeline_rebuilds,
+                    "admissions": engine.continuous_admissions,
+                    "retired": engine.continuous_retired,
+                },
+            )
+        finally:
+            await engine.close()
+
+    for continuous in (True, False):
+        asyncio.run(mode(continuous))
+    return results[True][0], results[False][0], results[True][1]
+
+
+def test_continuous_vs_rebuild_exact_streams_seeded_temp09():
+    """Mid-pipeline retirement + admission at temperature 0.9 with seeds:
+    byte-identical streams vs the forced-rebuild control, and the
+    continuous engine actually exercised the in-loop paths."""
+    on, off, stats = _run_modes(temperature=0.9)
+    assert on == off, "continuous batching changed seeded streams"
+    assert stats["admissions"] >= 1, stats
+    assert stats["retired"] >= 1, stats
+    assert stats["rebuilds"] == 0, stats
+
+
+def test_continuous_vs_rebuild_exact_streams_greedy_spec_on():
+    """Greedy + speculative decoding enabled: spec-session probes and
+    in-loop membership changes compose without changing a single token."""
+    on, off, stats = _run_modes(temperature=0.0, spec={"enable": True, "k": 4})
+    assert on == off, "continuous batching changed greedy/spec streams"
+    assert stats["retired"] >= 1, stats
+
+
+def test_freeze_quiesces_continuous_pipeline_and_resumes_exact():
+    """Migration freeze during a continuous session: the frozen row is
+    parked out at its write barrier (leaves ``_pipeline_members``, no
+    pending fetch) while the session keeps fusing for the other member;
+    unfreeze rejoins the live session and the stream completes
+    token-identically to an unfrozen control."""
+
+    async def control():
+        engine = TpuEngine(EngineConfig(**CFG))
+        try:
+            a, b = await asyncio.gather(
+                _one(engine, 1, 40, 0.9), _one(engine, 2, 48, 0.9)
+            )
+            return a, b
+        finally:
+            await engine.close()
+
+    async def frozen_run():
+        engine = TpuEngine(EngineConfig(**CFG))
+        try:
+            ctx_a = Context(_req(_prompt(1), max_tokens=40, seed=2,
+                                 temperature=0.9))
+            ctx_b = Context(_req(_prompt(2), max_tokens=48, seed=3,
+                                 temperature=0.9))
+            task_a = asyncio.create_task(
+                collect(await engine.generate(ctx_a))
+            )
+            task_b = asyncio.create_task(
+                collect(await engine.generate(ctx_b))
+            )
+            # Both decoding inside one fused session.
+            for _ in range(4000):
+                seq = engine.find_sequence(ctx_a.id)
+                if (
+                    len(engine._pipeline_members) == 2
+                    and seq is not None
+                    and seq.num_output_tokens >= 2
+                ):
+                    break
+                await asyncio.sleep(0.002)
+            seq = await engine.freeze_sequence(ctx_a.id)
+            assert seq is not None, "freeze did not reach quiescence"
+            assert seq.frozen
+            # Quiescent: no in-flight fused chunk or fetch can advance it.
+            assert ctx_a.id not in engine._pipeline_members
+            assert not seq.awaiting_fetch
+            # The session keeps fusing for B while A is frozen.
+            d0 = sum(
+                1 for k, *_ in engine.step_trace if k == "decode_dispatch"
+            )
+            for _ in range(2000):
+                d1 = sum(
+                    1
+                    for k, *_ in engine.step_trace
+                    if k == "decode_dispatch"
+                )
+                if d1 > d0:
+                    break
+                await asyncio.sleep(0.002)
+            assert d1 > d0, "session stalled while one row was frozen"
+            frozen_progress = seq.num_output_tokens
+            engine.unfreeze_sequence(ctx_a.id)
+            items_a, items_b = await asyncio.gather(task_a, task_b)
+            toks_a = [t for it in items_a for t in it["token_ids"]]
+            toks_b = [t for it in items_b for t in it["token_ids"]]
+            assert len(toks_a) == 40 and frozen_progress < 40
+            return toks_a, toks_b
+        finally:
+            await engine.close()
+
+    ctrl_a, ctrl_b = asyncio.run(control())
+    got_a, got_b = asyncio.run(frozen_run())
+    assert got_a == ctrl_a
+    assert got_b == ctrl_b
+
+
+def test_zero_new_compiles_in_loop_admission():
+    """Warmup covers every program the continuous pipeline can reach: a
+    churn trace with in-loop admission/retirement (chain-break merges,
+    interleaved prefill steps, chained bursts) must not add a single jit
+    cache entry."""
+
+    async def main():
+        engine = TpuEngine(EngineConfig(**CFG))
+        try:
+            baseline = await asyncio.to_thread(engine.warmup)
+            streams = await _churn(engine, temperature=0.9)
+            assert engine.continuous_admissions >= 1
+            after = engine.compile_counts()
+            assert after == baseline, (
+                f"in-loop admission compiled new programs: "
+                f"{baseline} -> {after}"
+            )
+            assert all(streams)
+        finally:
+            await engine.close()
+
+    asyncio.run(main())
+
+
+def test_dispatch_metrics_exported():
+    """engine.dispatch_summary → engine_dispatch_metrics: the pipeline
+    health the planner/bench read off /metrics instead of parsing bench
+    stdout — per-kind counts/percentiles plus the continuous-batching
+    session counters and host-gap fraction."""
+    from dynamo_tpu.llm.metrics import engine_dispatch_metrics
+
+    async def main():
+        engine = TpuEngine(EngineConfig(**CFG))
+        try:
+            engine_dispatch_metrics.set_source(engine.dispatch_summary)
+            await _churn(engine, temperature=0.0, n=4)
+            s = engine.dispatch_summary()
+            assert s["pipeline"]["sessions"] >= 1
+            assert 0.0 <= s["pipeline"]["host_gap_frac"] <= 1.0
+            assert "decode_dispatch" in s["kinds"]
+            text = engine_dispatch_metrics.render()
+            assert (
+                'dynamo_tpu_engine_dispatch_window_dispatches'
+                '{kind="decode_dispatch"}' in text
+            )
+            assert "dynamo_tpu_engine_dispatch_host_gap_frac" in text
+            assert (
+                "dynamo_tpu_engine_dispatch_pipeline_sessions_total" in text
+            )
+        finally:
+            engine_dispatch_metrics.reset()
+            await engine.close()
+
+    asyncio.run(main())
+
+
+def test_rowslots_free_list():
+    """RowSlots: lowest-index-first assignment, pending (barrier) state
+    between retire and free, capacity accounting."""
+    slots = RowSlots(3)
+
+    def mk(rid):
+        return SequenceState(
+            request_id=rid,
+            prompt=[1, 2, 3],
+            block_seq=TokenBlockSequence(block_size=4),
+        )
+
+    a, b = mk("a"), mk("b")
+    assert slots.assign(a) == 0
+    assert slots.assign(b) == 1
+    assert slots.num_active == 2
+    assert slots.capacity_left == 1
+    slots.retire(0)
+    assert slots.rows[0] is None
+    assert slots.num_active == 1
+    # Pending counts as capacity (reuse only happens after the barrier,
+    # at a chain-break merge) but is NOT assignable yet.
+    assert slots.capacity_left == 2
+    c = mk("c")
+    assert slots.assign(c) == 2  # the free slot, not the pending one
+    slots.free(0)
+    d = mk("d")
+    assert slots.assign(d) == 0  # barrier passed: slot 0 reusable
+    assert slots.num_active == 3
+    assert slots.capacity_left == 0
+    assert [i for i, _ in slots.active()] == [0, 1, 2]
+
+
+def test_admit_continuous_compatibility_and_order():
+    """Scheduler.admit_continuous: admits compatible waiting heads in WFQ
+    order with full block accounting, stops at an incompatible (grammar)
+    or frozen head — the pipeline drains for those."""
+    cfg = EngineConfig(**{k: v for k, v in CFG.items()})
+    kv = KvBlockManager(cfg.num_blocks, cfg.block_size)
+    sched = Scheduler(cfg, kv)
+
+    def mk(rid, grammar=None, frozen=False):
+        seq = SequenceState(
+            request_id=rid,
+            prompt=[1, 2, 3, 4],
+            block_seq=TokenBlockSequence(block_size=cfg.block_size),
+        )
+        seq.grammar = grammar
+        seq.frozen = frozen
+        return seq
+
+    s1, s2 = mk("s1"), mk("s2")
+    sched.add(s1)
+    sched.add(s2)
+    assert sched.waiting_head_compatible()
+    admitted = sched.admit_continuous(8)
+    assert admitted == [s1, s2]
+    assert all(s in sched.running for s in admitted)
+    assert all(s.block_ids for s in admitted)
+    assert len(sched.admission_waits) == 2
+
+    # A grammar-constrained head stops in-loop admission cold (it cannot
+    # ride fused chunks), even with compatible requests behind it.
+    g = mk("g", grammar=object())
+    tail = mk("tail")
+    sched.add(g)
+    sched.add(tail)
+    assert not sched.waiting_head_compatible()
+    assert sched.admit_continuous(8) == []
+    assert g in sched.waiting and tail in sched.waiting
+
+    # Frozen head: blocked, not admitted (mid-migration).
+    sched.waiting.clear()
+    f = mk("f", frozen=True)
+    sched.add(f)
+    assert not sched.waiting_head_compatible()
+    assert sched.admit_continuous(8) == []

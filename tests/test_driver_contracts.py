@@ -1,7 +1,10 @@
 """The two driver-facing contracts must never regress silently:
 
-- ``bench.py`` prints exactly ONE JSON line with metric/value/unit/
-  vs_baseline (the driver records it as BENCH_r{N}.json);
+- ``bench.py`` prints exactly ONE JSON line carrying metric/value/unit/
+  vs_baseline (the driver records it as BENCH_r{N}.json) plus the
+  machine-readable trajectory block (decode_mfu / host_gap_frac /
+  dispatch percentiles / pipeline counters — ISSUE 11: the ROADMAP used
+  to quote these by hand from stderr);
 - ``__graft_entry__.entry()`` returns a jittable (fn, args) and
   ``dryrun_multichip(n)`` compiles+executes the full sharded step on an
   n-device mesh in a hermetic CPU subprocess.
@@ -34,8 +37,19 @@ def test_bench_prints_one_json_line():
     lines = [l for l in proc.stdout.splitlines() if l.strip()]
     assert len(lines) == 1, f"want exactly one stdout line, got {lines}"
     out = json.loads(lines[0])
-    assert set(out) == {"metric", "value", "unit", "vs_baseline"}
+    # The driver's four keys are load-bearing; the trajectory block rides
+    # along so BENCH_r*.json carries what the ROADMAP quotes.
+    assert set(out) == {
+        "metric", "value", "unit", "vs_baseline",
+        "decode_mfu", "host_gap_frac", "dispatch", "pipeline",
+    }, sorted(out)
     assert out["value"] > 0
+    assert 0.0 <= out["host_gap_frac"] <= 1.0
+    assert isinstance(out["decode_mfu"], float)
+    for kind, v in out["dispatch"].items():
+        assert {"dispatches", "p50_ms", "p99_ms"} <= set(v), (kind, v)
+    assert {"sessions", "rebuilds", "continuous_admissions",
+            "continuous_retired", "host_gap_frac"} <= set(out["pipeline"])
 
 
 def test_graft_entry_compiles():

@@ -449,7 +449,13 @@ def test_engine_mixed_phase_burst_matches_serial():
     """While one request decodes and another prefills a long prompt, decode
     advances via fused bursts (decode_burst dispatches) — and the tokens
     must match serial execution exactly (burst cadence is a scheduling
-    change, never a numerics change)."""
+    change, never a numerics change).
+
+    Runs with ``_continuous_decode = False``: under continuous batching the
+    late long prompt is admitted INTO the fused session (its prefill
+    interleaves with fused chunks — tests/test_continuous_batching.py), so
+    the mixed-phase burst regime this test covers only engages on the
+    legacy path and in genuinely mixed plans (e.g. grammar rows)."""
 
     async def main():
         from dynamo_tpu.runtime.engine import Context, collect
@@ -473,6 +479,7 @@ def test_engine_mixed_phase_burst_matches_serial():
         await engine.close()
 
         engine2 = TpuEngine(EngineConfig(**cfg))
+        engine2._continuous_decode = False  # legacy mixed-phase control
 
         async def run_a():
             return await _generate(engine2, short, max_tokens=40)

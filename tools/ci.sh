@@ -45,6 +45,30 @@ echo "== qos suite (WFQ fairness + priority + brownout determinism) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_qos.py -q -m chaos \
   -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== continuous-decode churn smoke (CPU bench: staggered finishes +"
+echo "   late arrivals; bars: fewer rebuilds than forced-rebuild control,"
+echo "   exact streams, zero new compiles, dispatch metrics parseable) =="
+env JAX_PLATFORMS=cpu BENCH_CHURN=1 python bench.py > /tmp/_churn_smoke.json
+python - <<'PYEOF'
+import json, math
+r = json.loads(open("/tmp/_churn_smoke.json").read().strip().splitlines()[-1])
+assert r["metric"] == "continuous_decode_rebuilds", r
+# The hot-path guards: continuous batching must absorb the churn the
+# forced-rebuild control drains for, without compiling anything new, and
+# the dispatch summary the planner/bench consume must be well-formed.
+assert r["rebuilds"]["continuous"] < r["rebuilds"]["forced"], r["rebuilds"]
+assert r["compile_counts_stable"] is True, "compile count grew under churn"
+assert r["continuous_admissions"] >= 1, "no in-loop admission exercised"
+assert r["continuous_retired"] >= 1, "no in-loop retirement exercised"
+g = r["host_gap_frac"]
+assert isinstance(g, float) and math.isfinite(g) and 0.0 <= g <= 1.0, g
+d = r["dispatch"]["decode_dispatch"]
+assert d["dispatches"] >= 1 and math.isfinite(d["p99_ms"]), d
+print(f"churn smoke ok: rebuilds {r['rebuilds']} "
+      f"admissions={r['continuous_admissions']} "
+      f"retired={r['continuous_retired']} host_gap={g}")
+PYEOF
+
 echo "== chaos ladder L0-L2 + L5 respawn + L6 overload (seeded goodput"
 echo "   smoke; bars: 0 dropped, byte-identity incl. unseeded streams,"
 echo "   respawn on L5, non-flooding tenants >= 0.9x isolated on L6) =="
