@@ -399,6 +399,203 @@ def _churn_bench(cfg, model_cfg) -> None:
     )
 
 
+def _prefix_bench(cfg, model_cfg) -> None:
+    """BENCH_PREFIX=1: tiered-KV prefix-reuse ladder (docs/kv_tiering.md).
+
+    A shared-system-prompt / multi-turn trace (every session's turn-2
+    prompt extends its turn-1 prompt+output, and all sessions share one
+    system prefix) replayed through four tier configurations — tiers OFF /
+    host-only / host+disk (tiny host budget forces demotion) / cross-worker
+    PULL (a fresh engine pulls the prefix a donor computed) — reporting
+    per-mode TTFT and the fraction of second-occurrence prefill compute
+    skipped via prefix hits.  Bars (tools/ci.sh prefix smoke): host and
+    host+disk skip >= 90% of complete-block prefill, the pull serves a
+    prefix its engine never computed, ALL modes' streams are
+    byte-identical, and no mode compiles anything after its priming
+    session.  Env: BENCH_PREFIX_SESSIONS / BENCH_PREFIX_SYS.
+    """
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.llm.kv_router.pull import PrefixPuller
+    from dynamo_tpu.llm.protocols import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    sessions = int(os.environ.get("BENCH_PREFIX_SESSIONS", "5"))
+    bs = 4
+    sys_len = int(os.environ.get("BENCH_PREFIX_SYS", "40"))
+    ctx_len, osl, extra = 12, 9, 3
+    vocab = model_cfg.vocab_size
+    base = dict(
+        model=cfg.model,
+        block_size=bs,
+        num_blocks=48,  # small pool → sessions evict each other
+        max_batch=4,
+        max_model_len=256,
+        prefill_chunk=64,
+        dtype=cfg.dtype,
+        host_offload_interval=0.01,
+    )
+    shared_sys = [(7 * j + 13) % vocab for j in range(sys_len)]
+
+    def _user(i: int, n: int, off: int = 0):
+        return [(i * 7919 + (off + j) * 104729) % vocab for j in range(n)]
+
+    async def _gen(engine, tokens, max_tokens, annotations=None):
+        req = PreprocessedRequest(
+            token_ids=list(tokens),
+            stop_conditions=StopConditions(
+                max_tokens=max_tokens, ignore_eos=True
+            ),
+            sampling_options=SamplingOptions(temperature=0.0),
+            annotations=dict(annotations or {}),
+        ).to_dict()
+        t0 = time.perf_counter()
+        stream = await engine.generate(Context(req))
+        out, ttft = [], None
+        async for item in stream:
+            if ttft is None:
+                ttft = (time.perf_counter() - t0) * 1e3
+            out.extend(item.get("token_ids") or [])
+        return out, ttft
+
+    async def run_mode(mode: str, tmpdir: str) -> dict:
+        over: dict = {}
+        if mode == "off" or mode == "pull":
+            over["host_cache_bytes"] = 0
+        elif mode == "host":
+            over["host_cache_bytes"] = 256 << 20
+        elif mode == "disk":
+            over["host_cache_bytes"] = 1  # resized to blocks below
+            over["disk_cache_bytes"] = 256 << 20
+            over["disk_cache_dir"] = tmpdir
+        from dynamo_tpu.engine.config import EngineConfig
+
+        mode_cfg = EngineConfig(**{**base, **over})
+        engine = TpuEngine(mode_cfg)
+        donor = None
+        if mode == "disk":
+            # Tiny host window (4 blocks): almost everything demotes to
+            # disk, so second-occurrence restores exercise disk→host→HBM.
+            engine.host_kv.capacity_bytes = 4 * engine.block_nbytes()
+        if mode == "pull":
+            donor = TpuEngine(EngineConfig(**{**base, "host_cache_bytes": 0}))
+
+            async def exporter(worker_id, data):
+                return await donor.export_prompt_blocks(
+                    data["token_ids"],
+                    start_block=data.get("start_block", 0),
+                    max_blocks=data.get("max_blocks", 0),
+                    salt=data.get("salt"),
+                )
+
+            engine.set_prefix_puller(PrefixPuller(engine, exporter))
+        # Warmup covers every unified token bucket; the priming session
+        # below covers the tier paths (gather/inject/restore pads) warmup
+        # does not reach.  "Zero new compiles" is measured after both.
+        engine.warmup()
+        if donor is not None:
+            donor.warmup()
+        try:
+            streams, ttfts = [], []
+            skipped = total = 0
+
+            async def session(i: int, measured: bool):
+                nonlocal skipped, total
+                t1 = shared_sys + _user(i, ctx_len)
+                serve = donor if mode == "pull" else engine
+                out1, _ = await _gen(serve, t1, osl)
+                await serve.drain_offload()
+                # Evict: filler prompts churn the ENGINE's small HBM pool
+                # between the turns (in pull mode the engine is the cold
+                # target — the donor keeps its cache, as a remote peer
+                # would).
+                for f in range(6):
+                    await _gen(engine, _user(1000 + i * 11 + f, 32), 1)
+                    await engine.drain_offload()
+                t2 = t1 + out1 + _user(i, extra, off=900)
+                hint = None
+                if mode == "pull":
+                    blocks = donor.estimate_prefix_hit(t2) // bs
+                    hint = {"kv_pull": {"worker_id": 0, "blocks": blocks}}
+                lk0, mt0 = engine.kv.lookup_blocks, engine.kv.matched_blocks
+                out2, ttft = await _gen(engine, t2, osl, annotations=hint)
+                if measured:
+                    streams.append(out2)
+                    ttfts.append(ttft)
+                    skipped += engine.kv.matched_blocks - mt0
+                    total += engine.kv.lookup_blocks - lk0
+
+            compiles_ref: list = []
+
+            async def drive():
+                await session(-1, False)  # priming: compiles inject/restore
+                compiles_ref.append(engine.compile_counts())
+                for i in range(sessions):
+                    await session(i, True)
+
+            await drive()
+            stable = engine.compile_counts() == compiles_ref[0]
+            ttfts_s = sorted(ttfts)
+            return {
+                "streams": streams,
+                "ttft_ms_p50": round(ttfts_s[len(ttfts_s) // 2], 2),
+                "skip_frac": round(skipped / total, 4) if total else 0.0,
+                "compile_stable": stable,
+                "pulled_blocks": (
+                    engine.kv.matched_blocks if mode == "pull" else 0
+                ),
+            }
+        finally:
+            await engine.close()
+            if donor is not None:
+                await donor.close()
+
+    import tempfile
+
+    results: dict = {}
+    with tempfile.TemporaryDirectory() as tmpdir:
+        for mode in ("off", "host", "disk", "pull"):
+            results[mode] = asyncio.run(run_mode(mode, tmpdir))
+            r = results[mode]
+            print(
+                f"bench[prefix]: {mode:5s} ttft_p50={r['ttft_ms_p50']}ms "
+                f"skip={r['skip_frac']} compile_stable={r['compile_stable']}",
+                file=sys.stderr,
+            )
+    identical = all(
+        results[m]["streams"] == results["off"]["streams"]
+        for m in ("host", "disk", "pull")
+    )
+    if not identical:
+        raise RuntimeError(
+            "tiered/pulled prefix streams diverged from the no-tier "
+            "control — the exact-stream equivalence invariant is broken"
+        )
+    print("bench[prefix]: streams identical across all modes", file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "prefix_reuse_skip_frac",
+                "value": results["host"]["skip_frac"],
+                "unit": "frac",
+                "vs_baseline": 0.0,
+                "modes": {
+                    m: {k: v for k, v in r.items() if k != "streams"}
+                    for m, r in results.items()
+                },
+                "identical": identical,
+                "compile_stable": all(
+                    r["compile_stable"] for r in results.values()
+                ),
+                "pull_served_blocks": results["pull"]["pulled_blocks"],
+            }
+        )
+    )
+
+
 def main() -> None:
     from dynamo_tpu.engine.engine import TpuEngine
     from dynamo_tpu.models import get_config
@@ -438,6 +635,12 @@ def main() -> None:
         # Continuous-batching churn mode: staggered finishes + late
         # arrivals, continuous vs forced-rebuild (see _churn_bench).
         _churn_bench(cfg, model_cfg)
+        return
+    if os.environ.get("BENCH_PREFIX"):
+        # Tiered-KV prefix-reuse ladder: tiers off / host / host+disk /
+        # cross-worker pull over a shared-prefix multi-turn trace
+        # (see _prefix_bench).
+        _prefix_bench(cfg, model_cfg)
         return
     engine = TpuEngine(cfg)
 

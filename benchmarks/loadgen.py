@@ -187,6 +187,73 @@ async def _sweep_level(url: str, model: str, conc: int, n_requests: int,
     }
 
 
+# ------------------------------------------------------- session/prefix mode
+def _session_prompt(sess: int, turn: int, shared_sys: int, ctx: int,
+                    turn_isl: int, vocab: int) -> List[int]:
+    """Turn ``turn`` prompt of session ``sess``: a SHARED system prefix
+    (identical across all sessions — the fleet-wide reuse target), a
+    per-session context, then one extension per completed turn.  Each
+    turn's prompt strictly extends the previous one, so every turn >= 2 is
+    a prefix-cache (or cross-worker pull) candidate for its whole history."""
+    toks = [(7 * j + 13) % (vocab - 2) + 1 for j in range(shared_sys)]
+    toks += [(sess * 7919 + j * 104729 + 11) % (vocab - 2) + 1 for j in range(ctx)]
+    for t in range(turn):
+        toks += [
+            (sess * 6271 + (t + 1) * 331 + j * 104729) % (vocab - 2) + 1
+            for j in range(turn_isl)
+        ]
+    return toks
+
+
+async def _session_sweep(url: str, model: str, args, vocab: int) -> dict:
+    """Closed-loop multi-turn session replay (docs/kv_tiering.md): every
+    session shares one system prompt and each turn extends its own
+    history.  Per-turn TTFT percentiles make the reuse win visible — with
+    tiers/pull on, turn >= 2 TTFT should sit well under turn 1's."""
+    per_turn: dict = {t: [] for t in range(1, args.turns + 1)}
+    sem = asyncio.Semaphore(max(1, int(args.conc.split(",")[0])))
+
+    async def session(sess: int, http: ClientSession):
+        for turn in range(1, args.turns + 1):
+            prompt = _session_prompt(
+                sess, turn - 1, args.shared_system, args.session_ctx,
+                args.turn_isl, vocab,
+            )
+            async with sem:
+                r = await _one(http, url, model, prompt, args.osl)
+            if r.error is None:
+                per_turn[turn].append(r)
+
+    timeout = ClientTimeout(total=3600, sock_read=600)
+    t0 = time.perf_counter()
+    async with ClientSession(timeout=timeout) as http:
+        await asyncio.gather(*[session(s, http) for s in range(args.sessions)])
+    wall = time.perf_counter() - t0
+    rows = {
+        str(turn): {
+            "ok": len(rs),
+            "ttft_p50_ms": round(_pct([r.ttft_s for r in rs], 0.5) * 1e3, 1),
+            "ttft_p99_ms": round(_pct([r.ttft_s for r in rs], 0.99) * 1e3, 1),
+        }
+        for turn, rs in per_turn.items()
+    }
+    done = [r for rs in per_turn.values() for r in rs]
+    first = [r.ttft_s for r in per_turn.get(1, [])]
+    later = [r.ttft_s for t, rs in per_turn.items() if t > 1 for r in rs]
+    return {
+        "mode": "sessions",
+        "sessions": args.sessions,
+        "turns": args.turns,
+        "shared_system": args.shared_system,
+        "ok": len(done),
+        "wall_s": round(wall, 2),
+        "output_tok_s": round(sum(r.tokens for r in done) / wall, 2) if wall else 0.0,
+        "per_turn": rows,
+        "ttft_turn1_p50_ms": round(_pct(first, 0.5) * 1e3, 1),
+        "ttft_later_turns_p50_ms": round(_pct(later, 0.5) * 1e3, 1),
+    }
+
+
 # ------------------------------------------------------------- trace mode
 async def _run_trace(url: str, model: str, arrivals, vocab: int) -> dict:
     """Open-loop replay: request i fires at its trace timestamp (late
@@ -324,6 +391,10 @@ async def _self_host(args):
         weight_quant=quant,
         cache_dtype="int8" if quant else None,
         kv_scale="auto" if quant else 1.0,
+        # Tiered KV (docs/kv_tiering.md): enable the host/disk tiers for
+        # --sessions prefix-reuse runs (0 = off, matching historical rows).
+        host_cache_bytes=int(os.environ.get("LOADGEN_HOST_CACHE_MB", "0")) << 20,
+        disk_cache_bytes=int(os.environ.get("LOADGEN_DISK_CACHE_MB", "0")) << 20,
     )
     print(
         f"loadgen: self-hosted agg — model={model} layers={model_cfg.num_layers} "
@@ -377,6 +448,22 @@ async def main() -> None:
     ap.add_argument("--trace-seed", type=int, default=0, dest="trace_seed")
     ap.add_argument("--spike-mult", type=float, default=3.0, dest="spike_mult",
                     help="burst/ramp peak multiplier over --trace-rate")
+    # Shared-prefix multi-turn session mode (docs/kv_tiering.md): every
+    # session shares one system prompt; each turn extends its history —
+    # the tiered-KV / cross-worker-pull reuse workload.
+    ap.add_argument("--sessions", type=int, default=0,
+                    help="run N multi-turn sessions instead of the sweep")
+    ap.add_argument("--turns", type=int, default=3,
+                    help="turns per session (turn k extends turn k-1)")
+    ap.add_argument("--shared-system", type=int, default=512,
+                    dest="shared_system",
+                    help="shared system-prompt tokens (identical across "
+                    "sessions)")
+    ap.add_argument("--session-ctx", type=int, default=128,
+                    dest="session_ctx",
+                    help="per-session context tokens")
+    ap.add_argument("--turn-isl", type=int, default=64, dest="turn_isl",
+                    help="new user tokens added per turn")
     args = ap.parse_args()
 
     trace_mode = bool(args.trace or args.trace_file)
@@ -390,6 +477,26 @@ async def main() -> None:
     url, vocab = args.url, args.vocab
     if url is None:
         engine, service, url, vocab = await _self_host(args)
+
+    if args.sessions > 0:
+        try:
+            print(
+                f"loadgen: session mode — {args.sessions} sessions x "
+                f"{args.turns} turns, shared system {args.shared_system} "
+                f"tokens",
+                file=sys.stderr,
+            )
+            row = await _session_sweep(url, args.model, args, vocab)
+            print(json.dumps(row), flush=True)
+            if args.out:
+                with open(args.out, "w") as f:
+                    json.dump({"mode": "sessions", "rows": [row]}, f, indent=1)
+        finally:
+            if service is not None:
+                await service.close()
+            if engine is not None:
+                await engine.close()
+        return
 
     if trace_mode:
         try:

@@ -266,6 +266,12 @@ async def _run(args) -> None:
             from .llm.metrics import engine_dispatch_metrics
 
             engine_dispatch_metrics.set_source(engine.dispatch_summary)
+        # ... and its KV tier gauges (dynamo_tpu_kv_tier_*; also rides the
+        # edge SLO publication as the fleet prefix-hit-rate signal).
+        if hasattr(engine, "kv_tier_summary"):
+            from .llm.metrics import kv_tier_metrics
+
+            kv_tier_metrics.set_source(engine.kv_tier_summary)
         service = HttpService(
             host=args.host, port=args.port,
             qos=_edge_qos(args), kv_usage_fn=kv_usage_fn,
@@ -500,6 +506,35 @@ class WorkerRoles:
             h["metrics_pub"] = await KvMetricsPublisher(
                 endpoint.component, runtime.worker_id, engine.metrics
             ).start()
+            # Fleet-wide prefix reuse (docs/kv_tiering.md): serve this
+            # worker's sealed blocks to peers at kv_export, pull a deeper
+            # peer prefix at admission (router-stamped kv_pull hints), and
+            # — when the disk tier is on — consume the router's
+            # kv_prefetch plane to warm predicted prefixes disk→host.
+            from .llm.kv_router.pull import (
+                KV_EXPORT_ENDPOINT,
+                KvPrefetchConsumer,
+                PrefixPuller,
+                make_client_exporter,
+                make_kv_export_handler,
+            )
+
+            export_ep = endpoint.component.endpoint(KV_EXPORT_ENDPOINT)
+            h["serveds"].append(
+                await export_ep.serve_endpoint(make_kv_export_handler(engine))
+            )
+            pull_client = await export_ep.client()
+            h["pull_client"] = pull_client
+            engine.set_prefix_puller(
+                PrefixPuller(engine, make_client_exporter(pull_client))
+            )
+            if getattr(engine, "disk_kv", None) is not None:
+                h["prefetch"] = await KvPrefetchConsumer(
+                    endpoint.component, engine
+                ).start()
+            from .llm.metrics import kv_tier_metrics
+
+            kv_tier_metrics.set_source(engine.kv_tier_summary)
         await register_model(
             runtime,
             args.model,
@@ -563,6 +598,12 @@ class WorkerRoles:
             await h["disagg"].drain(timeout=10.0)
         for served in reversed(h["serveds"]):
             await served.stop()
+        if h.get("prefetch") is not None:
+            await h["prefetch"].stop()
+        if hasattr(self.engine, "set_prefix_puller"):
+            self.engine.set_prefix_puller(None)
+        if h.get("pull_client") is not None:
+            await h["pull_client"].close()
         if h.get("metrics_pub") is not None:
             await h["metrics_pub"].stop()
         if h.get("router") is not None:
@@ -892,6 +933,28 @@ def main(argv: Optional[list] = None) -> None:
     p_run.add_argument(
         "--kv-cache-dtype", default=None, dest="cache_dtype",
         help="KV page dtype (e.g. float8_e4m3fn halves KV memory)",
+    )
+    p_run.add_argument(
+        "--host-cache-mb", type=int, default=0, dest="host_cache_mb",
+        help="host (CPU RAM) KV tier budget in MiB: sealed blocks survive "
+        "HBM eviction and restore as prefix hits (0 = off)",
+    )
+    p_run.add_argument(
+        "--disk-cache-mb", type=int, default=0, dest="disk_cache_mb",
+        help="disk KV tier budget in MiB: host-tier eviction demotes "
+        "blocks to hash-named files instead of dropping them "
+        "(requires --host-cache-mb; docs/kv_tiering.md)",
+    )
+    p_run.add_argument(
+        "--disk-cache-dir", default=None, dest="disk_cache_dir",
+        help="directory for the disk KV tier's block files "
+        "(default: a per-process dir under the system temp root)",
+    )
+    p_run.add_argument(
+        "--kv-pull-mb", type=int, default=None, dest="kv_pull_mb",
+        help="cross-worker prefix pull byte budget in MiB (the router "
+        "hints a peer holding a deeper prefix; the engine pulls the "
+        "delta over the KV transfer plane instead of recomputing)",
     )
     p_run.add_argument(
         "--kv-scale",

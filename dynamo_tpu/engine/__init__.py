@@ -79,10 +79,15 @@ def build_tpu_engine(args):
         kv_scale=getattr(args, "kv_scale", 1.0),
         checkpoint_path=getattr(args, "checkpoint", None),
         attn_impl=getattr(args, "attn_impl", "auto"),
+        host_cache_bytes=(getattr(args, "host_cache_mb", 0) or 0) << 20,
+        disk_cache_bytes=(getattr(args, "disk_cache_mb", 0) or 0) << 20,
+        disk_cache_dir=getattr(args, "disk_cache_dir", None),
         spec_decode=_spec_decode_section(args),
         lora=lora_section,
         qos=_qos_sched_section(),
     )
+    if getattr(args, "kv_pull_mb", None) is not None:
+        cfg.kv_pull_max_bytes = int(args.kv_pull_mb) << 20
     engine = TpuEngine(cfg)
     _load_adapters(engine, lora_adapters, getattr(args, "model", None))
     return engine

@@ -256,6 +256,24 @@ class EngineConfig:
     host_cache_bytes: int = 0
     # Seconds between offload pump cycles (device gather + async D2H).
     host_offload_interval: float = 0.05
+    # Disk KV tier (engine/disk_cache.py): host-tier LRU eviction DEMOTES
+    # blocks to hash-named files under ``disk_cache_dir`` instead of
+    # dropping them; restores promote disk→host→HBM.  Requires
+    # host_cache_bytes > 0 (demotion feeds it); single-process only.
+    # 0 disables.
+    disk_cache_bytes: int = 0
+    # Directory for the disk tier's block files; None resolves to a
+    # per-process dir under the system temp root.
+    disk_cache_dir: Optional[str] = None
+    # Cross-worker prefix pull (llm/kv_router/pull.py): when the router's
+    # index says a peer holds a strictly longer prefix than every local
+    # tier, the engine pulls the sealed delta blocks over the KV transfer
+    # plane instead of recomputing prefill.  Budgets bound the worst case:
+    # a pull never moves more than ``kv_pull_max_bytes`` and never waits
+    # longer than ``kv_pull_timeout_s`` — past either, local prefill runs
+    # (the disagg degraded-mode shape; the request is never lost).
+    kv_pull_max_bytes: int = 64 << 20
+    kv_pull_timeout_s: float = 5.0
     # Persistent XLA compilation cache dir: None resolves DYN_XLA_CACHE_DIR
     # (default ~/.cache/dynamo_tpu/xla); "" disables.  Makes warmup ~free on
     # worker restart (engine/xla_cache.py; r3 cold warmup was 139.6s).
@@ -295,6 +313,11 @@ class EngineConfig:
         self.spec_decode = SpecDecodeConfig.normalize(self.spec_decode)
         self.lora = LoraConfig.normalize(self.lora)
         self.qos = QosSchedConfig.normalize(self.qos)
+        if self.disk_cache_bytes > 0 and self.host_cache_bytes <= 0:
+            raise ValueError(
+                "disk_cache_bytes requires host_cache_bytes > 0 (the disk "
+                "tier is fed by host-tier demotion)"
+            )
         if self.weight_quant not in (None, "int8"):
             # One check covering every load path (checkpoint / random-init /
             # externally supplied params).

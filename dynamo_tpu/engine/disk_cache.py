@@ -1,0 +1,247 @@
+"""Disk KV tier: the third rung of the memory hierarchy (HBM → host → disk).
+
+Reference direction: CacheGen / Mooncake-style KV tiering (PAPERS.md) — at
+millions of users the working set of shared system prompts and multi-turn
+sessions outgrows host RAM, and a prefix that fell off the host tier is
+still ~100x cheaper to reload from NVMe than to recompute.  Blocks arrive
+here ONLY by demotion from the host tier (``HostKvStore.on_evict``) and
+leave by promotion back into it (``HostOffloadMixin._promote_from_disk``)
+or by LRU eviction — the device never talks to this tier directly.
+
+Layout: one file per block, named by the block's chained sequence hash
+(``{hash:016x}.kvblk``) — the same salted chained-hash identity every other
+tier and the router index key on, so tenant isolation (llm/tenancy KV
+salts) holds structurally here too: a tenant's hashes are the only handles
+that can name its files.  Each file is a small self-describing container
+(magic + JSON header {dtype, shape} + raw payload) validated byte-for-byte
+on read, mirroring ``inject_blocks``'s validate-before-allocate contract:
+a truncated or corrupt file is deleted and treated as a miss, never
+scattered into the cache.
+
+Thread-safety: all mutation happens under one internal lock because
+callers run file I/O off the event loop (``asyncio.to_thread``).  Tier
+transitions (evictions) are RECORDED, not published — event emission must
+happen on the event loop, so the engine drains ``drain_transitions()``
+after each threaded call and publishes from there
+(``TpuEngine._flush_tier_events``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import struct
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_MAGIC = b"DKVB1\n"
+_HLEN = struct.Struct("<I")
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16/fp8 names register with numpy on ml_dtypes import.
+        import ml_dtypes  # noqa: F401
+
+        return np.dtype(name)
+
+
+class DiskKvStore:
+    """hash → one block's pages [L, page_size, 2*kv_heads, head_dim] on disk.
+
+    Byte-budgeted LRU like the host tier; counters mirror HostKvStore so
+    the tier metrics read uniformly.  Single-process only (the demoting
+    host tier holds whole contiguous blocks only in single-process runs —
+    multi-host per-shard dicts are refused at ``put``)."""
+
+    def __init__(self, capacity_bytes: int, directory: str):
+        self.capacity_bytes = capacity_bytes
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        # Transition records get their OWN tiny lock: the event loop drains
+        # them (drain_transitions via _flush_tier_events) and must never
+        # wait behind a thread holding the main lock through file I/O.
+        self._tlock = threading.Lock()
+        # hash → file bytes, LRU-ordered (oldest first).
+        self._index: "OrderedDict[int, int]" = OrderedDict()
+        self._bytes = 0
+        # counters (metrics / tests)
+        self.stored_blocks = 0
+        self.promoted_blocks = 0
+        self.evicted_blocks = 0
+        self.rejected_blocks = 0
+        self.corrupt_blocks = 0
+        # (kind, hash) records for the engine's event flush; "drop" only —
+        # promotion is driven (and recorded) by the engine side.
+        self._transitions: List[Tuple[str, int]] = []
+        # Rebuild the index from an existing directory (a restarted worker
+        # finds its demoted blocks again): coldest = oldest mtime.
+        entries = []
+        for name in os.listdir(directory):
+            if not name.endswith(".kvblk"):
+                continue
+            try:
+                h = int(name[: -len(".kvblk")], 16)
+            except ValueError:
+                continue
+            try:
+                st = os.stat(os.path.join(directory, name))
+            except OSError:
+                continue
+            entries.append((st.st_mtime, h, st.st_size))
+        for _, h, size in sorted(entries):
+            self._index[h] = size
+            self._bytes += size
+
+    # ------------------------------------------------------------------ state
+    def _path(self, seq_hash: int) -> str:
+        return os.path.join(self.directory, f"{seq_hash:016x}.kvblk")
+
+    # Reads are deliberately LOCK-FREE: the main lock is held across file
+    # I/O by executor threads, and the EVENT LOOP calls contains()/
+    # block_nbytes() on hot paths (kv_manager.tier_lookup at eviction,
+    # local_prefix_blocks at admission) — blocking the loop on a disk
+    # write would stall every live stream.  Dict membership/get are
+    # GIL-atomic; a stale answer is safe (a just-evicted hash reads as
+    # present → the later validated get() misses → recompute fallback).
+    def __len__(self) -> int:
+        return len(self._index)
+
+    @property
+    def used_bytes(self) -> int:
+        return self._bytes
+
+    def contains(self, seq_hash: int) -> bool:
+        return seq_hash in self._index
+
+    def block_nbytes(self, seq_hash: int) -> Optional[int]:
+        """On-disk size of one block (index lookup, no I/O) — lets the
+        promotion path budget the copy BEFORE reading any file."""
+        return self._index.get(seq_hash)
+
+    def drain_transitions(self) -> List[Tuple[str, int]]:
+        with self._tlock:
+            out, self._transitions = self._transitions, []
+            return out
+
+    # -------------------------------------------------------------------- put
+    def put(self, seq_hash: int, block) -> bool:
+        """Demote one host-tier block to disk.  Returns False (and the
+        caller emits Removed instead of a disk tier-tag) when the block
+        cannot be taken: multi-host shard dicts, or larger than the whole
+        budget."""
+        if not isinstance(block, np.ndarray):
+            self.rejected_blocks += 1
+            return False
+        header = json.dumps(
+            {"dtype": str(block.dtype), "shape": list(block.shape)}
+        ).encode()
+        payload = np.ascontiguousarray(block).tobytes()
+        blob = _MAGIC + _HLEN.pack(len(header)) + header + payload
+        nbytes = len(blob)
+        with self._lock:
+            if nbytes > self.capacity_bytes:
+                self.rejected_blocks += 1
+                return False
+            if seq_hash in self._index:
+                self._index.move_to_end(seq_hash)
+                return True
+            while self._bytes + nbytes > self.capacity_bytes and self._index:
+                old, old_bytes = self._index.popitem(last=False)  # LRU
+                self._bytes -= old_bytes
+                self.evicted_blocks += 1
+                with self._tlock:
+                    self._transitions.append(("drop", old))
+                try:
+                    os.remove(self._path(old))
+                except OSError:
+                    pass
+            path = self._path(seq_hash)
+            tmp = path + ".tmp"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, path)  # atomic: readers never see a torn file
+            except OSError:
+                logger.exception("disk KV tier write failed for %#x", seq_hash)
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                self.rejected_blocks += 1
+                return False
+            self._index[seq_hash] = nbytes
+            self._bytes += nbytes
+            self.stored_blocks += 1
+            return True
+
+    # -------------------------------------------------------------------- get
+    def get(
+        self,
+        seq_hash: int,
+        expected_shape: Optional[Tuple[int, ...]] = None,
+        expected_dtype=None,
+    ) -> Optional[np.ndarray]:
+        """Read + VALIDATE one block (the inject_blocks contract: a block
+        that fails validation is a miss, never a crash or a wrong scatter).
+        A corrupt file is deleted so it cannot miss forever."""
+        with self._lock:
+            if seq_hash not in self._index:
+                return None
+            path = self._path(seq_hash)
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+            except OSError:
+                self._drop_locked(seq_hash)
+                return None
+            arr = self._parse(blob, expected_shape, expected_dtype)
+            if arr is None:
+                self.corrupt_blocks += 1
+                self._drop_locked(seq_hash)
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                return None
+            self._index.move_to_end(seq_hash)  # touch
+            return arr
+
+    def _parse(
+        self, blob: bytes, expected_shape, expected_dtype
+    ) -> Optional[np.ndarray]:
+        if not blob.startswith(_MAGIC) or len(blob) < len(_MAGIC) + _HLEN.size:
+            return None
+        off = len(_MAGIC)
+        (hlen,) = _HLEN.unpack_from(blob, off)
+        off += _HLEN.size
+        if len(blob) < off + hlen:
+            return None
+        try:
+            header = json.loads(blob[off : off + hlen])
+            dt = _np_dtype(header["dtype"])
+            shape = tuple(int(s) for s in header["shape"])
+        except (ValueError, KeyError, TypeError):
+            return None
+        off += hlen
+        if len(blob) - off != int(np.prod(shape)) * dt.itemsize:
+            return None  # truncated/padded payload
+        if expected_shape is not None and shape != tuple(expected_shape):
+            return None
+        if expected_dtype is not None and dt != np.dtype(expected_dtype):
+            return None
+        return np.frombuffer(blob, dtype=dt, offset=off).reshape(shape)
+
+    def _drop_locked(self, seq_hash: int) -> None:
+        nbytes = self._index.pop(seq_hash, None)
+        if nbytes is not None:
+            self._bytes -= nbytes

@@ -125,8 +125,12 @@ class TpuEngine(
         self._mirror_carry: Any = None
         # Host KV offload tier (engine/host_cache.py).
         self.host_kv = None
+        self.disk_kv = None
         self._offload_queue: List[Tuple[int, Any]] = []
         self._offload_task: Optional[asyncio.Task] = None
+        # Cross-worker prefix pull hook (llm/kv_router/pull.py): the serving
+        # layer wires a PrefixPuller; None = pulls disabled.
+        self._prefix_puller = None
         if cfg.host_cache_bytes > 0:
             # Multi-process: every host keeps a PER-HOST SHARDED tier — it
             # stores only the shards its own devices hold (gathers and
@@ -135,6 +139,38 @@ class TpuEngine(
             from .host_cache import HostKvStore
 
             self.host_kv = HostKvStore(cfg.host_cache_bytes)
+            if cfg.disk_cache_bytes > 0:
+                if jax.process_count() > 1:
+                    # Per-host sharded tiers hold dict shards the disk
+                    # container refuses; multi-host overflow keeps the
+                    # pre-tier drop behaviour.
+                    logger.warning(
+                        "disk KV tier disabled: multi-process runs keep "
+                        "per-host sharded host tiers only"
+                    )
+                else:
+                    import os as _os
+                    import tempfile as _tempfile
+
+                    from .disk_cache import DiskKvStore
+
+                    # The per-PID default is deliberate: block hashes do
+                    # not encode params identity, so a STABLE shared dir
+                    # could restore a previous (differently-seeded) run's
+                    # KV under valid hashes.  Engine-owned dirs are
+                    # removed at close(); only an EXPLICIT disk_cache_dir
+                    # (operator owns params stability) survives restarts
+                    # and benefits from the re-index.
+                    self._disk_dir_owned = cfg.disk_cache_dir is None
+                    d = cfg.disk_cache_dir or _os.path.join(
+                        _tempfile.gettempdir(),
+                        f"dynamo_tpu_kv_{_os.getpid()}",
+                    )
+                    self.disk_kv = DiskKvStore(cfg.disk_cache_bytes, d)
+                    self.host_kv.on_evict = self._demote_to_disk
+            # HBM eviction of a block a lower tier retains emits a
+            # tier-tagged event instead of Removed (kv_manager).
+            self.kv.tier_lookup = self._tier_of
         # Per-dispatch trace: (kind, wall_s, rows, device_tokens); the
         # pipeline records dispatch and fetch separately since they
         # overlap.  Bounded: a long-lived server must not grow it forever.
@@ -645,10 +681,14 @@ class TpuEngine(
             ids, hashes = payload
             async with self._device_lock:
                 await asyncio.to_thread(self._offload_store, ids, hashes)
+            # Followers record host-tier drops too (no event callback to
+            # publish to, but the transition list must not grow forever).
+            self._flush_tier_events()
         elif kind == "restore_host":
             page_ids, hashes = payload
             async with self._device_lock:
                 await asyncio.to_thread(self._restore_inject, page_ids, hashes)
+            self._flush_tier_events()
         else:
             raise ValueError(f"unknown mirror step kind {kind!r}")
 
@@ -918,14 +958,38 @@ class TpuEngine(
         salt = pre.annotations.get("kv_salt") or None
         self._ensure_loop()
         prepared = 0
-        if self.host_kv is not None and len(self.host_kv):
-            # Pull any evicted prefix blocks back from host RAM BEFORE
-            # admission, so the scheduler sees them as prefix-cache hits
-            # (the reference's restore-ahead-of-prefill TTFT win).  The
-            # host tier indexes blocks by the (salted) hashes they sealed
+        if self.host_kv is not None and (
+            len(self.host_kv)
+            or (self.disk_kv is not None and len(self.disk_kv))
+        ):
+            # Pull any evicted prefix blocks back from the host/disk tiers
+            # BEFORE admission, so the scheduler sees them as prefix-cache
+            # hits (the reference's restore-ahead-of-prefill TTFT win).
+            # The tiers index blocks by the (salted) hashes they sealed
             # under, so tenant restores hit exactly their own blocks.
-            prepared += await self._restore_from_host(
+            from ..llm.metrics import kv_tier_metrics
+
+            t0 = time.perf_counter()
+            restored = await self._restore_from_host(
                 list(pre.token_ids), salt
+            )
+            prepared += restored
+            if restored:
+                kv_tier_metrics.restore_latency_ms.observe(
+                    (time.perf_counter() - t0) * 1e3
+                )
+                kv_tier_metrics.restore_hits_total += 1
+            else:
+                kv_tier_metrics.restore_misses_total += 1
+        if self._prefix_puller is not None and pre.annotations.get("kv_pull"):
+            # Cross-worker prefix pull (llm/kv_router/pull.py): the router
+            # stamped a peer that holds a strictly longer prefix than any
+            # local tier; pull the sealed delta blocks over the transfer
+            # plane instead of recomputing prefill.  Bounded by the
+            # configured byte/latency budgets; ANY failure degrades to
+            # local prefill (the disagg degraded-mode shape).
+            prepared += await self._prefix_puller.pull(
+                list(pre.token_ids), salt, pre.annotations["kv_pull"]
             )
         if (
             self._sp_fn is not None
@@ -1051,6 +1115,13 @@ class TpuEngine(
         if self._publisher is not None:
             await self._publisher.close()
             self._publisher = None
+        if self.disk_kv is not None and getattr(self, "_disk_dir_owned", False):
+            # Engine-owned (defaulted) disk-tier dir: remove it so worker
+            # restarts don't leak a dead budget's worth of block files.
+            import shutil
+
+            shutil.rmtree(self.disk_kv.directory, ignore_errors=True)
+            self.disk_kv = None
         # Fail whatever is still in flight so no generate() stream hangs.
         self._fail_all()
 
@@ -1077,6 +1148,101 @@ class TpuEngine(
 
         blocks = hash_token_blocks(token_ids, self.cfg.block_size, salt)
         return len(self.kv.match_prefix(blocks)) * self.cfg.block_size
+
+    # ------------------------------------------------------------ tiered KV
+    def _tier_of(self, seq_hash: int) -> Optional[str]:
+        """Cheapest LOWER tier still holding ``seq_hash`` (HBM excluded —
+        the caller is usually deciding what HBM eviction means)."""
+        if self.host_kv is not None and self.host_kv.contains(seq_hash):
+            return "host"
+        if self.disk_kv is not None and self.disk_kv.contains(seq_hash):
+            return "disk"
+        return None
+
+    def _demote_to_disk(self, seq_hash: int, block) -> bool:
+        """HostKvStore.on_evict hook: push an evicted host-tier block down
+        to disk.  Runs inside the host store's eviction loop (often off the
+        event loop) — record-only, events flush later."""
+        if self.disk_kv is None:
+            return False
+        return self.disk_kv.put(seq_hash, block)
+
+    def _flush_tier_events(self) -> None:
+        """Publish tier transitions recorded by the host/disk stores since
+        the last flush.  Must run on the event loop (the KvEventPublisher
+        binds futures to it); every threaded tier mutation's caller flushes
+        after the thread returns.  A hash still sealed in HBM publishes
+        nothing — the router's view stays 'hbm' until HBM eviction."""
+        if self.host_kv is None:
+            return
+        trans = self.host_kv.drain_transitions()
+        if self.disk_kv is not None:
+            trans += self.disk_kv.drain_transitions()
+        demoted: List[int] = []
+        removed: List[int] = []
+        for kind, h in trans:
+            if h in self.kv._by_hash:
+                continue  # HBM still holds it: best tier unchanged
+            if kind == "demote":
+                demoted.append(h)
+            elif self.host_kv.contains(h) or (
+                self.disk_kv is not None and self.disk_kv.contains(h)
+            ):
+                continue  # another tier still holds it
+            else:
+                removed.append(h)
+        self.kv.emit_tiered("disk", demoted)
+        self.kv.emit_removed(removed)
+
+    def local_prefix_blocks(
+        self, token_ids: List[int], salt: Optional[str] = None
+    ) -> int:
+        """Leading complete blocks restorable from ANY local tier (HBM,
+        host, disk) — what a cross-worker pull must strictly beat before
+        moving bytes (llm/kv_router/pull.py)."""
+        from ..tokens import hash_token_blocks
+
+        n = 0
+        for tb in hash_token_blocks(token_ids, self.cfg.block_size, salt):
+            h = tb.sequence_hash
+            if h in self.kv._by_hash or self._tier_of(h) is not None:
+                n += 1
+            else:
+                break
+        return n
+
+    def set_prefix_puller(self, puller) -> None:
+        """Attach the cross-worker prefix puller (llm/kv_router/pull.py);
+        None detaches.  The serving layer owns peer discovery — the engine
+        only calls ``puller.pull(tokens, salt, hint)`` at admission."""
+        self._prefix_puller = puller
+
+    def block_nbytes(self) -> int:
+        """Host-side bytes of one KV block in the stored representation."""
+        return int(self.cache.pages.nbytes // max(1, self.cfg.num_blocks))
+
+    def kv_tier_summary(self) -> Dict[str, Any]:
+        """Per-tier bytes/blocks gauges for /metrics (llm/metrics.py
+        kv_tier_metrics source) and the edge SLO publication."""
+        bb = self.block_nbytes()
+        out: Dict[str, Any] = {
+            "hbm": {
+                "blocks": len(self.kv._by_hash),
+                "bytes": len(self.kv._by_hash) * bb,
+            },
+            "prefix_hit_rate": self.kv.hit_rate,
+        }
+        if self.host_kv is not None:
+            out["host"] = {
+                "blocks": len(self.host_kv),
+                "bytes": self.host_kv.used_bytes,
+            }
+        if self.disk_kv is not None:
+            out["disk"] = {
+                "blocks": len(self.disk_kv),
+                "bytes": self.disk_kv.used_bytes,
+            }
+        return out
 
     # -------------------------------------------------------------- the loop
     def _ensure_loop(self) -> None:

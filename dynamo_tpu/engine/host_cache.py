@@ -15,10 +15,14 @@ Keyed by chained sequence hash (tokens.py), LRU-bounded by bytes.
 
 from __future__ import annotations
 
+import logging
+import threading
 from collections import OrderedDict
-from typing import Optional
+from typing import Callable, List, Optional, Tuple
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 
 class HostKvStore:
@@ -28,16 +32,41 @@ class HostKvStore:
     the combined-head-axis offset of each locally-held shard to its slice
     (engine._offload_store) — each process's tier holds only what its own
     devices held, and restores reassemble the global array from every
-    process's local contribution."""
+    process's local contribution.
 
-    def __init__(self, capacity_bytes: int):
+    With a disk tier configured (engine/disk_cache.py) LRU eviction DEMOTES
+    instead of dropping: ``on_evict(hash, block) -> bool`` is the engine's
+    demotion hook; a True return means the next tier took the block.  Every
+    eviction is recorded in ``_transitions`` — ("demote", h) or ("drop", h)
+    — for the engine's event flush (tier-tagged KvCacheEvents must be
+    published from the event loop, and eviction often happens inside
+    ``asyncio.to_thread``)."""
+
+    def __init__(
+        self,
+        capacity_bytes: int,
+        on_evict: Optional[Callable[[int, object], bool]] = None,
+    ):
         self.capacity_bytes = capacity_bytes
+        self.on_evict = on_evict
         self._data: "OrderedDict[int, object]" = OrderedDict()
         self._bytes = 0
+        # Mutations come from asyncio.to_thread workers (offload commit,
+        # disk→host promotion) that can overlap — OrderedDict reordering
+        # is not atomic, so serialize every access.  Reads (contains/peek/
+        # len) stay lock-free (GIL-atomic dict ops; stale answers degrade
+        # to a recompute, never corruption) because the EVENT LOOP calls
+        # them on hot paths and the main lock is held across on_evict disk
+        # writes.  Transitions use their own tiny lock for the same
+        # reason (drain_transitions runs on the loop).
+        self._lock = threading.Lock()
+        self._tlock = threading.Lock()
         # counters (metrics / tests)
         self.stored_blocks = 0
         self.restored_blocks = 0
         self.evicted_blocks = 0
+        self.demoted_blocks = 0
+        self._transitions: List[Tuple[str, int]] = []
 
     @staticmethod
     def _nbytes(block) -> int:
@@ -55,26 +84,68 @@ class HostKvStore:
     def contains(self, seq_hash: int) -> bool:
         return seq_hash in self._data
 
+    def admit_bytes(self, nbytes: int) -> bool:
+        """Could ``nbytes`` EVER fit this tier's budget?  The reject-early
+        gate restore/promotion paths consult BEFORE copying anything: an
+        oversized batch must fail before it stages a single byte, not blow
+        the budget transiently and evict the working set for nothing."""
+        return nbytes <= self.capacity_bytes
+
+    def drain_transitions(self) -> List[Tuple[str, int]]:
+        with self._tlock:
+            out, self._transitions = self._transitions, []
+            return out
+
+    def _evict_one(self) -> None:
+        # caller holds self._lock
+        h, old = self._data.popitem(last=False)  # LRU
+        self._bytes -= self._nbytes(old)
+        self.evicted_blocks += 1
+        demoted = False
+        if self.on_evict is not None:
+            try:
+                demoted = bool(self.on_evict(h, old))
+            except Exception:
+                # Demotion is an optimization; a failing disk tier must
+                # never break the host tier's eviction path.
+                logger.exception("host-tier demotion failed for %#x", h)
+        if demoted:
+            self.demoted_blocks += 1
+        with self._tlock:
+            self._transitions.append(("demote" if demoted else "drop", h))
+
     def put(self, seq_hash: int, block) -> None:
-        if seq_hash in self._data:
-            self._data.move_to_end(seq_hash)
-            return
-        nbytes = self._nbytes(block)
-        if nbytes > self.capacity_bytes:
-            return
-        while self._bytes + nbytes > self.capacity_bytes and self._data:
-            _, old = self._data.popitem(last=False)  # LRU
-            self._bytes -= self._nbytes(old)
-            self.evicted_blocks += 1
-        self._data[seq_hash] = block
-        self._bytes += nbytes
-        self.stored_blocks += 1
+        with self._lock:
+            if seq_hash in self._data:
+                self._data.move_to_end(seq_hash)
+                return
+            nbytes = self._nbytes(block)
+            if nbytes > self.capacity_bytes:
+                return
+            while self._bytes + nbytes > self.capacity_bytes and self._data:
+                self._evict_one()
+            self._data[seq_hash] = block
+            self._bytes += nbytes
+            self.stored_blocks += 1
 
     def get(self, seq_hash: int) -> Optional[np.ndarray]:
-        blk = self._data.get(seq_hash)
-        if blk is not None:
-            self._data.move_to_end(seq_hash)  # touch
-        return blk
+        with self._lock:
+            blk = self._data.get(seq_hash)
+            if blk is not None:
+                self._data.move_to_end(seq_hash)  # touch
+            return blk
+
+    def touch(self, seq_hash: int) -> None:
+        """Best-effort recency touch that NEVER blocks: the event loop
+        refreshes LRU order after a restore, and the main lock can be held
+        by a thread through an on_evict disk write — skipping a touch
+        under contention costs at most one suboptimal future eviction."""
+        if self._lock.acquire(blocking=False):
+            try:
+                if seq_hash in self._data:
+                    self._data.move_to_end(seq_hash)
+            finally:
+                self._lock.release()
 
     def peek(self, seq_hash: int):
         """Read WITHOUT the LRU touch.  Multi-host tiers must mutate in

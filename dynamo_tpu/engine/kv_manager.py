@@ -65,6 +65,13 @@ class KvBlockManager:
         self._event_callback = event_callback
         self._event_id = 0
         self._enable_prefix_caching = enable_prefix_caching
+        # Tiered KV cache (engine/{host_cache,disk_cache}.py): maps a
+        # sequence hash to the lower tier still holding its contents
+        # ("host"/"disk") or None.  When set, HBM eviction of a block a
+        # lower tier retains emits a TIER-TAGGED event instead of Removed —
+        # the router keeps scoring the worker for that prefix, discounted
+        # by restore cost, instead of forgetting it.
+        self.tier_lookup: Optional[Callable[[int], Optional[str]]] = None
         # cumulative counters for metrics
         self.lookup_blocks = 0
         self.matched_blocks = 0
@@ -94,6 +101,25 @@ class KvBlockManager:
     def _next_event_id(self) -> int:
         self._event_id += 1
         return self._event_id
+
+    def emit_tiered(self, tier: str, block_hashes: Sequence[int]) -> None:
+        """Publish a tier change for blocks this manager does not hold in
+        HBM (host→disk demotion, disk→host promotion) — the engine's tier
+        stores have no event plane of their own."""
+        if block_hashes and self._enable_prefix_caching:
+            self._emit(
+                KvCacheEvent.tiered(
+                    self._next_event_id(), tier, list(block_hashes)
+                )
+            )
+
+    def emit_removed(self, block_hashes: Sequence[int]) -> None:
+        """Publish the loss of blocks evicted from the LAST tier holding
+        them (see emit_tiered)."""
+        if block_hashes and self._enable_prefix_caching:
+            self._emit(
+                KvCacheEvent.removed(self._next_event_id(), list(block_hashes))
+            )
 
     # ------------------------------------------------------------- allocation
     def match_prefix(self, token_blocks: Sequence[TokenBlock]) -> List[int]:
@@ -129,17 +155,26 @@ class KvBlockManager:
         return fresh_needed <= self.free_blocks - revived
 
     def allocate_sequence(
-        self, token_blocks: Sequence[TokenBlock], num_blocks_needed: int
+        self,
+        token_blocks: Sequence[TokenBlock],
+        num_blocks_needed: int,
+        count_hits: bool = True,
     ) -> Optional[Tuple[List[int], int]]:
         """Allocate ``num_blocks_needed`` blocks for a prompt whose complete
         blocks are ``token_blocks`` (hashed).  Leading blocks already resident
         are shared (ref++) instead of recomputed.
 
+        ``count_hits=False`` skips the hit-rate counters — transfer-plane
+        injections (inject_blocks) are bookkeeping, not request admissions,
+        and counting them would skew gpu_prefix_cache_hit_rate the same way
+        acquire_prefix's docstring warns about pinning.
+
         Returns (block_ids, num_cached_tokens) or None if out of capacity.
         """
         matched = self.match_prefix(token_blocks)
-        self.lookup_blocks += len(token_blocks)
-        self.matched_blocks += len(matched)
+        if count_hits:
+            self.lookup_blocks += len(token_blocks)
+            self.matched_blocks += len(matched)
         if not self.would_fit(token_blocks, num_blocks_needed, matched):
             return None
         fresh_needed = num_blocks_needed - len(matched)
@@ -191,9 +226,25 @@ class KvBlockManager:
             blk = self._blocks[bid]
             if blk.sequence_hash is not None:
                 self._by_hash.pop(blk.sequence_hash, None)
-                self._emit(
-                    KvCacheEvent.removed(self._next_event_id(), [blk.sequence_hash])
+                # Tiered cache: a lower tier still holding the contents
+                # demotes the router's view instead of erasing it.
+                tier = (
+                    self.tier_lookup(blk.sequence_hash)
+                    if self.tier_lookup is not None
+                    else None
                 )
+                if tier is not None:
+                    self._emit(
+                        KvCacheEvent.tiered(
+                            self._next_event_id(), tier, [blk.sequence_hash]
+                        )
+                    )
+                else:
+                    self._emit(
+                        KvCacheEvent.removed(
+                            self._next_event_id(), [blk.sequence_hash]
+                        )
+                    )
             blk.sequence_hash = blk.parent_hash = blk.tokens_hash = None
             return bid
         return None

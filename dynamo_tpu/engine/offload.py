@@ -37,7 +37,17 @@ class HostOffloadMixin:
 
     async def drain_offload(self, max_blocks: int = 64) -> int:
         """Copy up to ``max_blocks`` queued sealed blocks to host RAM.
-        Returns how many were stored (public so tests can force a cycle)."""
+        Returns how many were stored (public so tests can force a cycle).
+
+        The device lock is held only for the GATHER DISPATCH: the gather's
+        output is a fresh buffer independent of the (donated) cache, and
+        the device executes queued programs in order, so once it is
+        enqueued the D2H force + host-tier copy can run outside the lock —
+        decode dispatch never waits on an offload's host copy (the r5
+        drain held the lock across the whole batched D2H).  Multi-process
+        runs keep the combined under-lock path: the leader's store must
+        complete before the mirror publish so a leader-side failure leaves
+        every process's tier unchanged (no tier skew)."""
         if self.host_kv is None or not self._offload_queue:
             return 0
         batch, self._offload_queue = (
@@ -58,16 +68,39 @@ class HostOffloadMixin:
             ids = np.zeros((pad,), np.int32)
             ids[: len(live)] = [bid for bid, _ in live]
             hashes = [tb.sequence_hash for _, tb in live]
-            # Leader stores FIRST, publish only on success — still under
-            # the device lock, so no other dispatch can interleave and the
-            # followers' execution position matches the leader's.  A
-            # leader-side failure then leaves every tier unchanged instead
-            # of followers holding blocks the leader lacks (tier skew would
-            # surface later as a fatal restore divergence).
-            await asyncio.to_thread(self._offload_store, ids, hashes)
-            if self._publisher is not None:
-                await self._publisher.publish("offload", (ids, hashes))
+            if jax.process_count() > 1:
+                # Leader stores FIRST, publish only on success — still
+                # under the device lock, so no other dispatch can
+                # interleave and the followers' execution position matches
+                # the leader's.  A leader-side failure then leaves every
+                # tier unchanged instead of followers holding blocks the
+                # leader lacks (tier skew would surface later as a fatal
+                # restore divergence).
+                await asyncio.to_thread(self._offload_store, ids, hashes)
+                if self._publisher is not None:
+                    await self._publisher.publish("offload", (ids, hashes))
+                # Host-tier drops still record transitions here (no disk
+                # tier multi-process) — flush them or the list grows
+                # unboundedly and the router keeps advertising prefixes
+                # this worker can no longer restore.
+                self._flush_tier_events()
+                return len(live)
+            # Single-process: enqueue the gather under the lock (ordering
+            # vs later donating steps), copy/store outside it.
+            pages_g = await asyncio.to_thread(
+                self._gather_fn, self.cache, self._prep(ids)
+            )
+        await asyncio.to_thread(self._offload_commit, pages_g, hashes)
+        self._flush_tier_events()
         return len(live)
+
+    def _offload_commit(self, pages_g, hashes: List[int]) -> None:
+        """Force the gathered pages to host and store them in the host tier
+        (single-process half of _offload_store, runs OUTSIDE the device
+        lock)."""
+        pages = np.asarray(pages_g)
+        for i, h in enumerate(hashes):
+            self.host_kv.put(h, np.ascontiguousarray(pages[:, i]))
 
     def _offload_store(self, ids: np.ndarray, hashes: List[int]) -> None:
         """Gather ``ids``'s pages and store THIS PROCESS's portion in the
@@ -153,21 +186,130 @@ class HostOffloadMixin:
             )
         return covered
 
+    def _promote_blocks(
+        self, seq_hashes: List[int], stop_on_miss: bool
+    ) -> List[int]:
+        """Disk→host promotion (thread context): read + validate each
+        block's file and insert it into the host tier.  Byte budget is
+        counted against the DESTINATION tier before any file is read —
+        an oversized batch rejects early instead of transiently blowing
+        the host budget (and evicting the working set for nothing).
+        ``stop_on_miss`` stops at the first unavailable hash (prefix
+        restores need a contiguous leading run); prefetch skips instead."""
+        L, _, ps, KV2, hd = self.cache.pages.shape
+        shape, dtype = (L, ps, KV2, hd), self.cache.pages.dtype
+        staged = 0
+        promoted: List[int] = []
+        for h in seq_hashes:
+            if self.host_kv.contains(h):
+                continue
+            nbytes = self.disk_kv.block_nbytes(h)
+            if nbytes is None:
+                if stop_on_miss:
+                    break
+                continue
+            if not self.host_kv.admit_bytes(staged + nbytes):
+                break  # destination budget exhausted: reject BEFORE copying
+            arr = self.disk_kv.get(h, expected_shape=shape, expected_dtype=dtype)
+            if arr is None:  # corrupt/truncated file: dropped, a miss
+                if stop_on_miss:
+                    break
+                continue
+            self.host_kv.put(h, arr)
+            staged += nbytes
+            promoted.append(h)
+        if promoted:
+            from ..llm.metrics import kv_tier_metrics
+
+            self.disk_kv.promoted_blocks += len(promoted)
+            kv_tier_metrics.promoted_blocks_total += len(promoted)
+        return promoted
+
+    def _emit_promotions(self, promoted: List[int]) -> None:
+        """Tier-tag promoted blocks back to 'host' (unless HBM still holds
+        them, in which case the router's view never left 'hbm'), then flush
+        any demotions the promotion's own evictions caused."""
+        self.kv.emit_tiered(
+            "host", [h for h in promoted if h not in self.kv._by_hash]
+        )
+        self._flush_tier_events()
+
+    async def prefetch_hashes(self, seq_hashes: List[int]) -> int:
+        """Warm predicted prefixes disk→host ahead of arrivals (the
+        planner/prefetch plane's engine hook — llm/kv_router/pull.py
+        KvPrefetchConsumer).  Returns blocks promoted; skips hashes already
+        resident in a faster tier."""
+        if self.disk_kv is None or self.host_kv is None or not seq_hashes:
+            return 0
+        want = [h for h in seq_hashes if h not in self.kv._by_hash]
+        if not want:
+            return 0
+        promoted = await asyncio.to_thread(self._promote_blocks, want, False)
+        if promoted:
+            from ..llm.metrics import kv_tier_metrics
+
+            kv_tier_metrics.prefetched_blocks_total += len(promoted)
+        self._emit_promotions(promoted)
+        return len(promoted)
+
+    async def restore_prefix(
+        self, token_ids: List[int], salt: Optional[str] = None
+    ) -> int:
+        """Public tier restore: bring ``token_ids``'s leading blocks back
+        into HBM from the host/disk tiers if any are resident there.
+        Used by admission (generate) and by the donor side of a
+        cross-worker pull — export_prompt_blocks reads HBM only, so a
+        donor whose blocks were demoted restores them before exporting
+        (the pull's primary scenario IS tier-demoted donors)."""
+        if self.host_kv is None or not (
+            len(self.host_kv)
+            or (self.disk_kv is not None and len(self.disk_kv))
+        ):
+            return 0
+        return await self._restore_from_host(token_ids, salt)
+
     async def _restore_from_host(
         self, token_ids: List[int], salt: Optional[str] = None
     ) -> int:
-        """Scatter host-tier blocks beyond the HBM-resident prefix back into
-        the device cache (sealed + released to the reuse pool), so admission
-        sees them as ordinary prefix-cache hits.  Returns restored blocks.
-        ``salt`` (llm/tenancy): the host tier indexes blocks by the SALTED
-        sequence hashes they sealed under, so tenant restores look up with
-        the tenant's salt — and can never resurrect another tenant's KV."""
+        """Scatter host/disk-tier blocks beyond the HBM-resident prefix
+        back into the device cache (sealed + released to the reuse pool),
+        so admission sees them as ordinary prefix-cache hits.  Returns
+        restored blocks.  Iterates promote→restore rounds until no
+        progress: a prefix deeper than the host tier's byte budget still
+        restores fully, one host-budget's worth per round (disk → host →
+        HBM).  ``salt`` (llm/tenancy): the tiers index blocks by the
+        SALTED sequence hashes they sealed under, so tenant restores look
+        up with the tenant's salt — and can never resurrect another
+        tenant's KV."""
+        total = 0
+        while True:
+            n = await self._restore_pass(token_ids, salt)
+            if n <= 0:
+                return total
+            total += n
+            if self.disk_kv is None:
+                return total  # one pass covers the whole host-resident run
+
+    async def _restore_pass(
+        self, token_ids: List[int], salt: Optional[str] = None
+    ) -> int:
+        """One promote→restore round of ``_restore_from_host``."""
         if self.host_kv is None:
             return 0
         from ..tokens import hash_token_blocks
 
         blocks = hash_token_blocks(token_ids, self.cfg.block_size, salt)
         resident = len(self.kv.match_prefix(blocks))
+        if self.disk_kv is not None and len(self.disk_kv):
+            # Promote the leading disk-resident run into the host tier
+            # first, so the host→HBM scatter below sees one contiguous
+            # restorable prefix (disk → host → HBM).
+            promoted = await asyncio.to_thread(
+                self._promote_blocks,
+                [tb.sequence_hash for tb in blocks[resident:]],
+                True,
+            )
+            self._emit_promotions(promoted)
         run: List[Tuple[Any, np.ndarray]] = []
         for tb in blocks[resident:]:
             # peek, not get: this is candidate selection (possibly
@@ -246,13 +388,18 @@ class HostOffloadMixin:
                     )
                 # Candidate selection peeked; refresh recency for the
                 # blocks actually restored (single-process has no
-                # cross-process lockstep to preserve).
+                # cross-process lockstep to preserve).  touch(), not
+                # get(): this runs ON THE EVENT LOOP and must never wait
+                # behind a thread holding the lock through a disk write.
                 for tb, _ in run:
-                    self.host_kv.get(tb.sequence_hash)
+                    self.host_kv.touch(tb.sequence_hash)
             for bid, (tb, _) in zip(ids, run):
                 self.kv.seal_block(bid, tb)
             self.kv.free_sequence(ids)
             self.host_kv.restored_blocks += n
+            from ..llm.metrics import kv_tier_metrics
+
+            kv_tier_metrics.restored_blocks_total += n
             return n
         finally:
             if prefix_ids:

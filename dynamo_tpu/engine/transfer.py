@@ -164,11 +164,11 @@ class KvTransferMixin:
         shape = tuple(payload["shape"])
         name = payload["dtype"]
         dt = jnp.dtype(name)  # ml_dtypes registers bf16/fp8 names
-        try:
-            k = np.frombuffer(payload["k"], dtype=dt).reshape(shape)[:, :n]
-            v = np.frombuffer(payload["v"], dtype=dt).reshape(shape)[:, :n]
-        except ValueError:
-            logger.warning("rejecting KV import: malformed payload arrays")
+        expected = int(np.prod(shape)) * dt.itemsize
+        if len(payload["k"]) != expected or len(payload["v"]) != expected:
+            # Byte-length mismatch against the claimed shape: reject before
+            # any array is even viewed, let alone copied.
+            logger.warning("rejecting KV import: payload bytes != shape")
             return 0
         if shape[1] < n:
             logger.warning(
@@ -176,11 +176,26 @@ class KvTransferMixin:
                 "%d", shape[1], n,
             )
             return 0
+        if not self.kv.would_fit(blocks, n):
+            # Destination-budget reject-early: an import the block pool
+            # cannot take must fail BEFORE the interleave below stages a
+            # payload-sized copy in host RAM (and before allocation could
+            # evict sealed contents it frees right back).
+            logger.warning(
+                "rejecting KV import: %d blocks exceed free KV capacity", n
+            )
+            return 0
+        try:
+            k = np.frombuffer(payload["k"], dtype=dt).reshape(shape)[:, :n]
+            v = np.frombuffer(payload["v"], dtype=dt).reshape(shape)[:, :n]
+        except ValueError:
+            logger.warning("rejecting KV import: malformed payload arrays")
+            return 0
         # Interleave back to combined pages [L, n, ps, 2KV, hd] (K even).
         comb = np.stack([k, v], axis=4).reshape(
             k.shape[0], n, k.shape[2], 2 * k.shape[3], k.shape[4]
         )
-        alloc = self.kv.allocate_sequence(blocks, n)
+        alloc = self.kv.allocate_sequence(blocks, n, count_hits=False)
         if alloc is None:
             return 0  # no capacity; caller falls back to local prefill
         ids, cached = alloc
@@ -264,7 +279,7 @@ class KvTransferMixin:
                 self.cache.pages.shape, self.cache.pages.dtype,
             )
             return 0
-        alloc = self.kv.allocate_sequence(blocks[:n], n)
+        alloc = self.kv.allocate_sequence(blocks[:n], n, count_hits=False)
         if alloc is None:
             return 0
         ids, _ = alloc

@@ -4,21 +4,42 @@ Protocol types (events, metrics) are shared with the engine, which emits
 them; the indexer/scheduler consume them to pick workers by prefix overlap.
 """
 
-from .indexer import KvIndexer, KvIndexerSharded, OverlapScores  # noqa: F401
+from .indexer import (  # noqa: F401
+    DEFAULT_TIER_WEIGHTS,
+    KvIndexer,
+    KvIndexerSharded,
+    OverlapScores,
+)
 from .protocols import (  # noqa: F401
     ForwardPassMetrics,
     KvCacheEvent,
     KvCacheRemoveData,
     KvCacheStoreData,
     KvCacheStoredBlockData,
+    KvCacheTierData,
 )
 from .publisher import (  # noqa: F401
     KvEventPublisher,
     KvMetricsAggregator,
     KvMetricsPublisher,
 )
+from .pull import (  # noqa: F401
+    KV_EXPORT_ENDPOINT,
+    KV_PREFETCH_TOPIC,
+    KvPrefetchConsumer,
+    KvPrefetchPublisher,
+    PrefixPuller,
+    make_client_exporter,
+    make_kv_export_handler,
+)
 from .recorder import KvRecorder, replay_events  # noqa: F401
-from .router import KvPushRouter, KvRouter, KvRouterCore, make_kv_router  # noqa: F401
+from .router import (  # noqa: F401
+    HotChainTracker,
+    KvPushRouter,
+    KvRouter,
+    KvRouterCore,
+    make_kv_router,
+)
 from .scheduler import (  # noqa: F401
     DefaultWorkerSelector,
     KvScheduler,

@@ -1,10 +1,21 @@
-"""Global KV prefix index: which worker holds which cached blocks.
+"""Global KV prefix index: which worker holds which cached blocks, and in
+which memory tier.
 
 Reference semantics (not code): lib/llm/src/kv_router/indexer.rs — a radix
 structure over *chained* block hashes with a per-node worker set;
 ``apply_event`` ingests per-worker ``KvCacheEvent``s (Stored/Removed/Cleared)
 and ``find_matches`` walks a request's block-hash chain, returning per-worker
 overlap counts (how many leading blocks each worker already holds).
+
+Tiered extension (docs/kv_tiering.md): engines with a host/disk tier emit
+TIER-TAGGED events on demotion (HBM eviction of a block the host tier
+retains publishes ``tiered{host}`` instead of ``Removed``; host→disk
+demotion publishes ``tiered{disk}``) so the index keeps the block matchable
+— discounted by restore cost.  ``find_matches`` therefore returns BOTH the
+raw per-worker overlap (block counts, what a cross-worker pull compares)
+and a DISCOUNTED overlap (each block weighted by its tier: hbm 1.0 > host >
+disk) that the scheduler's cost function scores with, so a deep-but-cold
+prefix loses to a shallow-but-hot one deterministically.
 
 Because block hashes are chained (dynamo_tpu.tokens), one hash already
 identifies its whole prefix, so lookup is a flat dict walk rather than an
@@ -17,56 +28,109 @@ very large indexes over hash shards (indexer.rs:499-796).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Sequence, Set
+from typing import Dict, Mapping, Optional, Sequence, Set
 
 from ...tokens import fast_sequence_hashes
-from .protocols import KvCacheEvent, KvCacheRemoveData, KvCacheStoreData
+from .protocols import (
+    TIER_HBM,
+    KvCacheEvent,
+    KvCacheRemoveData,
+    KvCacheStoreData,
+    KvCacheTierData,
+)
 
 WorkerId = int
+
+# Restore-cost discount per tier: one block's contribution to the
+# discounted overlap score.  HBM is free (the block is live), host costs
+# one scatter, disk costs a file read + promotion + scatter.  Unknown tier
+# names (forward compat) score like disk — matchable but expensive.
+DEFAULT_TIER_WEIGHTS: Dict[str, float] = {
+    "hbm": 1.0,
+    "host": 0.75,
+    "disk": 0.45,
+}
 
 
 @dataclass
 class OverlapScores:
-    """worker → number of leading request blocks it already caches."""
+    """worker → number of leading request blocks it already caches.
+
+    ``scores`` is the RAW block count (prefix depth — what a cross-worker
+    pull compares and KVHitRateEvents report); ``discounted`` weights each
+    block by its tier's restore cost (what the scheduler scores with)."""
 
     scores: Dict[WorkerId, int] = field(default_factory=dict)
+    discounted: Dict[WorkerId, float] = field(default_factory=dict)
+
+    def discounted_for(self, worker: WorkerId) -> float:
+        """Tier-discounted overlap; falls back to the raw count for
+        overlap sources that never tagged tiers (pre-tier publishers)."""
+        got = self.discounted.get(worker)
+        return float(self.scores.get(worker, 0)) if got is None else got
 
     def best(self) -> Optional[WorkerId]:
         if not self.scores:
             return None
-        return max(self.scores, key=self.scores.get)
+        return max(
+            self.scores,
+            key=lambda w: (self.discounted_for(w), self.scores[w], -w),
+        )
+
+    def deepest(self) -> Optional[WorkerId]:
+        """Worker with the longest RAW prefix (ties → lowest id,
+        deterministic) — the cross-worker pull's donor candidate."""
+        if not self.scores:
+            return None
+        return max(self.scores, key=lambda w: (self.scores[w], -w))
 
 
 @dataclass
 class _Node:
-    workers: Set[WorkerId] = field(default_factory=set)
+    # worker → tier name currently holding this block ("hbm"/"host"/"disk").
+    workers: Dict[WorkerId, str] = field(default_factory=dict)
     parent_hash: Optional[int] = None
 
 
 class RadixIndex:
-    """Hash → worker-set index with per-worker reverse map for fast removal."""
+    """Hash → worker/tier index with per-worker reverse map for removal."""
 
-    def __init__(self) -> None:
+    def __init__(self, tier_weights: Optional[Mapping[str, float]] = None):
         self._nodes: Dict[int, _Node] = {}
         self._by_worker: Dict[WorkerId, Set[int]] = {}
+        self.tier_weights = dict(tier_weights or DEFAULT_TIER_WEIGHTS)
 
     def __len__(self) -> int:
         return len(self._nodes)
 
+    def _weight(self, tier: str) -> float:
+        return self.tier_weights.get(tier, self.tier_weights.get("disk", 0.45))
+
     def add_block(
-        self, worker: WorkerId, seq_hash: int, parent_hash: Optional[int]
+        self,
+        worker: WorkerId,
+        seq_hash: int,
+        parent_hash: Optional[int],
+        tier: str = TIER_HBM,
     ) -> None:
         node = self._nodes.get(seq_hash)
         if node is None:
             node = self._nodes[seq_hash] = _Node(parent_hash=parent_hash)
-        node.workers.add(worker)
+        node.workers[worker] = tier
         self._by_worker.setdefault(worker, set()).add(seq_hash)
+
+    def set_tier(self, worker: WorkerId, seq_hash: int, tier: str) -> None:
+        """Apply a tier-tagged event: the block is still restorable on
+        ``worker``, now from ``tier``.  Unknown blocks are ADDED — a tier
+        event for a block the index missed (e.g. an index started after
+        the Stored) still recovers matchable state."""
+        self.add_block(worker, seq_hash, None, tier=tier)
 
     def remove_block(self, worker: WorkerId, seq_hash: int) -> None:
         node = self._nodes.get(seq_hash)
         if node is None:
             return
-        node.workers.discard(worker)
+        node.workers.pop(worker, None)
         owned = self._by_worker.get(worker)
         if owned is not None:
             owned.discard(seq_hash)
@@ -77,35 +141,47 @@ class RadixIndex:
         for seq_hash in self._by_worker.pop(worker, set()):
             node = self._nodes.get(seq_hash)
             if node is not None:
-                node.workers.discard(worker)
+                node.workers.pop(worker, None)
                 if not node.workers:
                     del self._nodes[seq_hash]
 
-    def workers_for(self, seq_hash: int) -> Set[WorkerId]:
+    def workers_for(self, seq_hash: int) -> Dict[WorkerId, str]:
+        """worker → tier for one block (empty when unknown)."""
         node = self._nodes.get(seq_hash)
-        return node.workers if node is not None else set()
+        return node.workers if node is not None else {}
 
     def find_matches(self, seq_hashes: Sequence[int]) -> OverlapScores:
         """Per-worker count of leading blocks present (a worker's count stops
-        at its first missing block — prefix semantics)."""
+        at its first missing block — prefix semantics) plus the
+        tier-discounted sum over the same run."""
         scores: Dict[WorkerId, int] = {}
+        discounted: Dict[WorkerId, float] = {}
         active: Optional[Set[WorkerId]] = None
         for i, h in enumerate(seq_hashes):
             holders = self.workers_for(h)
-            active = set(holders) if active is None else active & holders
+            active = (
+                set(holders) if active is None else active & set(holders)
+            )
             if not active:
                 break
             for w in active:
                 scores[w] = i + 1
-        return OverlapScores(scores)
+                discounted[w] = discounted.get(w, 0.0) + self._weight(
+                    holders[w]
+                )
+        return OverlapScores(scores, discounted)
 
 
 class KvIndexer:
     """Event-driven index over one worker fleet (one model endpoint)."""
 
-    def __init__(self, block_size: int):
+    def __init__(
+        self,
+        block_size: int,
+        tier_weights: Optional[Mapping[str, float]] = None,
+    ):
         self.block_size = block_size
-        self._index = RadixIndex()
+        self._index = RadixIndex(tier_weights)
         self.events_applied = 0
 
     def apply_event(self, worker: WorkerId, event: KvCacheEvent) -> None:
@@ -120,6 +196,9 @@ class KvIndexer:
         elif isinstance(data, KvCacheRemoveData):
             for h in data.block_hashes:
                 self._index.remove_block(worker, h)
+        elif isinstance(data, KvCacheTierData):
+            for h in data.block_hashes:
+                self._index.set_tier(worker, h, data.tier)
         else:  # cleared
             self._index.remove_worker(worker)
         self.events_applied += 1
@@ -152,10 +231,17 @@ class KvIndexerSharded:
     queries every shard per block (cheap dict hits) — the win is bounded
     per-shard memory and, later, per-shard threads/processes."""
 
-    def __init__(self, block_size: int, num_shards: int = 4):
+    def __init__(
+        self,
+        block_size: int,
+        num_shards: int = 4,
+        tier_weights: Optional[Mapping[str, float]] = None,
+    ):
         self.block_size = block_size
         self.num_shards = num_shards
-        self._shards = [KvIndexer(block_size) for _ in range(num_shards)]
+        self._shards = [
+            KvIndexer(block_size, tier_weights) for _ in range(num_shards)
+        ]
 
     def _shard_for(self, seq_hash: int) -> KvIndexer:
         return self._shards[seq_hash % self.num_shards]
@@ -170,6 +256,9 @@ class KvIndexerSharded:
         elif isinstance(data, KvCacheRemoveData):
             for h in data.block_hashes:
                 self._shard_for(h)._index.remove_block(worker, h)
+        elif isinstance(data, KvCacheTierData):
+            for h in data.block_hashes:
+                self._shard_for(h)._index.set_tier(worker, h, data.tier)
         else:
             for shard in self._shards:
                 shard.remove_worker(worker)
@@ -181,14 +270,23 @@ class KvIndexerSharded:
     def find_matches(
         self, token_ids: Sequence[int], salt: Optional[str] = None
     ) -> OverlapScores:
-        hashes = fast_sequence_hashes(token_ids, self.block_size, salt)
+        return self.find_matches_for_hashes(
+            fast_sequence_hashes(token_ids, self.block_size, salt)
+        )
+
+    def find_matches_for_hashes(self, seq_hashes: Sequence[int]) -> OverlapScores:
         scores: Dict[WorkerId, int] = {}
+        discounted: Dict[WorkerId, float] = {}
         active: Optional[Set[WorkerId]] = None
-        for i, h in enumerate(hashes):
-            holders = self._shard_for(h)._index.workers_for(h)
-            active = set(holders) if active is None else active & holders
+        for i, h in enumerate(seq_hashes):
+            shard = self._shard_for(h)._index
+            holders = shard.workers_for(h)
+            active = set(holders) if active is None else active & set(holders)
             if not active:
                 break
             for w in active:
                 scores[w] = i + 1
-        return OverlapScores(scores)
+                discounted[w] = discounted.get(w, 0.0) + shard._weight(
+                    holders[w]
+                )
+        return OverlapScores(scores, discounted)

@@ -40,12 +40,32 @@ class KvCacheRemoveData:
     block_hashes: List[int] = field(default_factory=list)
 
 
+# KV tier names, best (cheapest restore) first.  These label tier-tagged
+# cache events and the indexer's discounted overlap weights.
+TIER_HBM = "hbm"
+TIER_HOST = "host"
+TIER_DISK = "disk"
+KV_TIERS = (TIER_HBM, TIER_HOST, TIER_DISK)
+
+
+@dataclass(frozen=True)
+class KvCacheTierData:
+    """Blocks DEMOTED to (or promoted back up to) a lower tier but still
+    restorable — the router keeps them matchable, discounted by restore
+    cost, instead of forgetting them as Removed.  ``tier`` names where the
+    cheapest surviving copy now lives."""
+
+    tier: str  # one of KV_TIERS (never "hbm": Stored covers that)
+    block_hashes: List[int] = field(default_factory=list)
+
+
 @dataclass(frozen=True)
 class KvCacheEvent:
-    """One cache mutation; ``data`` is Store, Remove, or None (= cleared)."""
+    """One cache mutation; ``data`` is Store, Remove, TierChange, or None
+    (= cleared)."""
 
     event_id: int
-    data: Any  # KvCacheStoreData | KvCacheRemoveData | None
+    data: Any  # KvCacheStoreData | KvCacheRemoveData | KvCacheTierData | None
 
     def to_dict(self) -> Dict[str, Any]:
         if isinstance(self.data, KvCacheStoreData):
@@ -57,6 +77,13 @@ class KvCacheEvent:
             }
         elif isinstance(self.data, KvCacheRemoveData):
             payload = {"removed": {"block_hashes": list(self.data.block_hashes)}}
+        elif isinstance(self.data, KvCacheTierData):
+            payload = {
+                "tiered": {
+                    "tier": self.data.tier,
+                    "block_hashes": list(self.data.block_hashes),
+                }
+            }
         else:
             payload = {"cleared": {}}
         return {"event_id": self.event_id, "data": payload}
@@ -72,6 +99,11 @@ class KvCacheEvent:
             )
         elif "removed" in payload:
             data = KvCacheRemoveData(block_hashes=list(payload["removed"]["block_hashes"]))
+        elif "tiered" in payload:
+            t = payload["tiered"]
+            data = KvCacheTierData(
+                tier=t["tier"], block_hashes=list(t["block_hashes"])
+            )
         else:
             data = None
         return cls(event_id=d["event_id"], data=data)
@@ -88,6 +120,12 @@ class KvCacheEvent:
     @classmethod
     def removed(cls, event_id: int, block_hashes: List[int]) -> "KvCacheEvent":
         return cls(event_id, KvCacheRemoveData(block_hashes))
+
+    @classmethod
+    def tiered(
+        cls, event_id: int, tier: str, block_hashes: List[int]
+    ) -> "KvCacheEvent":
+        return cls(event_id, KvCacheTierData(tier, block_hashes))
 
 
 @dataclass

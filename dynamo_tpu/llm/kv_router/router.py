@@ -25,11 +25,87 @@ from typing import Any, AsyncIterator, Dict, Optional, Tuple
 
 from ...runtime.client import Client
 from ...runtime.engine import AsyncEngine, Context, ResponseStream
-from .indexer import KvIndexer, KvIndexerSharded, WorkerId
+from ...tokens import fast_sequence_hashes
+from .indexer import KvIndexer, KvIndexerSharded, OverlapScores, WorkerId
 from .publisher import KV_EVENTS_TOPIC, KvMetricsAggregator, unpack_message
 from .scheduler import KvScheduler, KVHitRateEvent, KV_HIT_RATE_SUBJECT, WorkerSelector
 
 logger = logging.getLogger(__name__)
+
+
+class HotChainTracker:
+    """Decayed hit counts over routed prefix NODES — the prefetch plane's
+    'hottest chains' source (docs/kv_tiering.md).
+
+    Weight accumulates PER PREFIX NODE (each leading block hash), not per
+    full chain: two multi-turn requests over one shared system prompt end
+    in different deepest hashes, but their common leading nodes each get
+    credited twice — so shared-prefix heat aggregates exactly where reuse
+    happens, and per-request tail blocks stay at weight 1 and decay away.
+    ``top()`` returns the hottest nodes' chains (deepest first on equal
+    weight, strict prefixes of an already-selected chain deduplicated),
+    which the KvPrefetchPublisher pushes to workers so they can warm those
+    prefixes disk→host AHEAD of the next arrival."""
+
+    def __init__(self, max_chains: int = 256, max_depth: int = 32):
+        self.max_chains = max_chains
+        self.max_depth = max_depth
+        # prefix-node hash → [weight, [leading hashes up to this node]]
+        self._chains: Dict[int, list] = {}
+
+    def record(self, seq_hashes) -> None:
+        hashes = list(seq_hashes[: self.max_depth])
+        decayed = False
+        for d, h in enumerate(hashes):
+            row = self._chains.get(h)
+            if row is not None:
+                row[0] += 1.0
+                continue
+            if len(self._chains) >= self.max_chains:
+                # Decay AT MOST ONCE per recorded chain: a single deep
+                # never-seen chain must not halve the table per node (32
+                # halvings would erase the entire heat history).
+                if decayed:
+                    continue
+                self._decay_and_prune()
+                decayed = True
+                if len(self._chains) >= self.max_chains:
+                    continue  # full of hotter nodes: drop this one
+            self._chains[h] = [1.0, hashes[: d + 1]]
+
+    def _decay_and_prune(self) -> None:
+        """Make room: drop cold one-hit entries first; only if the table
+        is STILL full does every weight halve, pruning what falls under
+        1.0 — so the halving pass always frees the warm-but-not-hot band
+        and steady per-request tail churn cannot erase genuinely hot
+        nodes, while yesterday's hot prompt still fades instead of
+        squatting forever."""
+        for k in [k for k, row in self._chains.items() if row[0] < 1.5]:
+            del self._chains[k]
+        if len(self._chains) >= self.max_chains:
+            for row in self._chains.values():
+                row[0] *= 0.5
+            for k in [k for k, row in self._chains.items() if row[0] < 1.0]:
+                del self._chains[k]
+
+    def top(self, n: int = 8):
+        """The ``n`` hottest distinct chains, hottest first.  On equal
+        weight the DEEPER node wins (its chain subsumes the shallower
+        ones, which are then deduplicated as strict prefixes); remaining
+        ties break on the node hash — fully deterministic."""
+        ranked = sorted(
+            self._chains.items(),
+            key=lambda kv: (-kv[1][0], -len(kv[1][1]), kv[0]),
+        )
+        out: list = []
+        for _, row in ranked:
+            chain = row[1]
+            if any(sel[: len(chain)] == chain for sel in out):
+                continue  # strict prefix of a hotter selected chain
+            out.append(chain)
+            if len(out) >= n:
+                break
+        return out
 
 
 class KvRouterCore:
@@ -60,14 +136,26 @@ class KvRouterCore:
         self._event_sub = None
         self._known_workers: set = set()
         self._bg: set = set()
+        # Prefetch plane input: decayed hit counts over routed prefix
+        # chains (KvPrefetchPublisher reads top()).
+        self.hot_chains = HotChainTracker()
+        self._prefetch_pub = None
 
     async def start(self) -> "KvRouterCore":
         self._event_sub = await self.component.subscribe(KV_EVENTS_TOPIC)
         self._event_task = asyncio.get_running_loop().create_task(self._event_loop())
         await self.aggregator.start()
+        # Prefetch plane (docs/kv_tiering.md): push the hottest routed
+        # chains so workers with a disk tier warm them ahead of arrivals.
+        from .pull import KvPrefetchPublisher
+
+        self._prefetch_pub = await KvPrefetchPublisher(self).start()
         return self
 
     async def stop(self) -> None:
+        if self._prefetch_pub is not None:
+            await self._prefetch_pub.stop()
+            self._prefetch_pub = None
         if self._event_task is not None:
             self._event_task.cancel()
             try:
@@ -116,15 +204,32 @@ class KvRouterCore:
         """(best worker, overlap_blocks); None if no instances.  ``salt``
         is the tenant KV salt (llm/tenancy) — overlap hashing must match
         the engine's salted sealing or scores diverge from cache state."""
+        winner, overlap = self.select_with_scores(token_ids, salt)
+        return winner, overlap.scores.get(winner, 0) if winner is not None else 0
+
+    def select_with_scores(
+        self, token_ids, salt: Optional[str] = None
+    ) -> Tuple[Optional[WorkerId], OverlapScores]:
+        """``select`` plus the full per-worker overlap — what the push
+        router needs to stamp cross-worker pull hints (a peer with a
+        strictly deeper RAW prefix than the winner's)."""
         live = set(self.client.instance_ids)
         if live != self._known_workers:
             self._prune_dead_workers(live)
         if not live:
-            return None, 0
-        overlap = self.indexer.find_matches(token_ids, salt)
+            return None, OverlapScores()
+        hashes = fast_sequence_hashes(token_ids, self.block_size, salt)
+        self.hot_chains.record(hashes)
+        overlap = self.indexer.find_matches_for_hashes(hashes)
+        # Dead workers may linger in the index until their Removed/watch
+        # events land; never hint (or route) toward one.
+        overlap = OverlapScores(
+            {w: n for w, n in overlap.scores.items() if w in live},
+            {w: d for w, d in overlap.discounted.items() if w in live},
+        )
         workers = self.aggregator.endpoints(sorted(live))
         winner = self.scheduler.schedule(len(token_ids), overlap, workers)
-        return winner, overlap.scores.get(winner, 0) if winner is not None else 0
+        return winner, overlap
 
 
 class KvRouter(AsyncEngine):
@@ -161,11 +266,29 @@ class KvPushRouter(AsyncEngine):
         # the engine seals their blocks under the same salt, so routing
         # overlap only means anything when hashed identically.
         annotations = request.data.get("annotations") or {}
-        worker_id, overlap = self.core.select(
+        worker_id, overlap = self.core.select_with_scores(
             token_ids, annotations.get("kv_salt")
         )
         if worker_id is None:
             return await self.core.client.generate(request)
+        # Cross-worker prefix pull hint (docs/kv_tiering.md): when a PEER
+        # holds a strictly deeper RAW prefix than the winner (the winner
+        # won on tier heat / load), tell the winner who to pull the sealed
+        # delta blocks from instead of recomputing prefill.  The engine
+        # still compares against its own tiers at admission — the hint is
+        # advisory and bounded by the pull budgets.
+        donor = overlap.deepest()
+        if (
+            donor is not None
+            and donor != worker_id
+            and overlap.scores.get(donor, 0) > overlap.scores.get(worker_id, 0)
+        ):
+            annotations = dict(annotations)
+            annotations["kv_pull"] = {
+                "worker_id": donor,
+                "blocks": overlap.scores[donor],
+            }
+            request.data["annotations"] = annotations
         return await self.core.client.generate(request, worker_id=worker_id)
 
 

@@ -71,9 +71,15 @@ class DefaultWorkerSelector:
         best: List[WorkerId] = []
         for snap in request.workers:
             m = snap.metrics
-            overlap_blocks = request.overlap.scores.get(snap.worker_id, 0)
+            # Tier-discounted overlap (indexer.OverlapScores): a block
+            # restorable only from host/disk contributes less than a live
+            # HBM block, so a deep-but-cold prefix loses to a
+            # shallow-but-hot one DETERMINISTICALLY (distinct tier weights
+            # break what used to be an exact tie).  Raw block counts are
+            # still what KVHitRateEvents report.
+            eff_blocks = request.overlap.discounted_for(snap.worker_id)
             score = (
-                overlap_blocks * request.block_size / request.isl_tokens
+                eff_blocks * request.block_size / request.isl_tokens
                 if request.isl_tokens
                 else 0.0
             )

@@ -611,6 +611,135 @@ class EngineDispatchMetrics:
 engine_dispatch_metrics = EngineDispatchMetrics()
 
 
+class KvTierMetrics:
+    """Tiered-KV-cache counters + gauges (docs/kv_tiering.md): per-tier
+    bytes/blocks, restore/demote/promote/pull activity, restore + pull
+    latency percentiles.  Module-level singleton rendered as Prometheus
+    text and appended to ``/metrics`` (same pattern as ``spec_metrics``).
+
+    Counters are updated inline by the engine/puller; the per-tier
+    bytes/blocks GAUGES come from a source callable
+    (``engine.kv_tier_summary`` — wired like EngineDispatchMetrics by
+    whoever colocates an engine with the HTTP edge), so remote-engine
+    edges render counters only."""
+
+    def __init__(self):
+        self._source = None
+        # restore path (host/disk → HBM ahead of admission)
+        self.restore_hits_total = 0      # requests that restored ≥1 block
+        self.restore_misses_total = 0    # tiered restore attempts, 0 blocks
+        self.restored_blocks_total = 0   # host→HBM scatters
+        self.promoted_blocks_total = 0   # disk→host promotions
+        self.prefetched_blocks_total = 0  # promotions driven by kv_prefetch
+        # cross-worker pull (llm/kv_router/pull.py)
+        self.pulls_started_total = 0
+        self.pulls_completed_total = 0
+        self.pulls_failed_total = 0      # any degraded-to-local outcome
+        self.pulled_blocks_total = 0
+        self.pulled_bytes_total = 0
+        self.restore_latency_ms = RollingWindow(maxlen=1024)
+        self.pull_latency_ms = RollingWindow(maxlen=512)
+
+    def set_source(self, source) -> None:
+        """``source() -> engine.kv_tier_summary()`` dict, or None."""
+        self._source = source
+
+    def reset(self) -> None:
+        self.__init__()
+
+    def tier_summary(self) -> Dict[str, object]:
+        """The engine's per-tier gauges ({} without a wired source) —
+        shared by render() and the edge SLO publication."""
+        if self._source is None:
+            return {}
+        try:
+            return self._source() or {}
+        except Exception:  # noqa: BLE001 — engine mid-teardown
+            return {}
+
+    def snapshot(self) -> Dict[str, float]:
+        out = {
+            k: float(v) for k, v in vars(self).items() if isinstance(v, (int, float))
+        }
+        out["restore_latency_ms_p50"] = self.restore_latency_ms.percentile(0.5)
+        out["restore_latency_ms_p99"] = self.restore_latency_ms.percentile(0.99)
+        out["pull_latency_ms_p50"] = self.pull_latency_ms.percentile(0.5)
+        out["pull_latency_ms_p99"] = self.pull_latency_ms.percentile(0.99)
+        return out
+
+    def render(self, prefix: str = "dynamo_tpu") -> str:
+        ns = f"{prefix}_kv_tier"
+        lines = []
+
+        def emit(name: str, kind: str, help_: str, value) -> None:
+            lines.append(f"# HELP {ns}_{name} {help_}")
+            lines.append(f"# TYPE {ns}_{name} {kind}")
+            lines.append(f"{ns}_{name} {value}")
+
+        summary = self.tier_summary()
+        tiers = [t for t in ("hbm", "host", "disk") if t in summary]
+        if tiers:
+            lines.append(f"# HELP {ns}_blocks Sealed KV blocks per tier")
+            lines.append(f"# TYPE {ns}_blocks gauge")
+            for t in tiers:  # bounded constant label set
+                lines.append(
+                    f'{ns}_blocks{{tier="{escape_label(t)}"}} '
+                    f'{summary[t]["blocks"]}'
+                )
+            lines.append(f"# HELP {ns}_bytes KV bytes per tier")
+            lines.append(f"# TYPE {ns}_bytes gauge")
+            for t in tiers:
+                lines.append(
+                    f'{ns}_bytes{{tier="{escape_label(t)}"}} '
+                    f'{summary[t]["bytes"]}'
+                )
+            emit("prefix_hit_rate", "gauge",
+                 "Engine prefix-cache hit rate (matched/looked-up blocks)",
+                 round(float(summary.get("prefix_hit_rate", 0.0)), 6))
+        emit("restore_hits_total", "counter",
+             "Requests that restored >=1 prefix block from a lower tier",
+             self.restore_hits_total)
+        emit("restore_misses_total", "counter",
+             "Tiered restore attempts that found nothing restorable",
+             self.restore_misses_total)
+        emit("restored_blocks_total", "counter",
+             "Blocks scattered host->HBM ahead of admission",
+             self.restored_blocks_total)
+        emit("promoted_blocks_total", "counter",
+             "Blocks promoted disk->host", self.promoted_blocks_total)
+        emit("prefetched_blocks_total", "counter",
+             "disk->host promotions driven by the kv_prefetch plane",
+             self.prefetched_blocks_total)
+        emit("pulls_started_total", "counter",
+             "Cross-worker prefix pulls attempted", self.pulls_started_total)
+        emit("pulls_completed_total", "counter",
+             "Cross-worker prefix pulls that landed blocks",
+             self.pulls_completed_total)
+        emit("pulls_failed_total", "counter",
+             "Pulls degraded to local prefill (timeout/refusal/error)",
+             self.pulls_failed_total)
+        emit("pulled_blocks_total", "counter",
+             "Blocks imported by cross-worker pulls", self.pulled_blocks_total)
+        emit("pulled_bytes_total", "counter",
+             "Bytes imported by cross-worker pulls", self.pulled_bytes_total)
+        emit("restore_latency_ms_p50", "gauge",
+             "Rolling p50 of tier-restore latency",
+             round(self.restore_latency_ms.percentile(0.5), 3))
+        emit("restore_latency_ms_p99", "gauge",
+             "Rolling p99 of tier-restore latency",
+             round(self.restore_latency_ms.percentile(0.99), 3))
+        emit("pull_latency_ms_p50", "gauge",
+             "Rolling p50 of cross-worker pull latency",
+             round(self.pull_latency_ms.percentile(0.5), 3))
+        emit("pull_latency_ms_p99", "gauge",
+             "Rolling p99 of cross-worker pull latency",
+             round(self.pull_latency_ms.percentile(0.99), 3))
+        return "\n".join(lines) + "\n"
+
+
+kv_tier_metrics = KvTierMetrics()
+
+
 class InflightGuard:
     """Tracks one request: inflight gauge, duration, TTFT, ITL, final status.
 

@@ -160,6 +160,11 @@ class SignalSnapshot:
     # means latency/queue signals are already brownout-suppressed — a
     # scale-down policy must not read that suppression as idle capacity.
     edge_brownout_rung: int = 0
+    # Mean engine prefix-cache hit rate across live edges' kv_tier
+    # publications (docs/kv_tiering.md), or None when no edge publishes
+    # tier gauges.  A sagging fleet hit rate with tiered capacity free is
+    # the planner's cue to warm prefixes (kv_prefetch) before scaling.
+    fleet_prefix_hit_rate: Optional[float] = None
 
     def pool(self, name: str) -> PoolStats:
         return self.pools.get(name) or PoolStats()
@@ -446,6 +451,16 @@ class SignalCollector:
         ]
         return max(vals) if vals else None
 
+    def _edge_mean(self, key: str) -> Optional[float]:
+        """Mean of a fresh edge-published scalar (rates, not latencies —
+        the representative read, unlike the worst-case percentile merge)."""
+        vals = [
+            e[key]
+            for e in self._edges.values()
+            if isinstance(e.get(key), (int, float))
+        ]
+        return sum(vals) / len(vals) if vals else None
+
     def worker_slo_view(self) -> Dict[int, Dict[str, Any]]:
         """Merged per-worker TTFT/ITL view from the live edges' slo_metrics
         publications (``workers`` key) — a planner-side HealthWatchdog's
@@ -502,6 +517,7 @@ class SignalCollector:
             edge_brownout_rung=int(
                 self._edge_percentile("brownout_rung") or 0
             ),
+            fleet_prefix_hit_rate=self._edge_mean("prefix_hit_rate"),
         )
 
 
@@ -539,6 +555,19 @@ class EdgeSloPublisher:
         snap["edge_id"] = self.edge_id
         if self.qos is not None and self.qos.ladder is not None:
             snap["brownout_rung"] = self.qos.rung
+        # Tiered-KV view (docs/kv_tiering.md): when an engine is colocated
+        # (kv_tier_metrics source wired), the fleet's prefix-hit rate rides
+        # the SLO publication so the planner can distinguish "TTFT is high
+        # because prefixes run cold" from "TTFT is high because we're out
+        # of compute".
+        from ..llm.metrics import kv_tier_metrics
+
+        tier = kv_tier_metrics.tier_summary()
+        if tier:
+            snap["prefix_hit_rate"] = float(tier.get("prefix_hit_rate", 0.0))
+            snap["kv_tier"] = {
+                t: dict(tier[t]) for t in ("hbm", "host", "disk") if t in tier
+            }
         # Per-worker TTFT/ITL p50s observed by this edge's routed clients
         # (runtime/health.py): the planner-side watchdog's straggler feed.
         workers = worker_latency.snapshot()

@@ -45,6 +45,31 @@ echo "== qos suite (WFQ fairness + priority + brownout determinism) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_qos.py -q -m chaos \
   -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== kv-tiering suite (disk tier, tier events, discounted scoring,"
+echo "   cross-worker pull exactness) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_kv_tiering.py -q -m tiering \
+  -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "== prefix-reuse smoke (BENCH_PREFIX=1: tiers off/host/disk/pull;"
+echo "   bars: >=90% prefill skipped on 2nd occurrence, pull serves a"
+echo "   never-computed prefix, byte-identical streams, stable compiles) =="
+env JAX_PLATFORMS=cpu BENCH_PREFIX=1 python bench.py > /tmp/_prefix_smoke.json
+python - <<'PYEOF'
+import json
+r = json.loads(open("/tmp/_prefix_smoke.json").read().strip().splitlines()[-1])
+assert r["metric"] == "prefix_reuse_skip_frac", r
+assert r["identical"] is True, "tiered streams diverged from control"
+assert r["compile_stable"] is True, "tier paths compiled after warmup"
+assert r["modes"]["host"]["skip_frac"] >= 0.9, r["modes"]["host"]
+assert r["modes"]["disk"]["skip_frac"] >= 0.9, r["modes"]["disk"]
+assert r["pull_served_blocks"] >= 1, "cross-worker pull never served blocks"
+assert r["modes"]["off"]["skip_frac"] < 0.5, (
+    "control mode reused prefixes — the smoke lost its eviction pressure")
+print(f"prefix smoke ok: skip host={r['modes']['host']['skip_frac']} "
+      f"disk={r['modes']['disk']['skip_frac']} "
+      f"pull_blocks={r['pull_served_blocks']}")
+PYEOF
+
 echo "== continuous-decode churn smoke (CPU bench: staggered finishes +"
 echo "   late arrivals; bars: fewer rebuilds than forced-rebuild control,"
 echo "   exact streams, zero new compiles, dispatch metrics parseable) =="
