@@ -335,6 +335,9 @@ def _churn_bench(cfg, model_cfg) -> None:
                 "tok_s": sum(len(s) for s in streams) / dt,
                 "compiles_stable": engine.compile_counts() == compiles0,
                 "summary": engine.dispatch_summary(),
+                # Which decode kernel actually served the run — the CI
+                # smoke asserts the fused path under DYN_DECODE_KERNEL.
+                "decode_kernel": engine.decode_kernel,
             }
         finally:
             await engine.close()
@@ -348,7 +351,16 @@ def _churn_bench(cfg, model_cfg) -> None:
             "continuous batching changed the token streams — the "
             "exact-stream equivalence invariant is broken"
         )
-    print("bench[churn]: token streams identical on/off", file=sys.stderr)
+    if on["decode_kernel"] != off["decode_kernel"]:
+        raise RuntimeError(
+            "churn modes resolved different decode kernels: "
+            f"{on['decode_kernel']} vs {off['decode_kernel']}"
+        )
+    print(
+        "bench[churn]: token streams identical on/off "
+        f"(decode_kernel={on['decode_kernel']})",
+        file=sys.stderr,
+    )
     pipe_on, pipe_off = on["summary"]["pipeline"], off["summary"]["pipeline"]
     for mode, r, pipe in (("on", on, pipe_on), ("off", off, pipe_off)):
         print(
@@ -363,6 +375,7 @@ def _churn_bench(cfg, model_cfg) -> None:
         json.dumps(
             {
                 "metric": "continuous_decode_rebuilds",
+                "decode_kernel": on["decode_kernel"],
                 "value": pipe_on["rebuilds"],
                 "unit": "rebuilds",
                 "vs_baseline": round(
@@ -756,11 +769,38 @@ def main() -> None:
             f"bench: ~{n_params/1e9:.2f}B params, decode MFU {mfu*100:.2f}%{note}",
             file=sys.stderr,
         )
+        # Attention-time share (analytic HBM-byte attribution): decode is
+        # bandwidth-bound, so the expected step-time split is the byte
+        # split — weights streamed once per fused step vs KV context
+        # gathered per row at the mean decode context.  Lets BENCH_r06
+        # attribute MFU movement to the attention kernel (fused dequant
+        # reads quantized KV at 1 byte/value) vs the matmul path instead
+        # of hand-waving from the headline number.
+        import numpy as _np
+        rows = min(wl["requests"], cfg.max_batch)
+        mean_ctx = wl["isl"] + wl["osl"] / 2.0
+        kv_itemsize = _np.dtype(cfg.cache_dtype).itemsize
+        w_itemsize = 1 if cfg.weight_quant else 2
+        kv_bytes = rows * mean_ctx * 2 * c.kv_size * c.num_layers * kv_itemsize
+        w_bytes = n_params * w_itemsize
+        attn_share = kv_bytes / (kv_bytes + w_bytes)
+        print(
+            f"bench: attention share (byte model) {attn_share*100:.1f}% "
+            f"(kv {kv_bytes/1e6:.0f}MB vs weights {w_bytes/1e6:.0f}MB per "
+            f"step, kernel={dispatch.get('decode_kernel')})",
+            file=sys.stderr,
+        )
         # Machine-readable trajectory (ISSUE 11): until now only tok/s was
         # parseable and the ROADMAP quoted MFU/host-gap by hand from stderr.
         extras.update(
             {
                 "decode_mfu": round(mfu, 4),
+                "decode_kernel": dispatch.get("decode_kernel"),
+                "attention": {
+                    "share_est": round(attn_share, 4),
+                    "kv_bytes_per_step": int(kv_bytes),
+                    "weight_bytes_per_step": int(w_bytes),
+                },
                 "host_gap_frac": round(max(0.0, dt - device_s) / dt, 4),
                 "dispatch": {
                     k: {

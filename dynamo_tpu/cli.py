@@ -971,6 +971,19 @@ def main(argv: Optional[list] = None) -> None:
         dest="attn_impl",
         help="decode attention backend",
     )
+    from .engine.config import DECODE_KERNELS
+
+    p_run.add_argument(
+        "--decode-kernel",
+        default="auto",
+        choices=["auto", *DECODE_KERNELS],
+        dest="decode_kernel",
+        help="decode-path attention kernel (ops/decode_attention.py): "
+        "pallas_fused = our fused-dequant split-KV kernel, stock = the "
+        "jax pallas ragged kernel with tuned hints, xla = the "
+        "bit-exactness oracle.  auto resolves DYN_DECODE_KERNEL, then "
+        "pallas_fused on TPU / stock elsewhere",
+    )
     p_run.add_argument(
         "--spec-decode",
         action="store_true",

@@ -79,6 +79,7 @@ def build_tpu_engine(args):
         kv_scale=getattr(args, "kv_scale", 1.0),
         checkpoint_path=getattr(args, "checkpoint", None),
         attn_impl=getattr(args, "attn_impl", "auto"),
+        decode_kernel=getattr(args, "decode_kernel", "auto"),
         host_cache_bytes=(getattr(args, "host_cache_mb", 0) or 0) << 20,
         disk_cache_bytes=(getattr(args, "disk_cache_mb", 0) or 0) << 20,
         disk_cache_dir=getattr(args, "disk_cache_dir", None),

@@ -14,6 +14,14 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 
+# Canonical decode-kernel names (ops/ragged_attention.resolve_decode_kernel
+# and the CLI --decode-kernel choices both derive from this — ONE list, so
+# a new kernel cannot be reachable from the env but not the config/CLI).
+# Lives here because config.py is the dependency-free bottom of the import
+# graph; ops/ and cli import it lazily.
+DECODE_KERNELS = ("pallas_fused", "stock", "xla")
+
+
 def _pow2_buckets(lo: int, hi: int) -> List[int]:
     out, v = [], lo
     while v < hi:
@@ -237,6 +245,21 @@ class EngineConfig:
     # Attention backend: auto (ragged pallas kernel on TPU, xla gather
     # fallback elsewhere) | tpu | xla.
     attn_impl: str = "auto"
+    # Decode-path attention kernel (ops/ragged_attention.py
+    # resolve_decode_kernel; env override DYN_DECODE_KERNEL):
+    #   auto         — pallas_fused on TPU, stock elsewhere
+    #   pallas_fused — our fused-dequant split-KV Pallas decode kernel
+    #                  (ops/decode_attention.py; interpret-mode on CPU)
+    #   stock        — the jax pallas ragged kernel with tuned decode
+    #                  hints on TPU, XLA fallback elsewhere (pre-kernel
+    #                  behaviour)
+    #   xla          — force the XLA fallback (bit-exactness oracle)
+    decode_kernel: str = "auto"
+    # Decode-stall watchdog threshold in seconds (engine/pipeline.py
+    # _await_device): a token fetch / device dispatch exceeding it logs the
+    # dispatch trace loudly and bumps dynamo_tpu_engine_stall_total.
+    # None resolves the DYN_DECODE_STALL_S env var; 0 disables (default).
+    decode_stall_s: Optional[float] = None
     # Decode iterations fused into one device dispatch (lax.scan feeding
     # sampled tokens forward in HBM).  >1 amortises host→device dispatch
     # latency at the cost of token-delivery granularity; essential when the
@@ -317,6 +340,11 @@ class EngineConfig:
             raise ValueError(
                 "disk_cache_bytes requires host_cache_bytes > 0 (the disk "
                 "tier is fed by host-tier demotion)"
+            )
+        if self.decode_kernel not in ("auto",) + DECODE_KERNELS:
+            raise ValueError(
+                f"unknown decode_kernel {self.decode_kernel!r} "
+                f"(auto|{'|'.join(DECODE_KERNELS)})"
             )
         if self.weight_quant not in (None, "int8"):
             # One check covering every load path (checkpoint / random-init /

@@ -212,6 +212,23 @@ class TpuEngine(
         # the BOUNDED step_trace, whose eviction after 65k entries would
         # drift the ratio toward 1.0 on a long-lived server.
         self.decode_busy_s = 0.0
+        # Decode-stall watchdog (r5 diagnosed a ~3-minute decode_wait hang
+        # with NO engine-side detector): a token fetch / device dispatch
+        # that exceeds the threshold trips a loud log with the recent
+        # dispatch trace, bumps this counter (dynamo_tpu_engine_stall_total
+        # on /metrics) and surfaces in dispatch_summary() so the health
+        # watchdog's straggler path can see a wedged device even while the
+        # worker still answers probes.  Config decode_stall_s; None
+        # resolves DYN_DECODE_STALL_S; 0 = off (default).
+        import os as _os
+
+        self._stall_threshold_s = float(
+            cfg.decode_stall_s
+            if cfg.decode_stall_s is not None
+            else _os.environ.get("DYN_DECODE_STALL_S", "0") or 0
+        )
+        self.decode_stalls = 0  # fetches that exceeded the threshold
+        self.last_stall: Optional[Dict[str, Any]] = None
         # Multi-tenancy (llm/tenancy): LoRA adapter registry (None = LoRA
         # disabled), optional served-model allowlist (unknown names →
         # ModelNotFoundError → 404 at the edge), and the deserialized
@@ -323,6 +340,20 @@ class TpuEngine(
 
             attn_impl = "tpu" if on_tpu() else "xla"
         self.attn_impl = attn_impl
+        # Decode-path kernel selector (config > DYN_DECODE_KERNEL env >
+        # auto) + the tuned block-hint table for this engine's geometry
+        # (tools/tune_decode.py; built-in defaults when no entry matches).
+        from ..ops.decode_attention import install_tuned_hints
+        from ..ops.ragged_attention import resolve_decode_kernel
+
+        decode_kernel = resolve_decode_kernel(
+            cfg.decode_kernel, attn_impl=attn_impl
+        )
+        self.decode_kernel = decode_kernel
+        install_tuned_hints(cfg.model, cfg.max_batch, cfg.block_size)
+        logger.info(
+            "decode kernel: %s (attn_impl=%s)", decode_kernel, attn_impl
+        )
         S = cfg.max_batch
         mesh = self.mesh
         # Quantized (1-byte) KV pages: a static scale, or per-layer scales
@@ -400,7 +431,7 @@ class TpuEngine(
                 logits, cache = forward_ragged(
                     params, model_config, rb, cache, attn_impl=attn_impl,
                     mesh=mesh, kv_scale=kv_scale, decode=True,
-                    lora_rank=lora_rank,
+                    decode_kernel=decode_kernel, lora_rank=lora_rank,
                 )
                 out = sample_tokens(
                     logits,
@@ -1506,6 +1537,8 @@ class TpuEngine(
         self.continuous_retired = 0
         self.pipeline_wall_s = 0.0
         self.decode_busy_s = 0.0
+        self.decode_stalls = 0
+        self.last_stall = None
 
     def dispatch_summary(self) -> Dict[str, Any]:
         """Machine-readable decode-pipeline health: the per-kind dispatch
@@ -1527,6 +1560,7 @@ class TpuEngine(
         )
         return {
             "kinds": self.step_summary(),
+            "decode_kernel": self.decode_kernel,
             "pipeline": {
                 "sessions": self.pipeline_sessions,
                 "rebuilds": self.pipeline_rebuilds,
@@ -1534,6 +1568,11 @@ class TpuEngine(
                 "continuous_retired": self.continuous_retired,
                 "wall_s": round(wall, 4),
                 "host_gap_frac": round(gap, 4),
+                # Stall-watchdog surface (DYN_DECODE_STALL_S): the health
+                # watchdog's straggler path reads this off the same
+                # summary the planner already consumes.
+                "stalls": self.decode_stalls,
+                "last_stall": self.last_stall,
             },
         }
 

@@ -279,7 +279,9 @@ class DecodePipelineMixin:
                     "unified",
                     (rb, jax.tree_util.tree_map(np.asarray, samp)),
                 )
-            out = await asyncio.to_thread(run)
+            out = await self._await_device(
+                self._device_task(run), "unified_dispatch", len(plan.items)
+            )
         self.step_trace.append(
             (
                 "unified_fetch" if need_tokens else "unified",
@@ -307,6 +309,56 @@ class DecodePipelineMixin:
                 pending_rows.append((seq, i))
         if pending_rows:
             self._stash_fetch("first", out, need_lp, pending_rows)
+
+    async def _await_device(self, task, kind: str, rows: int):
+        """Await a device-op task (token fetch OR dispatch) under the
+        decode-stall watchdog.
+
+        r5 diagnosed a ~3-minute ``decode_wait`` hang (a wedged device
+        fetch) that no engine-side detector caught — the worker kept
+        answering health probes while every stream it owned sat frozen.
+        With the threshold set (EngineConfig.decode_stall_s /
+        ``DYN_DECODE_STALL_S``; default off), a device op that exceeds it
+        LOUDLY logs the recent dispatch trace, bumps ``decode_stalls``
+        (``dynamo_tpu_engine_stall_total`` on /metrics) and records
+        ``last_stall`` for ``dispatch_summary()`` — then KEEPS WAITING:
+        the watchdog attributes the hang, it does not guess at recovery
+        (killing an op whose DMA later lands would corrupt the
+        dispatch-order invariants).  Dispatch awaits are covered too: a
+        wedge can just as well surface one await earlier, blocking the
+        ``to_thread(run)`` handoff with no fetch outstanding."""
+        thr = self._stall_threshold_s
+        if thr <= 0:
+            return await task
+        waited = 0.0
+        while True:
+            done, _ = await asyncio.wait({task}, timeout=thr)
+            if done:
+                return task.result()
+            first = waited == 0.0
+            waited += thr
+            if first:
+                self.decode_stalls += 1
+            trace = [
+                [k, round(t, 4), r, n]
+                for k, t, r, n in list(self.step_trace)[-8:]
+            ]
+            self.last_stall = {
+                "kind": kind,
+                "rows": rows,
+                "waited_s": round(waited, 3),
+                "trace": trace,
+            }
+            logger.error(
+                "decode stall: %s (%d rows) exceeded %.1fs (waited %.1fs, "
+                "threshold decode_stall_s/DYN_DECODE_STALL_S); recent "
+                "dispatch trace: %s",
+                kind, rows, thr, waited, trace,
+            )
+
+    def _device_task(self, fn):
+        """Wrap a device-op thread in a Task so _await_device can watch it."""
+        return asyncio.get_running_loop().create_task(asyncio.to_thread(fn))
 
     @staticmethod
     def _fetch_outs(out, need_lp: bool):
@@ -341,7 +393,9 @@ class DecodePipelineMixin:
             kind, task = entry[0], entry[1]
 
             t0 = time.perf_counter()
-            sampled, logp, top_ids, top_lp = await task
+            sampled, logp, top_ids, top_lp = await self._await_device(
+                task, f"{kind}_fetch", len(entry[2])
+            )
             self.step_trace.append(
                 (
                     f"{kind}_harvest",
@@ -745,7 +799,9 @@ class DecodePipelineMixin:
                 # _run_unified) — publish under the device lock.
                 if self._publisher is not None:
                     await self._publisher.publish("multi", pub_payload)
-                outs, new_carry = await asyncio.to_thread(run)
+                outs, new_carry = await self._await_device(
+                    self._device_task(run), "decode_dispatch", n_active
+                )
             carry = new_carry
             wall = time.perf_counter() - t0
             self.decode_busy_s += wall  # unbounded host-gap accounting
@@ -822,7 +878,9 @@ class DecodePipelineMixin:
                 progressed = True
 
             if fetch_task is not None:
-                sampled, logp, top_ids, top_lp = await fetch_task
+                sampled, logp, top_ids, top_lp = await self._await_device(
+                    fetch_task, "decode_wait", slots.num_active
+                )
                 wait_wall = time.perf_counter() - wait_t0
                 self.decode_busy_s += wait_wall
                 self.step_trace.append(
@@ -964,7 +1022,9 @@ class DecodePipelineMixin:
                     "multi",
                     (tok0, pos0, tables.copy(), limits, samp_np),
                 )
-            outs, carry = await asyncio.to_thread(run)
+            outs, carry = await self._await_device(
+                self._device_task(run), "burst_dispatch", n
+            )
         self.step_trace.append(
             ("decode_burst", time.perf_counter() - t0, n, n * T)
         )
@@ -996,7 +1056,9 @@ class DecodePipelineMixin:
                     "multi",
                     (None, pos0b, tables.copy(), limits, samp_np),
                 )
-            outs_b = await asyncio.to_thread(run_b)
+            outs_b = await self._await_device(
+                self._device_task(run_b), "burst_dispatch", n
+            )
         self.step_trace.append(
             ("decode_burst", time.perf_counter() - t0, n, n * T)
         )

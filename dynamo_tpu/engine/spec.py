@@ -465,7 +465,12 @@ class SpecDecodeMixin:
                     "unified",
                     (rb, jax.tree_util.tree_map(np.asarray, samp)),
                 )
-            out = await asyncio.to_thread(run)
+            # Same decode-stall watchdog as every other device-op await
+            # (engine/pipeline.py _await_device): a wedge inside a spec
+            # verify step is the identical hang class.
+            out = await self._await_device(
+                self._device_task(run), "spec_dispatch", len(plan.items)
+            )
         self.step_trace.append(
             ("spec_verify", time.perf_counter() - t0, len(plan.items), at)
         )

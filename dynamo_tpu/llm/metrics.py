@@ -605,6 +605,22 @@ class EngineDispatchMetrics:
         emit("host_gap_frac", "gauge",
              "Fraction of fused-session wall not covered by decode "
              "dispatch/wait device work", pipe.get("host_gap_frac", 0.0))
+        # Decode-stall watchdog (decode_stall_s / DYN_DECODE_STALL_S;
+        # engine/pipeline.py _await_device).  OUTSIDE the _dispatch ns —
+        # the alert rule keys on this exact name.
+        lines.append(f"# HELP {prefix}_engine_stall_total Token fetches "
+                     "that exceeded the decode-stall threshold")
+        lines.append(f"# TYPE {prefix}_engine_stall_total counter")
+        lines.append(f"{prefix}_engine_stall_total {pipe.get('stalls', 0)}")
+        # Which decode kernel serves this engine (info-style gauge).
+        kern = s.get("decode_kernel", "")
+        if kern:
+            lines.append(f"# HELP {ns}_decode_kernel_info Active decode "
+                         "attention kernel (DYN_DECODE_KERNEL)")
+            lines.append(f"# TYPE {ns}_decode_kernel_info gauge")
+            lines.append(
+                f'{ns}_decode_kernel_info{{kernel="{escape_label(kern)}"}} 1'
+            )
         return "\n".join(lines) + "\n"
 
 

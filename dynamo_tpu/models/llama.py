@@ -223,6 +223,12 @@ def forward_ragged(
     # pallas kernel's native k_scale/v_scale only accepts static floats).
     kv_scale=None,
     decode: bool = False,  # static: every row is a single-token decode row
+    # Decode-path attention kernel (ops/ragged_attention.py
+    # resolve_decode_kernel): "pallas_fused" routes the fused-dequant
+    # split-KV kernel, which takes the (possibly traced per-layer)
+    # kv_scale IN-KERNEL — the algebraic q/out fold below is skipped for
+    # it, so the quantized KV stream is dequantized exactly once, in VMEM.
+    decode_kernel: str = "stock",
     # Static per-slot rank of the LoRA device bank (llm/tenancy/lora.py);
     # 0 = no LoRA.  Active only when BOTH the params tree carries bank
     # leaves and the batch carries adapter_slots.
@@ -254,12 +260,17 @@ def forward_ragged(
         else jnp.asarray(kv_scale, jnp.float32).reshape(-1)  # [1] or [L]
     )
 
+    # The fused decode kernel dequantizes in-kernel (the scale is an SMEM
+    # scalar operand, traced per-layer values included) — the algebraic
+    # fold would double-apply it.
+    fused_dequant = decode and decode_kernel == "pallas_fused"
+
     def attn_and_write(q, k, v, s_l, pages, slots, kv_lens, tables, cu, num):
         # s_l: this layer's scale ([] f32) or None.  q·(K·s) == (q·s)·K and
         # softmax(p)·(V·s) == (softmax(p)·V)·s, so scaling q in and the
         # output back out dequantizes exactly without kernel support.
         pages = write_kv_ragged(pages, k, v, slots, kv_scale=s_l)
-        if s_l is not None:
+        if s_l is not None and not fused_dequant:
             q = (q.astype(jnp.float32) * s_l).astype(q.dtype)
         out = ragged_attention(
             q,
@@ -271,8 +282,10 @@ def forward_ragged(
             sm_scale=scale,
             impl=attn_impl,
             decode=decode,
+            decode_kernel=decode_kernel,
+            kv_scale=s_l if fused_dequant else None,
         )
-        if s_l is not None:
+        if s_l is not None and not fused_dequant:
             out = (out.astype(jnp.float32) * s_l).astype(out.dtype)
         return out, pages
 

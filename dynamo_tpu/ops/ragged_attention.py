@@ -42,6 +42,53 @@ def on_tpu() -> bool:
     return dev is None or getattr(dev, "platform", None) == "tpu"
 
 
+def resolve_decode_kernel(value: str = "auto", attn_impl: str = "auto") -> str:
+    """Resolve the decode attention kernel selector.
+
+    Order: explicit config value > ``DYN_DECODE_KERNEL`` env > auto.
+    - ``pallas_fused``: our fused-dequant split-KV kernel
+      (ops/decode_attention.py) — compiled on TPU, interpret-mode on CPU
+      (the tier-1 parity gates run exactly the device kernel logic).
+    - ``stock``: the pre-existing path — the jax pallas
+      ragged_paged_attention kernel on TPU, XLA gather fallback elsewhere.
+    - ``xla``: force the XLA fallback everywhere (the bit-exactness
+      oracle, even on TPU).
+    ``auto`` picks pallas_fused on TPU and stock elsewhere, so default
+    CPU behaviour (and every pre-existing test stream) is unchanged.
+
+    ``attn_impl`` is the engine's attention backend: an operator forcing
+    ``attn_impl="xla"`` (the oracle-numerics debugging contract) must not
+    have ``auto`` route decode through the compiled fused kernel — auto
+    resolves to ``stock`` there, which honours impl=xla end-to-end.  An
+    EXPLICIT pallas_fused (config or env) still wins.
+    """
+    import os
+
+    # Lazy: config.py is the canonical (dependency-free) home of the
+    # kernel list — EngineConfig validation and the CLI choices share it.
+    from ..engine.config import DECODE_KERNELS
+
+    # ''/whitespace count as unset at both layers: a deployment template
+    # rendering DYN_DECODE_KERNEL= (empty) must not fail worker boot.
+    v = ((value or "auto").strip() or "auto").lower()
+    if v == "auto":
+        v = (
+            os.environ.get("DYN_DECODE_KERNEL", "auto").strip() or "auto"
+        ).lower()
+    if v == "auto":
+        v = "stock" if attn_impl == "xla" else (
+            "pallas_fused" if on_tpu() else "stock"
+        )
+    if v not in DECODE_KERNELS:
+        # Report the RESOLVED value: with config "auto" the offender is
+        # usually a typo'd DYN_DECODE_KERNEL env var, not the config.
+        raise ValueError(
+            f"unknown decode kernel {v!r} (from config {value!r} / "
+            f"DYN_DECODE_KERNEL; expected auto|{'|'.join(DECODE_KERNELS)})"
+        )
+    return v
+
+
 def quantize_for_cache(x: jnp.ndarray, dtype) -> jnp.ndarray:
     """Make already-scaled values representable in a quantized page dtype.
 
@@ -92,14 +139,18 @@ def _decode_block_hints(pages: jnp.ndarray, page_indices: jnp.ndarray):
     limit, and decode steps measured 2x faster with explicit 16-query blocks
     + a ~4MB-budget KV block (18-layer chain at batch 256: 14.2 -> 7.9ms on
     v5e).  Tunable for hardware sweeps: DYN_DECODE_NQ query block,
-    DYN_DECODE_NKV_MB KV block budget."""
-    import os
+    DYN_DECODE_NKV_MB KV block budget — each resolved env var > tuned-table
+    entry installed at engine init (tools/tune_decode.py) > the defaults
+    above, through the ONE precedence implementation (resolve_hint)."""
+    from .decode_attention import pages_per_vmem_budget, resolve_hint
 
     ps, KV2, hd = pages.shape[1], pages.shape[2], pages.shape[3]
-    budget = int(os.environ.get("DYN_DECODE_NKV_MB", "4")) << 20
-    nkv = max(1, budget // max(1, 2 * ps * KV2 * hd * 2))
+    budget = resolve_hint("DYN_DECODE_NKV_MB", "nkv_mb", 4) << 20
+    # itemsize 2: the stock kernel's VMEM working set is in the cast-up
+    # bf16 compute dtype regardless of the page dtype (see the helper).
+    nkv = pages_per_vmem_budget(budget, ps, KV2, hd, 2)
     nkv = min(page_indices.shape[1], nkv)
-    nq = int(os.environ.get("DYN_DECODE_NQ", "16"))
+    nq = resolve_hint("DYN_DECODE_NQ", "nq", 16)
     return nq, nkv
 
 
@@ -113,6 +164,7 @@ def ragged_decode_attention(
     sm_scale: float,
     impl: str = "xla",  # "tpu" | "xla"
     kv_scale: float | None = None,
+    kernel: str = "stock",  # "pallas_fused" | "stock" | "xla"
 ) -> jnp.ndarray:
     """Decode-specialized attention: every row is exactly ONE query token
     (the fused multi-step decode program's shape — engine/pipeline.py).
@@ -124,14 +176,55 @@ def ragged_decode_attention(
     ``kv_lens[i] - 1`` by construction, so the row map is the identity and
     the causal mask is just ``ctx < kv_len``.
 
-    - TPU: the same pallas kernel, always with the decode-tuned block/grid
-      hints (``_decode_block_hints``).
-    - XLA fallback (CPU tier-1): a direct [S, W] row gather — no
-      searchsorted, no cu_q_lens — numerically identical to the unified
-      fallback on decode shapes (same einsums, same operand order), so the
-      fused-vs-unified exact-stream gates keep holding bit-for-bit.
+    ``kernel`` selects the implementation (resolve_decode_kernel /
+    DYN_DECODE_KERNEL):
+    - "pallas_fused": our fused-dequant split-KV decode kernel
+      (ops/decode_attention.py) — ``kv_scale`` (static OR traced) is
+      applied IN-KERNEL, so quantized pages stream from HBM once at
+      1 byte/value.  Interpret-mode on CPU, compiled on TPU.
+    - "stock": the pre-existing routing — the jax pallas kernel with the
+      decode-tuned block hints on ``impl == "tpu"``, XLA fallback
+      otherwise.
+    - "xla": force the XLA fallback (the bit-exactness oracle) — a direct
+      [S, W] row gather, no searchsorted, no cu_q_lens — numerically
+      identical to the unified fallback on decode shapes.
     """
     S, H, D = q.shape
+    if kernel == "pallas_fused":
+        from .decode_attention import fused_decode_attention
+
+        try:
+            return fused_decode_attention(
+                q,
+                pages,
+                kv_lens,
+                page_indices,
+                num_seqs,
+                sm_scale=sm_scale,
+                kv_scale=kv_scale,
+            )
+        except Exception as e:  # trace-time rejection (see ragged_attention)
+            # Only COMPILED toy shapes (sub-lane-width heads on a real
+            # TPU) may fall back.  Interpret mode has no legitimate
+            # rejection path, and a silent fallback there would leave
+            # every decode_kernel reporting surface (bench JSON, CI churn
+            # assertion, /metrics info gauge) claiming pallas_fused while
+            # stock served — the attribution error BENCH_r06 exists to
+            # avoid.  Real serving geometries stay loud everywhere.
+            if pages.shape[3] >= 128 or not on_tpu():
+                raise
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "fused decode kernel rejected toy shapes q=%s pages=%s "
+                "(%s); using the stock path",
+                q.shape, pages.shape, e,
+            )
+            kernel = "stock"
+    if kernel == "xla":
+        impl = "xla"
+    elif kernel != "stock":
+        raise ValueError(f"unknown decode kernel {kernel!r}")
     if impl == "tpu":
         from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
             ragged_paged_attention,
@@ -187,7 +280,12 @@ def ragged_decode_attention(
     kv = pages.reshape(-1, 2 * KV, D)[slots]  # [S, W, 2KV, D]
     k = kv[:, :, 0::2].astype(jnp.float32)  # [S, W, KV, D]
     v = kv[:, :, 1::2].astype(jnp.float32)
-    if kv_scale is not None and kv_scale != 1.0:
+    # The != 1.0 fast path only for PYTHON floats: a traced per-layer
+    # scale (the fused kernel's native contract, reachable here through
+    # its toy-shape fallback) cannot be compared at trace time.
+    if kv_scale is not None and (
+        not isinstance(kv_scale, (int, float)) or kv_scale != 1.0
+    ):
         k = k * kv_scale
         v = v * kv_scale
 
@@ -216,6 +314,7 @@ def ragged_attention(
     impl: str = "xla",  # "tpu" | "xla"
     kv_scale: float | None = None,  # quantized cache: value = stored * scale
     decode: bool = False,  # static hint: every row is a 1-token decode row
+    decode_kernel: str = "stock",  # decode-path kernel (resolve_decode_kernel)
 ) -> jnp.ndarray:
     """Causal attention of each token against its sequence's paged context.
 
@@ -243,6 +342,7 @@ def ragged_attention(
             sm_scale=sm_scale,
             impl=impl,
             kv_scale=kv_scale,
+            kernel=decode_kernel,
         )
     if impl == "tpu":
         from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (

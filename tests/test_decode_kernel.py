@@ -1,0 +1,429 @@
+"""Fused-dequant Pallas decode kernel gates (ISSUE 13).
+
+Four layers of defense, all CPU-runnable:
+
+1. **Interpret-mode parity vs the XLA oracle** — the pallas kernel body
+   (split-KV grid, double-buffered page DMA, in-kernel dequant) runs
+   under the Pallas interpreter against ``ragged_decode_attention``'s XLA
+   fallback on ragged page tables: varying chain lengths, int8 and fp32
+   KV, static and traced scales, empty rows, every split/block combo.
+2. **Exact-stream equivalence across DYN_DECODE_KERNEL modes** — the
+   engine must emit byte-identical token streams under
+   pallas_fused/stock/xla at temperature 0 AND seeded temperature 0.9,
+   spec decode on or off, with ZERO new compiles after warmup.
+3. **Decode-stall watchdog** — an injected fetch hang trips the counter +
+   loud log; a clean run stays silent.
+4. **Autotuner table** — install/fallback resolution order (env > tuned >
+   default) and the merge-on-write behaviour of tools/tune_decode.py.
+"""
+
+import asyncio
+import json
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.ops.decode_attention import (
+    active_hints,
+    clear_tuned_hints,
+    fused_decode_attention,
+    hint_key,
+    install_tuned_hints,
+    resolve_hint,
+)
+from dynamo_tpu.ops.ragged_attention import (
+    ragged_decode_attention,
+    resolve_decode_kernel,
+)
+
+pytestmark = pytest.mark.decode_kernel
+
+
+# --------------------------------------------------------------- parity
+
+
+def _case(seed, S, PP, ps, KV, G, D, kv_lens_list, nvalid,
+          dtype=jnp.float32, kv_scale=None):
+    """Ragged decode batch: shuffled page tables, per-row chain lengths,
+    optionally int8-quantized pages stored as value/scale."""
+    keys = jax.random.split(jax.random.PRNGKey(seed), 2)
+    H = KV * G
+    P = S * PP + 3  # spare pages: tables must be a strict subset
+    q = jax.random.normal(keys[0], (S, H, D), jnp.float32)
+    vals = jax.random.normal(keys[1], (P, ps, 2 * KV, D), jnp.float32) * 3.0
+    if dtype == jnp.int8:
+        pages = jnp.clip(jnp.round(vals / kv_scale), -127, 127).astype(jnp.int8)
+    else:
+        pages = vals
+    kv_lens = np.zeros(S, np.int32)
+    kv_lens[: len(kv_lens_list)] = kv_lens_list
+    tables = np.asarray(
+        np.random.default_rng(seed).permutation(S * PP), np.int32
+    ).reshape(S, PP)
+    num = np.asarray([nvalid], np.int32)
+    return q, pages, jnp.asarray(kv_lens), jnp.asarray(tables), jnp.asarray(num)
+
+
+GEOMETRIES = [
+    # (S, PP, ps, KV, G, D, chain lengths, valid rows, dtype, scale)
+    (4, 6, 4, 2, 2, 16, [24, 1, 13, 7], 4, jnp.float32, None),
+    (4, 6, 4, 2, 2, 16, [24, 1, 13, 7], 2, jnp.float32, None),  # empty rows
+    (5, 8, 4, 1, 4, 16, [32, 0, 5, 17, 2], 5, jnp.int8, 0.05),  # int8 + 0-len
+    (2, 5, 2, 2, 1, 8, [9, 10], 2, jnp.float32, 2.5),  # fp32 with scale
+    (3, 4, 4, 2, 2, 8, [16, 16, 16], 3, jnp.int8, 0.1),  # full chains
+]
+
+
+@pytest.mark.parametrize("geom", GEOMETRIES, ids=lambda g: f"S{g[0]}PP{g[1]}")
+@pytest.mark.parametrize("splits,ppcb", [(1, 1), (2, 2), (3, 1), (4, 2)])
+def test_fused_kernel_parity_vs_xla_oracle(geom, splits, ppcb):
+    S, PP, ps, KV, G, D, lens, nv, dt, scale = geom
+    q, pages, kv_lens, tables, num = _case(0, S, PP, ps, KV, G, D, lens, nv,
+                                           dt, scale)
+    sm = D**-0.5
+    want = ragged_decode_attention(
+        q, pages, kv_lens, tables, num, sm_scale=sm, impl="xla",
+        kv_scale=scale,
+    )
+    got = fused_decode_attention(
+        q, pages, kv_lens, tables, num, sm_scale=sm, kv_scale=scale,
+        num_kv_splits=splits, pages_per_block=ppcb, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+    # Rows past num_seqs and zero-length rows are exactly zero (the
+    # oracle's padding contract).
+    for i in range(S):
+        if i >= nv or int(kv_lens[i]) == 0:
+            np.testing.assert_array_equal(np.asarray(got)[i], 0.0)
+
+
+def test_fused_kernel_traced_scale_under_jit():
+    """The fused kernel's dequant contract: kv_scale is an SMEM operand,
+    so a TRACED per-layer calibration scale works without the algebraic
+    q/out fold the stock path needs."""
+    S, PP, ps, KV, G, D = 5, 8, 4, 1, 4, 16
+    q, pages, kv_lens, tables, num = _case(
+        0, S, PP, ps, KV, G, D, [32, 0, 5, 17, 2], 5, jnp.int8, 0.05
+    )
+    sm = D**-0.5
+
+    @jax.jit
+    def f(q, pages, s):
+        return fused_decode_attention(
+            q, pages, kv_lens, tables, num, sm_scale=sm, kv_scale=s,
+            num_kv_splits=2, pages_per_block=2, interpret=True,
+        )
+
+    got = f(q, pages, jnp.float32(0.05))
+    want = ragged_decode_attention(
+        q, pages, kv_lens, tables, num, sm_scale=sm, impl="xla",
+        kv_scale=0.05,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_routed_through_ragged_decode_attention():
+    """kernel="pallas_fused" routes the entry the engine dispatches."""
+    S, PP, ps, KV, G, D = 4, 6, 4, 2, 2, 16
+    q, pages, kv_lens, tables, num = _case(
+        1, S, PP, ps, KV, G, D, [20, 3, 11, 6], 4
+    )
+    sm = D**-0.5
+    want = ragged_decode_attention(
+        q, pages, kv_lens, tables, num, sm_scale=sm, impl="xla"
+    )
+    got = ragged_decode_attention(
+        q, pages, kv_lens, tables, num, sm_scale=sm, impl="xla",
+        kernel="pallas_fused",
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+# ------------------------------------------------------------- selector
+
+
+def test_resolve_decode_kernel(monkeypatch):
+    monkeypatch.delenv("DYN_DECODE_KERNEL", raising=False)
+    assert resolve_decode_kernel("stock") == "stock"
+    assert resolve_decode_kernel("xla") == "xla"
+    assert resolve_decode_kernel("pallas_fused") == "pallas_fused"
+    # auto on CPU resolves to stock (pre-kernel behaviour unchanged)
+    assert resolve_decode_kernel("auto") == "stock"
+    # attn_impl="xla" (the oracle-numerics debugging contract) pins auto
+    # to stock — which honours impl=xla — even where auto would otherwise
+    # pick the fused kernel; an EXPLICIT pallas_fused still wins.
+    assert resolve_decode_kernel("auto", attn_impl="xla") == "stock"
+    assert (
+        resolve_decode_kernel("pallas_fused", attn_impl="xla")
+        == "pallas_fused"
+    )
+    # ''/whitespace env means unset (a template rendering an empty value
+    # must not fail worker boot), and the config layer tolerates it too.
+    monkeypatch.setenv("DYN_DECODE_KERNEL", "")
+    assert resolve_decode_kernel("auto") == "stock"
+    assert resolve_decode_kernel("") == "stock"
+    # env fills the auto slot; explicit config still wins over env
+    monkeypatch.setenv("DYN_DECODE_KERNEL", "pallas_fused")
+    assert resolve_decode_kernel("auto") == "pallas_fused"
+    assert resolve_decode_kernel("xla") == "xla"
+    with pytest.raises(ValueError):
+        resolve_decode_kernel("fused")  # typo'd names fail loudly
+
+
+def test_engine_config_validates_decode_kernel():
+    from dynamo_tpu.engine import EngineConfig
+
+    with pytest.raises(ValueError):
+        EngineConfig(model="debug-tiny", decode_kernel="bogus")
+
+
+# ------------------------------------------- engine stream equivalence
+
+CFG = dict(
+    model="debug-tiny",
+    block_size=4,
+    num_blocks=256,
+    max_batch=4,
+    max_model_len=256,
+    prefill_chunk=16,
+    dtype="float32",
+    decode_steps=4,
+    pipeline_depth=2,
+)
+
+
+def _req(tokens, max_tokens=10, seed=None, temperature=0.0):
+    from dynamo_tpu.llm.protocols import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=temperature, seed=seed),
+    ).to_dict()
+
+
+def _prompt(i, n=12):
+    return [(i * 7919 + j * 104729) % 251 + 1 for j in range(n)]
+
+
+async def _generate_streams(engine):
+    """One engine serves both temperature regimes: temp-0 rows and seeded
+    temp-0.9 rows in the same concurrent batch (mixed-temperature
+    dispatches are the serving shape, not a per-test luxury)."""
+    from dynamo_tpu.runtime.engine import Context, collect
+
+    async def one(i, temperature):
+        items = await collect(
+            await engine.generate(
+                Context(_req(_prompt(i), seed=i + 1, temperature=temperature))
+            )
+        )
+        return [t for it in items for t in it["token_ids"]]
+
+    jobs = [one(i, 0.0) for i in range(3)]
+    jobs += [one(i + 10, 0.9) for i in range(3)]
+    return await asyncio.gather(*jobs)
+
+
+def _run_kernel_mode(kernel, spec=None):
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+
+    out = {}
+
+    async def go():
+        cfg = dict(CFG, decode_kernel=kernel)
+        if spec is not None:
+            cfg["spec_decode"] = spec
+        engine = TpuEngine(EngineConfig(**cfg))
+        compiles0 = engine.warmup()
+        try:
+            out["streams"] = await _generate_streams(engine)
+            out["compiles_stable"] = engine.compile_counts() == compiles0
+            out["resolved"] = engine.decode_kernel
+            out["stalls"] = engine.decode_stalls
+        finally:
+            await engine.close()
+
+    asyncio.run(go())
+    return out
+
+
+def test_exact_streams_across_kernel_modes():
+    """Byte-identical streams pallas_fused vs stock vs xla, temp 0 and
+    seeded temp 0.9 in one batch, zero new compiles after warmup — the
+    repo's standing kernel gate.  Also the clean-run half of the stall
+    watchdog bar: no stall fires without an injected hang."""
+    runs = {k: _run_kernel_mode(k) for k in ("stock", "xla", "pallas_fused")}
+    for k, r in runs.items():
+        assert r["resolved"] == k
+        assert r["compiles_stable"], f"{k}: compiles grew after warmup"
+        assert r["stalls"] == 0, f"{k}: stall watchdog fired on a clean run"
+    assert runs["stock"]["streams"] == runs["xla"]["streams"]
+    assert runs["stock"]["streams"] == runs["pallas_fused"]["streams"], (
+        "fused kernel changed the token streams"
+    )
+
+
+@pytest.mark.spec
+def test_exact_streams_with_spec_decode():
+    """Spec decode rides the UNIFIED program (not the fused decode
+    kernel), but session flips between the two regimes must still leave
+    streams byte-identical across kernel modes."""
+    spec = dict(enable=True, k=4, ngram_min=2, ngram_max=3)
+    a = _run_kernel_mode("pallas_fused", spec=spec)
+    b = _run_kernel_mode("stock", spec=spec)
+    assert a["compiles_stable"] and b["compiles_stable"]
+    assert a["streams"] == b["streams"], (
+        "fused kernel + spec decode diverged from stock"
+    )
+
+
+# ------------------------------------------------------ stall watchdog
+
+
+def test_stall_watchdog_trips_on_injected_hang(caplog):
+    """A wedged token fetch (r5's ~3-minute decode_wait hang class) must
+    trip the watchdog: counter bumped, last_stall recorded with the
+    dispatch trace, loud log — while the stream still completes once the
+    fetch lands."""
+    import time as _time
+
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+
+    async def go():
+        engine = TpuEngine(
+            EngineConfig(**CFG, decode_kernel="stock", decode_stall_s=0.05)
+        )
+        orig = engine._fetch_outs
+        injected = {"n": 0}
+
+        def slow_fetch(out, need_lp):
+            if injected["n"] == 0:
+                injected["n"] = 1
+                _time.sleep(0.4)  # > threshold: a hung device fetch
+            return orig(out, need_lp)
+
+        engine._fetch_outs = slow_fetch
+        try:
+            with caplog.at_level(logging.ERROR, "dynamo_tpu.engine.pipeline"):
+                streams = await _generate_streams(engine)
+            assert all(len(s) == 10 for s in streams)  # streams completed
+            assert engine.decode_stalls >= 1
+            stall = engine.dispatch_summary()["pipeline"]
+            assert stall["stalls"] == engine.decode_stalls
+            assert stall["last_stall"] is not None
+            assert stall["last_stall"]["kind"]
+            assert isinstance(stall["last_stall"]["trace"], list)
+            assert any("decode stall" in r.message for r in caplog.records)
+        finally:
+            await engine.close()
+
+    asyncio.run(go())
+
+
+def test_stall_counter_on_metrics():
+    """dynamo_tpu_engine_stall_total rides /metrics off the dispatch
+    summary source, and the kernel info gauge names the active kernel."""
+    from dynamo_tpu.llm.metrics import EngineDispatchMetrics
+
+    m = EngineDispatchMetrics()
+    m.set_source(
+        lambda: {
+            "kinds": {},
+            "decode_kernel": "pallas_fused",
+            "pipeline": {"stalls": 3, "host_gap_frac": 0.1},
+        }
+    )
+    text = m.render()
+    assert "dynamo_tpu_engine_stall_total 3" in text
+    assert 'decode_kernel_info{kernel="pallas_fused"} 1' in text
+
+
+# ------------------------------------------------------ autotuner table
+
+
+@pytest.fixture
+def clean_hints():
+    clear_tuned_hints()
+    yield
+    clear_tuned_hints()
+
+
+def test_tuned_hints_install_and_fallback(tmp_path, monkeypatch, clean_hints):
+    table = {
+        hint_key("debug-tiny", 4, 4): {
+            "splits": 3, "ppcb": 2, "nq": 7, "nkv_mb": 1
+        }
+    }
+    path = tmp_path / "tune.json"
+    path.write_text(json.dumps(table))
+    monkeypatch.setenv("DYN_DECODE_TUNE_TABLE", str(path))
+    monkeypatch.delenv("DYN_DECODE_SPLITS", raising=False)
+    monkeypatch.delenv("DYN_DECODE_FUSED_PPCB", raising=False)
+
+    # Matching geometry: entry installed, hints resolve from it.
+    entry = install_tuned_hints("debug-tiny", 4, 4)
+    assert entry == table[hint_key("debug-tiny", 4, 4)]
+    assert active_hints() == entry
+    assert resolve_hint("DYN_DECODE_SPLITS", "splits", 0) == 3
+    assert resolve_hint("DYN_DECODE_FUSED_PPCB", "ppcb", 99) == 2
+    # Explicit env var still wins over the tuned entry.
+    monkeypatch.setenv("DYN_DECODE_SPLITS", "5")
+    assert resolve_hint("DYN_DECODE_SPLITS", "splits", 0) == 5
+
+    # Non-matching geometry: fallback to built-in defaults.
+    assert install_tuned_hints("debug-tiny", 8, 16) is None
+    assert active_hints() is None
+    assert resolve_hint("DYN_DECODE_FUSED_PPCB", "ppcb", 99) == 99
+
+    # Corrupt table: never raises, falls back.
+    path.write_text("{not json")
+    assert install_tuned_hints("debug-tiny", 4, 4) is None
+
+
+def test_tuned_hints_feed_stock_block_hints(tmp_path, monkeypatch, clean_hints):
+    from dynamo_tpu.ops.ragged_attention import _decode_block_hints
+
+    pages = jnp.zeros((8, 4, 4, 16), jnp.float32)
+    tables = jnp.zeros((2, 6), jnp.int32)
+    monkeypatch.delenv("DYN_DECODE_NQ", raising=False)
+    monkeypatch.delenv("DYN_DECODE_NKV_MB", raising=False)
+    nq0, nkv0 = _decode_block_hints(pages, tables)
+    assert nq0 == 16  # built-in default
+
+    path = tmp_path / "tune.json"
+    path.write_text(json.dumps({hint_key("m", 2, 4): {"nq": 7, "nkv_mb": 4}}))
+    monkeypatch.setenv("DYN_DECODE_TUNE_TABLE", str(path))
+    install_tuned_hints("m", 2, 4)
+    nq, nkv = _decode_block_hints(pages, tables)
+    assert nq == 7
+    assert nkv == nkv0  # same 4MB budget -> same page count
+    # Env pin beats the table.
+    monkeypatch.setenv("DYN_DECODE_NQ", "11")
+    assert _decode_block_hints(pages, tables)[0] == 11
+
+
+def test_tune_table_write_merges(tmp_path):
+    from tools.tune_decode import write_entry
+
+    path = str(tmp_path / "t.json")
+    write_entry(path, "a|b1|ps4", {"splits": 1})
+    write_entry(path, "c|b2|ps8", {"splits": 2})
+    write_entry(path, "a|b1|ps4", {"splits": 4})  # overwrite in place
+    table = json.loads(open(path).read())
+    assert table == {"a|b1|ps4": {"splits": 4}, "c|b2|ps8": {"splits": 2}}

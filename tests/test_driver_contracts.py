@@ -41,15 +41,24 @@ def test_bench_prints_one_json_line():
     # along so BENCH_r*.json carries what the ROADMAP quotes.
     assert set(out) == {
         "metric", "value", "unit", "vs_baseline",
-        "decode_mfu", "host_gap_frac", "dispatch", "pipeline",
+        "decode_mfu", "decode_kernel", "attention", "host_gap_frac",
+        "dispatch", "pipeline",
     }, sorted(out)
     assert out["value"] > 0
     assert 0.0 <= out["host_gap_frac"] <= 1.0
     assert isinstance(out["decode_mfu"], float)
+    # ISSUE 13: which decode kernel served the run + the analytic
+    # attention byte-share so BENCH_r06 can attribute MFU movement to the
+    # kernel vs the matmuls.
+    assert out["decode_kernel"] in ("pallas_fused", "stock", "xla")
+    assert {"share_est", "kv_bytes_per_step",
+            "weight_bytes_per_step"} <= set(out["attention"])
+    assert 0.0 <= out["attention"]["share_est"] <= 1.0
     for kind, v in out["dispatch"].items():
         assert {"dispatches", "p50_ms", "p99_ms"} <= set(v), (kind, v)
     assert {"sessions", "rebuilds", "continuous_admissions",
-            "continuous_retired", "host_gap_frac"} <= set(out["pipeline"])
+            "continuous_retired", "host_gap_frac", "stalls"} <= set(
+                out["pipeline"])
 
 
 def test_graft_entry_compiles():

@@ -70,14 +70,24 @@ print(f"prefix smoke ok: skip host={r['modes']['host']['skip_frac']} "
       f"pull_blocks={r['pull_served_blocks']}")
 PYEOF
 
+echo "== fused decode kernel parity (interpret-mode pallas vs XLA oracle"
+echo "   on ragged int8/fp32 page tables; ops/decode_attention.py) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_decode_kernel.py -q \
+  -k "parity or traced_scale or routed or resolve" \
+  -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== continuous-decode churn smoke (CPU bench: staggered finishes +"
-echo "   late arrivals; bars: fewer rebuilds than forced-rebuild control,"
-echo "   exact streams, zero new compiles, dispatch metrics parseable) =="
-env JAX_PLATFORMS=cpu BENCH_CHURN=1 python bench.py > /tmp/_churn_smoke.json
+echo "   late arrivals, FUSED decode kernel; bars: fewer rebuilds than"
+echo "   forced-rebuild control, exact streams, zero new compiles,"
+echo "   pallas_fused actually served the run, dispatch metrics parseable) =="
+env JAX_PLATFORMS=cpu DYN_DECODE_KERNEL=pallas_fused BENCH_CHURN=1 \
+  python bench.py > /tmp/_churn_smoke.json
 python - <<'PYEOF'
 import json, math
 r = json.loads(open("/tmp/_churn_smoke.json").read().strip().splitlines()[-1])
 assert r["metric"] == "continuous_decode_rebuilds", r
+assert r["decode_kernel"] == "pallas_fused", (
+    f"churn smoke did not run on the fused kernel: {r['decode_kernel']}")
 # The hot-path guards: continuous batching must absorb the churn the
 # forced-rebuild control drains for, without compiling anything new, and
 # the dispatch summary the planner/bench consume must be well-formed.
@@ -89,7 +99,8 @@ g = r["host_gap_frac"]
 assert isinstance(g, float) and math.isfinite(g) and 0.0 <= g <= 1.0, g
 d = r["dispatch"]["decode_dispatch"]
 assert d["dispatches"] >= 1 and math.isfinite(d["p99_ms"]), d
-print(f"churn smoke ok: rebuilds {r['rebuilds']} "
+print(f"churn smoke ok: kernel={r['decode_kernel']} "
+      f"rebuilds {r['rebuilds']} "
       f"admissions={r['continuous_admissions']} "
       f"retired={r['continuous_retired']} host_gap={g}")
 PYEOF
