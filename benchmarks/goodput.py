@@ -29,6 +29,14 @@ L6    OVERLOAD: a ``tenant_flood`` fault drives a 3x noisy-neighbor burst
       from one flooding tenant on top of the normal multi-tenant trace;
       the scheduler's WFQ (engine/scheduler.py) must keep the non-flooding
       tenants' goodput >= 0.9x their L0 (isolated) goodput
+L7    KV CORRUPTION STORM: ``kv_corrupt`` armed on every integrity plane
+      (disk read / host restore / wire inject) while a storm driver
+      hammers the tiers — shared-prefix repeat traffic through the client
+      with squeezed host budgets (demote → disk-read → restore churn) plus
+      export/inject hops between workers.  The integrity plane
+      (engine/integrity.py) must detect EVERY injected flip before any
+      scatter, drop + negative-cache the poisoned chain, and recompute:
+      0 dropped streams, 0 poisoned tokens, byte-identity vs L0
 ====  =======================================================================
 
 Determinism: the trace, every request's sampling seed, and the fault
@@ -50,9 +58,10 @@ Usage:
 
 ``--check`` exits nonzero unless: every rung has 0 dropped streams, L2
 goodput >= 0.85 x L0 goodput, all completed streams are token-identical to
-the L0 control, L5 respawned its crashed worker, and L6's non-flooding
-tenants each retain >= 0.9x their L0 goodput.  tools/ci.sh runs exactly
-that as the standing gate.
+the L0 control, L5 respawned its crashed worker, L6's non-flooding
+tenants each retain >= 0.9x their L0 goodput, and L7 detected every
+injected corruption before scatter (``integrity.detected >= fired >= 1``).
+tools/ci.sh runs exactly that as the standing gate.
 """
 
 from __future__ import annotations
@@ -62,7 +71,9 @@ import asyncio
 import hashlib
 import json
 import logging
+import shutil
 import sys
+import tempfile
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -76,6 +87,10 @@ REPORT_SCHEMA = "dynamo-tpu-goodput-v1"
 
 # Engine geometry for the CPU ladder: small enough to compile fast, big
 # enough that 3 workers x max_batch rows exercise real batching/preemption.
+# Tiers are ON for every rung (the L0 control must run the exact engine
+# shape the corruption rung stresses; the tiering contract is that restores
+# are byte-identical, so lower rungs are unaffected beyond offload traffic)
+# — run_ladder adds the per-engine disk tier with an explicit directory.
 ENGINE_CFG = dict(
     model="debug-tiny",
     block_size=4,
@@ -86,6 +101,12 @@ ENGINE_CFG = dict(
     dtype="float32",
     decode_steps=2,
     pipeline_depth=2,
+    host_cache_bytes=8 << 20,
+    host_offload_interval=0.05,
+    # CPU-smoke scale: the production default (30s) would keep a hash
+    # banned for the whole rung after its FIRST detection, starving the
+    # other planes of restore traffic for the same 9 storm hashes.
+    kv_corrupt_ttl_s=1.0,
 )
 
 NAMESPACE = "chaos"
@@ -98,6 +119,14 @@ COMPONENT = "fleet"
 TENANTS = ("t0", "t1", "t2")
 FLOOD_TENANT = "flood"
 FLOOD_BASE = 100_000
+# L7 corruption-storm traffic: ids offset past the flood band, a few SHARED
+# prompts replayed every wave (repeat occurrences are what drive the tier
+# demote/restore churn the armed kv_corrupt faults corrupt).  Storm ids
+# never appear in the L0 control, so they ride the 0-dropped bar but not
+# the cross-rung identity bar (each storm stream is still seeded).
+STORM_TENANT = "storm"
+CORRUPT_BASE = 200_000
+STORM_PROMPTS = 3
 # Every UNSEEDED_EVERY-th request omits its sampling seed: server-side
 # seed resolution (engine stamps the resolved seed, derived from the fixed
 # request id, on the first stream item) must keep these byte-identical
@@ -134,6 +163,9 @@ class FaultEvent:
     worker: Optional[int] = None
     level: float = 0.0
     count: Optional[int] = None
+    # Explicit fault-point match key (e.g. the kv_corrupt PLANE: disk /
+    # host / wire); None keeps the worker-address / wildcard derivation.
+    match: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"kind": self.kind, "at": self.at}
@@ -145,6 +177,8 @@ class FaultEvent:
             out["level"] = self.level
         if self.count is not None:
             out["count"] = self.count
+        if self.match is not None:
+            out["match"] = self.match
         return out
 
 
@@ -164,6 +198,15 @@ def ladder_rungs() -> List[Dict[str, Any]]:
     # is armed (the trace driver reads the armed level as the rate
     # multiplier; runtime/faultinject.py documents the kind).
     flood = FaultEvent("tenant_flood", at=0.10, until=0.80, level=3.0)
+    # L7: kv_corrupt armed on every integrity plane for most of the trace;
+    # the storm driver (_drive_corruption) supplies the tier churn the
+    # flips land on.  Detection is 1:1 with firings by construction (one
+    # flip per read/restore/inject), which is what the check bar compares.
+    corrupt = [
+        FaultEvent("kv_corrupt", at=0.10, until=0.80, match="disk"),
+        FaultEvent("kv_corrupt", at=0.10, until=0.80, match="host"),
+        FaultEvent("kv_corrupt", at=0.10, until=0.80, match="wire"),
+    ]
     return [
         {"level": 0, "name": "L0-baseline", "events": []},
         {"level": 1, "name": "L1-worker-crash", "events": [crash1]},
@@ -177,6 +220,8 @@ def ladder_rungs() -> List[Dict[str, Any]]:
          "events": [crash1], "supervise": True},
         {"level": 6, "name": "L6-tenant-flood-overload",
          "events": [flood]},
+        {"level": 7, "name": "L7-kv-corruption-storm",
+         "events": corrupt, "corrupt": True},
     ]
 
 
@@ -478,11 +523,16 @@ class Outcome:
 
 
 def _tenant_for(i: int) -> str:
-    """Deterministic tenant assignment (flood ids live past FLOOD_BASE)."""
+    """Deterministic tenant assignment (flood ids live past FLOOD_BASE,
+    corruption-storm ids past CORRUPT_BASE)."""
+    if i >= CORRUPT_BASE:
+        return STORM_TENANT
     return FLOOD_TENANT if i >= FLOOD_BASE else TENANTS[i % len(TENANTS)]
 
 
-def _request_dict(i: int, isl: int, osl: int, seed: int) -> Dict[str, Any]:
+def _request_dict(
+    i: int, isl: int, osl: int, seed: int, prompt_i: Optional[int] = None
+) -> Dict[str, Any]:
     from dynamo_tpu.llm.protocols import (
         PreprocessedRequest,
         SamplingOptions,
@@ -493,9 +543,12 @@ def _request_dict(i: int, isl: int, osl: int, seed: int) -> Dict[str, Any]:
     # resolves one from the FIXED request id (_one_request pins it), so the
     # stream stays byte-deterministic across rungs AND crash-resumable via
     # the resolved-seed stamp (runtime/client.py _StreamGuard).
+    # ``prompt_i`` decouples the prompt from the request id so the L7 storm
+    # can REPEAT a small prompt set under fresh ids (repeat occurrences are
+    # what exercise the tier restore planes).
     unseeded = i < FLOOD_BASE and i % UNSEEDED_EVERY == 2
     return PreprocessedRequest(
-        token_ids=_prompt_tokens(i, isl),
+        token_ids=_prompt_tokens(i if prompt_i is None else prompt_i, isl),
         stop_conditions=StopConditions(max_tokens=osl, ignore_eos=True),
         sampling_options=SamplingOptions(
             temperature=0.8, seed=None if unseeded else seed * 100003 + i
@@ -516,7 +569,10 @@ async def prewarm_engine(engine, seed: int = 0) -> None:
         await engine.inject_blocks(list(warm["token_ids"]), payload)
 
 
-async def _one_request(client, i: int, isl: int, osl: int, seed: int) -> Outcome:
+async def _one_request(
+    client, i: int, isl: int, osl: int, seed: int,
+    prompt_i: Optional[int] = None,
+) -> Outcome:
     from dynamo_tpu.runtime.engine import Context
 
     out = Outcome(i=i, tenant=_tenant_for(i))
@@ -528,7 +584,9 @@ async def _one_request(client, i: int, isl: int, osl: int, seed: int) -> Outcome
         # seed from it (crc32(id) ^ engine seed), so the same (ladder seed,
         # i) replays byte-identically on any worker and across rungs.
         stream = await client.generate(
-            Context.with_id(_request_dict(i, isl, osl, seed), f"g{seed}-{i}")
+            Context.with_id(
+                _request_dict(i, isl, osl, seed, prompt_i), f"g{seed}-{i}"
+            )
         )
         async for item in stream:
             now = time.monotonic()
@@ -554,7 +612,12 @@ async def _one_request(client, i: int, isl: int, osl: int, seed: int) -> Outcome
     return out
 
 
-async def _drive_fault(fleet: ChaosFleet, ev: FaultEvent, duration: float) -> None:
+async def _drive_fault(
+    fleet: ChaosFleet,
+    ev: FaultEvent,
+    duration: float,
+    armed: Optional[List[Any]] = None,
+) -> None:
     from dynamo_tpu.runtime import faults
 
     await asyncio.sleep(ev.at * duration)
@@ -566,15 +629,19 @@ async def _drive_fault(fleet: ChaosFleet, ev: FaultEvent, duration: float) -> No
         await fleet.restart_hub()
         logger.warning("[fault] hub restarted")
         return
-    match = "*"
-    if ev.worker is not None and ev.worker < len(fleet.workers):
+    match = ev.match or "*"
+    if match == "*" and ev.worker is not None and ev.worker < len(fleet.workers):
         match = fleet.workers[ev.worker].address
-    faults.arm(
+    fault = faults.arm(
         ev.kind,
         match=match,
         count=ev.count,
         delay_s=ev.level or 0.05,
     )
+    if armed is not None:
+        # The disarmed _Fault object keeps its fired count — the L7
+        # integrity bar compares detections against it.
+        armed.append(fault)
     if ev.until is not None:
         await asyncio.sleep((ev.until - ev.at) * duration)
         faults.disarm(ev.kind, match if match != "*" else None)
@@ -630,6 +697,122 @@ async def _drive_flood(
             t.cancel()
 
 
+async def _drive_corruption(
+    fleet: ChaosFleet,
+    events: List[FaultEvent],
+    t_start: float,
+    *,
+    seed: int,
+    duration: float,
+    isl: int,
+    osl: int,
+) -> List[Outcome]:
+    """The ``kv_corrupt`` fault's hook-site driver (the L7 storm): keep
+    every integrity plane BUSY while the flips are armed.
+
+    Each wave (a) force-evicts the shared storm prefixes out of HBM on
+    every live engine (``KvBlockManager.evict_hashes`` — the real LRU
+    eviction path, deterministic instead of hoping organic pressure lands
+    on exactly these blocks) so the repeats MUST restore from the tiers;
+    (b) replays STORM_PROMPTS shared prompts through the routed client
+    under fresh ids — the restores walk host→HBM (the ``host`` flip's
+    boundary) and, on squeeze waves, disk→host→HBM (the ``disk`` flip's);
+    (c) alternates a host-budget squeeze so demotions reach the disk
+    tier; and (d) ships one storm prefix between two live workers over
+    export/inject — the ``wire`` plane, the same path cross-worker pulls
+    and migration pushes ride.  Storm streams are seeded and must
+    COMPLETE (detection degrades to recompute, never a drop); original
+    host budgets are restored when the storm ends."""
+    from dynamo_tpu.tokens import hash_token_blocks
+    lo = min(ev.at for ev in events) * duration
+    hi = max(
+        ev.until if ev.until is not None else 1.0 for ev in events
+    ) * duration
+    outcomes: List[Outcome] = []
+    orig_caps: Dict[int, int] = {}
+    counter = 0
+    wave = 0
+    delay = lo - (time.monotonic() - t_start)
+    if delay > 0:
+        await asyncio.sleep(delay)
+    try:
+        while time.monotonic() - t_start < hi:
+            live = [w for w in fleet.workers if not w.closed]
+            # Alternate the host-tier squeeze: even waves shrink the
+            # budget so offloads DEMOTE to disk (the disk plane needs real
+            # file reads); odd waves restore it so blocks stay
+            # host-resident and the next repeat's restore verifies them at
+            # the host→HBM boundary (the host plane).
+            for w in live:
+                eng = w.engine
+                if getattr(eng, "host_kv", None) is None:
+                    continue
+                orig_caps.setdefault(id(eng), eng.host_kv.capacity_bytes)
+                eng.host_kv.capacity_bytes = (
+                    3 * eng.block_nbytes() if wave % 2 == 0
+                    else orig_caps[id(eng)]
+                )
+            # Deterministic HBM pressure: evict the storm chains so the
+            # repeats below restore through the (corrupting) tiers.
+            for w in live:
+                for p in range(STORM_PROMPTS):
+                    w.engine.kv.evict_hashes([
+                        tb.sequence_hash
+                        for tb in hash_token_blocks(
+                            _prompt_tokens(CORRUPT_BASE + p, isl),
+                            w.engine.cfg.block_size,
+                        )
+                    ])
+            tasks = [
+                asyncio.ensure_future(
+                    _one_request(
+                        fleet.client, CORRUPT_BASE + counter + p, isl, osl,
+                        seed, prompt_i=CORRUPT_BASE + p,
+                    )
+                )
+                for p in range(STORM_PROMPTS)
+            ]
+            counter += STORM_PROMPTS
+            outcomes.extend(await asyncio.gather(*tasks))
+            for w in live:
+                if w.closed:
+                    continue
+                try:
+                    await w.engine.drain_offload()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — storm churn is best-effort
+                    pass
+            # Wire plane: one export→inject hop between two live workers
+            # (the exact transfer path cross-worker pulls and migration
+            # pushes use; the donor restores from its own tiers first).
+            if len(live) >= 2:
+                donor = live[wave % len(live)].engine
+                dst = live[(wave + 1) % len(live)].engine
+                toks = _prompt_tokens(CORRUPT_BASE + (wave % STORM_PROMPTS), isl)
+                try:
+                    await donor.restore_prefix(toks)
+                    payload = await donor.export_prompt_blocks(toks)
+                    if payload is not None:
+                        await dst.inject_blocks(toks, payload)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — best-effort churn
+                    logger.warning("storm wire hop failed", exc_info=True)
+            wave += 1
+            await asyncio.sleep(0.05)
+    finally:
+        for w in fleet.workers:
+            eng = w.engine
+            cap = orig_caps.get(id(eng))
+            if cap is not None and getattr(eng, "host_kv", None) is not None:
+                eng.host_kv.capacity_bytes = cap
+    logger.info(
+        "[storm] %d corruption-storm requests over %d waves", counter, wave
+    )
+    return outcomes
+
+
 async def run_rung(
     engines: List[Any],
     rung: Dict[str, Any],
@@ -649,11 +832,19 @@ async def run_rung(
     from dynamo_tpu.runtime.health import health_metrics, worker_latency
     from dynamo_tpu.runtime.resilience import metrics as res
 
+    from dynamo_tpu.llm.metrics import kv_integrity_metrics
+
     faults.reset()
     worker_latency.reset()
     trace = gen_trace(
         "burst", rate=rate, duration_s=duration, seed=seed, isl=isl, osl=osl
     )
+    integrity_before = {
+        "corrupt": dict(kv_integrity_metrics.corrupt_total),
+        "verified": dict(kv_integrity_metrics.verified_total),
+        "negcache": kv_integrity_metrics.negative_cache_hits_total,
+        "recomputed": kv_integrity_metrics.recomputed_total,
+    }
     before = {
         "reconnects": res.hub_reconnects_total,
         "sessions_resumed": res.hub_sessions_resumed_total,
@@ -670,8 +861,9 @@ async def run_rung(
     if rung.get("supervise"):
         await fleet.start_supervisor()
     t_start = time.monotonic()
+    armed: List[Any] = []
     fault_tasks = [
-        asyncio.ensure_future(_drive_fault(fleet, ev, duration))
+        asyncio.ensure_future(_drive_fault(fleet, ev, duration, armed))
         for ev in rung["events"]
     ]
     req_tasks: List[asyncio.Task] = []
@@ -682,6 +874,15 @@ async def run_rung(
             _drive_flood(
                 fleet, flood_events[0], t_start,
                 seed=seed, rate=rate, duration=duration, isl=isl, osl=osl,
+            )
+        )
+    corrupt_events = [ev for ev in rung["events"] if ev.kind == "kv_corrupt"]
+    storm_task = None
+    if corrupt_events:
+        storm_task = asyncio.ensure_future(
+            _drive_corruption(
+                fleet, corrupt_events, t_start,
+                seed=seed, duration=duration, isl=isl, osl=osl,
             )
         )
     try:
@@ -699,12 +900,18 @@ async def run_rung(
             # The flood's streams are admitted work too: they count against
             # the 0-dropped bar (and are reported under their own tenant).
             outcomes.extend(await flood_task)
+        if storm_task is not None:
+            # Same contract for the corruption storm: every storm stream
+            # must COMPLETE — detection degrades to recompute, never a drop.
+            outcomes.extend(await storm_task)
         await asyncio.gather(*fault_tasks)
     finally:
         for t in (*req_tasks, *fault_tasks):
             t.cancel()
         if flood_task is not None:
             flood_task.cancel()
+        if storm_task is not None:
+            storm_task.cancel()
         faults.reset()
         await fleet.close()
     # -- scoring ------------------------------------------------------------
@@ -775,6 +982,28 @@ async def run_rung(
             "dropped": len(dropped),
         },
     }
+    if corrupt_events:
+        # The L7 bars: every armed kv_corrupt firing is one injected flip,
+        # and the integrity plane's corrupt counters advance exactly once
+        # per detected flip — detected >= fired means nothing scattered
+        # undetected ("0 poisoned tokens" is then proven by the generic
+        # byte-identity bar over the completed streams).
+        planes = {
+            p: kv_integrity_metrics.corrupt_total[p]
+            - integrity_before["corrupt"][p]
+            for p in kv_integrity_metrics.corrupt_total
+        }
+        report["integrity"] = {
+            "fired": sum(f.fired for f in armed if f.point == "kv_corrupt"),
+            "detected": sum(planes.values()),
+            "planes": planes,
+            "verified": sum(kv_integrity_metrics.verified_total.values())
+            - sum(integrity_before["verified"].values()),
+            "negative_cache_hits": kv_integrity_metrics.negative_cache_hits_total
+            - integrity_before["negcache"],
+            "recomputed": kv_integrity_metrics.recomputed_total
+            - integrity_before["recomputed"],
+        }
     return report
 
 
@@ -818,6 +1047,22 @@ def check_report(
             problems.append(
                 f"L{level}: supervised rung respawned no crashed worker"
             )
+        if any(ev["kind"] == "kv_corrupt" for ev in rung["faults"]):
+            # Corruption rung: every injected flip must be DETECTED before
+            # scatter.  Zero firings means the storm never reached the
+            # armed planes — a silently-dead rung must fail, not pass.
+            integ = rung.get("integrity") or {}
+            if integ.get("fired", 0) < 1:
+                problems.append(
+                    f"L{level}: corruption rung fired no kv_corrupt faults "
+                    "(storm never reached the integrity planes)"
+                )
+            if integ.get("detected", 0) < integ.get("fired", 0):
+                problems.append(
+                    f"L{level}: {integ.get('fired', 0) - integ.get('detected', 0)} "
+                    "injected corruption(s) scattered UNDETECTED "
+                    f"(fired={integ.get('fired')} detected={integ.get('detected')})"
+                )
         if any(ev["kind"] == "tenant_flood" for ev in rung["faults"]):
             # Noisy-neighbor isolation: every non-flooding tenant keeps >=
             # min_tenant_ratio of its isolated (L0) goodput while the
@@ -855,7 +1100,22 @@ async def run_ladder(args) -> Dict[str, Any]:
            if ev.worker is not None]
     )
     logger.info("building %d engines (%s)", n_workers, ENGINE_CFG["model"])
-    engines = [TpuEngine(EngineConfig(**ENGINE_CFG)) for _ in range(n_workers)]
+    # Per-engine disk tiers with EXPLICIT directories: the engine-owned
+    # per-PID default would collide across the fleet's engines (one
+    # process), and the first close() would rmtree everyone's files.
+    kv_root = Path(
+        tempfile.mkdtemp(prefix="goodput-kv-", dir=args.workdir)
+    )
+    engines = [
+        TpuEngine(
+            EngineConfig(
+                **ENGINE_CFG,
+                disk_cache_bytes=8 << 20,
+                disk_cache_dir=str(kv_root / f"w{i}"),
+            )
+        )
+        for i in range(n_workers)
+    ]
     for engine in engines:
         await prewarm_engine(engine, args.seed)
     fault_matrix = None
@@ -904,6 +1164,7 @@ async def run_ladder(args) -> Dict[str, Any]:
     finally:
         for engine in engines:
             await engine.close()
+        shutil.rmtree(kv_root, ignore_errors=True)
     if fault_matrix is not None:
         swept = set(fault_matrix.get("fault_kinds") or ()) or {
             row.get("fault", "").split(" ")[0]

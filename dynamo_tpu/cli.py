@@ -528,6 +528,16 @@ class WorkerRoles:
             engine.set_prefix_puller(
                 PrefixPuller(engine, make_client_exporter(pull_client))
             )
+            # KV integrity self-reporting (docs/kv_tiering.md §integrity):
+            # this worker's OWN disk/host corruption detections feed the
+            # watchdog's ledger under its worker id — a sick local medium
+            # earns the same quarantine path as a donor shipping poison.
+            from .runtime.health import kv_corruption
+
+            wid = runtime.worker_id
+            engine.set_integrity_reporter(
+                lambda plane, _wid=wid: kv_corruption.record(_wid)
+            )
             if getattr(engine, "disk_kv", None) is not None:
                 h["prefetch"] = await KvPrefetchConsumer(
                     endpoint.component, engine
@@ -602,6 +612,8 @@ class WorkerRoles:
             await h["prefetch"].stop()
         if hasattr(self.engine, "set_prefix_puller"):
             self.engine.set_prefix_puller(None)
+        if hasattr(self.engine, "set_integrity_reporter"):
+            self.engine.set_integrity_reporter(None)
         if h.get("pull_client") is not None:
             await h["pull_client"].close()
         if h.get("metrics_pub") is not None:

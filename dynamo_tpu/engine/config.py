@@ -288,6 +288,19 @@ class EngineConfig:
     # Directory for the disk tier's block files; None resolves to a
     # per-process dir under the system temp root.
     disk_cache_dir: Optional[str] = None
+    # fsync the block file before the atomic rename (DYN_DISK_FSYNC=1 also
+    # enables).  os.replace is rename-atomic, but a power loss can persist
+    # a renamed file whose payload pages never hit the platter; default
+    # OFF because the read-side checksum already turns that torn payload
+    # into a recompute, never a wrong scatter (docs/kv_tiering.md has the
+    # durability-vs-latency tradeoff).
+    disk_fsync: bool = False
+    # KV integrity plane (engine/integrity.py): seconds a checksum-failed
+    # block hash stays negative-cached.  While banned, restore/promotion
+    # treat the hash as a miss and cross-worker pulls skip it, so a donor
+    # still holding the corrupt copy cannot be re-pulled in a loop; after
+    # the TTL a healthy copy becomes reachable again.
+    kv_corrupt_ttl_s: float = 30.0
     # Cross-worker prefix pull (llm/kv_router/pull.py): when the router's
     # index says a peer holds a strictly longer prefix than every local
     # tier, the engine pulls the sealed delta blocks over the KV transfer

@@ -13,10 +13,16 @@ Layout: one file per block, named by the block's chained sequence hash
 tier and the router index key on, so tenant isolation (llm/tenancy KV
 salts) holds structurally here too: a tenant's hashes are the only handles
 that can name its files.  Each file is a small self-describing container
-(magic + JSON header {dtype, shape} + raw payload) validated byte-for-byte
-on read, mirroring ``inject_blocks``'s validate-before-allocate contract:
-a truncated or corrupt file is deleted and treated as a miss, never
-scattered into the cache.
+(magic + JSON header {dtype, shape, checksum} + raw payload) validated
+byte-for-byte on read, mirroring ``inject_blocks``'s validate-before-
+allocate contract: a truncated or corrupt file is deleted and treated as
+a miss, never scattered into the cache.  The ``checksum`` (CRC-32 over
+the payload bytes — engine/integrity.py) is *carried* from the host
+tier's offload stamp, not recomputed here, so a bit that rotted in host
+RAM between offload and demotion is refused at the write instead of
+laundered into a structurally-valid file; reads verify it before any
+promotion.  Files without the field (pre-integrity envelopes) stay
+readable — omit-when-absent, like the wire plane.
 
 Thread-safety: all mutation happens under one internal lock because
 callers run file I/O off the event loop (``asyncio.to_thread``).  Tier
@@ -62,9 +68,16 @@ class DiskKvStore:
     host tier holds whole contiguous blocks only in single-process runs —
     multi-host per-shard dicts are refused at ``put``)."""
 
-    def __init__(self, capacity_bytes: int, directory: str):
+    def __init__(self, capacity_bytes: int, directory: str, fsync: bool = False):
         self.capacity_bytes = capacity_bytes
         self.directory = directory
+        # Durability knob (DYN_DISK_FSYNC / EngineConfig.disk_fsync):
+        # ``os.replace`` is rename-atomic but a power loss can persist the
+        # renamed file with unflushed payload pages; fsync-before-rename
+        # closes that window at a per-demotion latency cost.  Default OFF
+        # because the read-side checksum already catches the torn payload
+        # (deleted + recompute) — docs/kv_tiering.md has the tradeoff.
+        self.fsync = fsync
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
         # Transition records get their OWN tiny lock: the event loop drains
@@ -84,9 +97,18 @@ class DiskKvStore:
         # promotion is driven (and recorded) by the engine side.
         self._transitions: List[Tuple[str, int]] = []
         # Rebuild the index from an existing directory (a restarted worker
-        # finds its demoted blocks again): coldest = oldest mtime.
+        # finds its demoted blocks again): coldest = oldest mtime.  Orphaned
+        # ``*.kvblk.tmp`` files (a crash mid-write) are deleted here — they
+        # hold no indexable content but consume disk OUTSIDE the byte
+        # budget, forever, across every restart.
         entries = []
         for name in os.listdir(directory):
+            if name.endswith(".kvblk.tmp"):
+                try:
+                    os.remove(os.path.join(directory, name))
+                except OSError:
+                    pass
+                continue
             if not name.endswith(".kvblk"):
                 continue
             try:
@@ -134,18 +156,43 @@ class DiskKvStore:
             return out
 
     # -------------------------------------------------------------------- put
-    def put(self, seq_hash: int, block) -> bool:
+    def put(self, seq_hash: int, block, checksum: Optional[int] = None) -> bool:
         """Demote one host-tier block to disk.  Returns False (and the
         caller emits Removed instead of a disk tier-tag) when the block
         cannot be taken: multi-host shard dicts, or larger than the whole
-        budget."""
+        budget.
+
+        ``checksum`` is the block's offload-time integrity stamp
+        (engine/integrity.py).  When provided it is VERIFIED against the
+        payload before anything touches disk: a mismatch means the bytes
+        rotted in host RAM after the stamp, and writing them would launder
+        the corruption into a structurally-valid file other requests (and
+        restarts) would trust."""
+        from .integrity import bytes_checksum
+
         if not isinstance(block, np.ndarray):
             self.rejected_blocks += 1
             return False
-        header = json.dumps(
-            {"dtype": str(block.dtype), "shape": list(block.shape)}
-        ).encode()
         payload = np.ascontiguousarray(block).tobytes()
+        payload_crc = bytes_checksum(payload)
+        if checksum is not None and int(checksum) != payload_crc:
+            from ..llm.metrics import kv_integrity_metrics
+
+            kv_integrity_metrics.corrupt_total["host"] += 1
+            self.corrupt_blocks += 1
+            self.rejected_blocks += 1
+            logger.warning(
+                "refusing to demote block %#x: payload fails its offload "
+                "checksum (host-RAM corruption)", seq_hash,
+            )
+            return False
+        header = json.dumps(
+            {
+                "dtype": str(block.dtype),
+                "shape": list(block.shape),
+                "checksum": payload_crc,
+            }
+        ).encode()
         blob = _MAGIC + _HLEN.pack(len(header)) + header + payload
         nbytes = len(blob)
         with self._lock:
@@ -170,6 +217,9 @@ class DiskKvStore:
             try:
                 with open(tmp, "wb") as f:
                     f.write(blob)
+                    if self.fsync:
+                        f.flush()
+                        os.fsync(f.fileno())
                 os.replace(tmp, path)  # atomic: readers never see a torn file
             except OSError:
                 logger.exception("disk KV tier write failed for %#x", seq_hash)
@@ -191,34 +241,84 @@ class DiskKvStore:
         expected_shape: Optional[Tuple[int, ...]] = None,
         expected_dtype=None,
     ) -> Optional[np.ndarray]:
+        """Read + VALIDATE one block; see ``read`` (this wrapper drops the
+        integrity detail for callers that only care hit/miss)."""
+        return self.read(seq_hash, expected_shape, expected_dtype)[0]
+
+    def read(
+        self,
+        seq_hash: int,
+        expected_shape: Optional[Tuple[int, ...]] = None,
+        expected_dtype=None,
+    ) -> Tuple[Optional[np.ndarray], Optional[int], bool]:
         """Read + VALIDATE one block (the inject_blocks contract: a block
         that fails validation is a miss, never a crash or a wrong scatter).
-        A corrupt file is deleted so it cannot miss forever."""
+        Returns ``(array, carried_checksum, corrupt)``: the checksum rides
+        to the host tier on promotion so the stamp survives the round
+        trip; ``corrupt`` distinguishes a failed verification from a plain
+        miss so the engine can quarantine the chain.  A corrupt file is
+        deleted (it cannot miss forever) and its loss RECORDED so the
+        router stops advertising the prefix."""
+        from ..runtime.faultinject import faults
+
         with self._lock:
             if seq_hash not in self._index:
-                return None
+                return None, None, False
             path = self._path(seq_hash)
             try:
                 with open(path, "rb") as f:
                     blob = f.read()
             except OSError:
                 self._drop_locked(seq_hash)
-                return None
-            arr = self._parse(blob, expected_shape, expected_dtype)
-            if arr is None:
+                with self._tlock:
+                    self._transitions.append(("drop", seq_hash))
+                return None, None, False
+            if (
+                faults.enabled
+                and len(blob) > len(_MAGIC) + _HLEN.size
+                and faults.should("kv_corrupt", "disk")
+            ):
+                # Chaos hook: flip one payload byte AFTER the OS read —
+                # models media rot the structural checks cannot see.
+                from .integrity import flip_blob_byte
+
+                (hlen,) = _HLEN.unpack_from(blob, len(_MAGIC))
+                blob = flip_blob_byte(blob, len(_MAGIC) + _HLEN.size + hlen)
+            parsed = self._parse(blob, expected_shape, expected_dtype)
+            if parsed is None:
                 self.corrupt_blocks += 1
                 self._drop_locked(seq_hash)
+                with self._tlock:
+                    self._transitions.append(("drop", seq_hash))
                 try:
                     os.remove(path)
                 except OSError:
                     pass
-                return None
+                return None, None, True
+            arr, checksum = parsed
             self._index.move_to_end(seq_hash)  # touch
-            return arr
+            return arr, checksum, False
+
+    def drop(self, seq_hash: int) -> bool:
+        """Remove one block (corruption quarantine of chained
+        descendants); records the loss for the engine's event flush."""
+        with self._lock:
+            if seq_hash not in self._index:
+                return False
+            self._drop_locked(seq_hash)
+            try:
+                os.remove(self._path(seq_hash))
+            except OSError:
+                pass
+        with self._tlock:
+            self._transitions.append(("drop", seq_hash))
+        return True
 
     def _parse(
         self, blob: bytes, expected_shape, expected_dtype
-    ) -> Optional[np.ndarray]:
+    ) -> Optional[Tuple[np.ndarray, Optional[int]]]:
+        from .integrity import bytes_checksum
+
         if not blob.startswith(_MAGIC) or len(blob) < len(_MAGIC) + _HLEN.size:
             return None
         off = len(_MAGIC)
@@ -230,6 +330,8 @@ class DiskKvStore:
             header = json.loads(blob[off : off + hlen])
             dt = _np_dtype(header["dtype"])
             shape = tuple(int(s) for s in header["shape"])
+            checksum = header.get("checksum")
+            checksum = None if checksum is None else int(checksum)
         except (ValueError, KeyError, TypeError):
             return None
         off += hlen
@@ -239,7 +341,9 @@ class DiskKvStore:
             return None
         if expected_dtype is not None and dt != np.dtype(expected_dtype):
             return None
-        return np.frombuffer(blob, dtype=dt, offset=off).reshape(shape)
+        if checksum is not None and bytes_checksum(blob[off:]) != checksum:
+            return None  # payload bit-rot: structural checks passed, CRC not
+        return np.frombuffer(blob, dtype=dt, offset=off).reshape(shape), checksum
 
     def _drop_locked(self, seq_hash: int) -> None:
         nbytes = self._index.pop(seq_hash, None)

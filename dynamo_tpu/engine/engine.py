@@ -131,6 +131,14 @@ class TpuEngine(
         # Cross-worker prefix pull hook (llm/kv_router/pull.py): the serving
         # layer wires a PrefixPuller; None = pulls disabled.
         self._prefix_puller = None
+        # KV integrity plane (engine/integrity.py): negative cache of
+        # checksum-failed hashes (always on — the wire plane needs it even
+        # without tiers) + the optional self-corruption reporter the
+        # serving layer wires to feed the health watchdog.
+        from .integrity import CorruptionCache
+
+        self.integrity = CorruptionCache(ttl_s=cfg.kv_corrupt_ttl_s)
+        self._integrity_reporter = None
         if cfg.host_cache_bytes > 0:
             # Multi-process: every host keeps a PER-HOST SHARDED tier — it
             # stores only the shards its own devices hold (gathers and
@@ -166,7 +174,12 @@ class TpuEngine(
                         _tempfile.gettempdir(),
                         f"dynamo_tpu_kv_{_os.getpid()}",
                     )
-                    self.disk_kv = DiskKvStore(cfg.disk_cache_bytes, d)
+                    fsync = cfg.disk_fsync or _os.environ.get(
+                        "DYN_DISK_FSYNC", ""
+                    ) not in ("", "0", "false")
+                    self.disk_kv = DiskKvStore(
+                        cfg.disk_cache_bytes, d, fsync=fsync
+                    )
                     self.host_kv.on_evict = self._demote_to_disk
             # HBM eviction of a block a lower tier retains emits a
             # tier-tagged event instead of Removed (kv_manager).
@@ -1193,10 +1206,76 @@ class TpuEngine(
     def _demote_to_disk(self, seq_hash: int, block) -> bool:
         """HostKvStore.on_evict hook: push an evicted host-tier block down
         to disk.  Runs inside the host store's eviction loop (often off the
-        event loop) — record-only, events flush later."""
+        event loop) — record-only, events flush later.  The host tier's
+        offload-time checksum is CARRIED into the disk envelope (and
+        verified by the put), so a bit that rotted in host RAM is refused
+        here instead of laundered into a valid-looking file."""
         if self.disk_kv is None:
             return False
-        return self.disk_kv.put(seq_hash, block)
+        return self.disk_kv.put(
+            seq_hash, block, checksum=self.host_kv.checksum(seq_hash)
+        )
+
+    def set_integrity_reporter(self, reporter) -> None:
+        """Attach ``reporter(plane: str)`` called on every LOCAL-tier
+        corruption detection (disk/host).  The serving layer wires it to
+        feed the health watchdog's corruption ledger with this worker's
+        own id — a worker whose own media keeps flipping bits is as
+        quarantine-worthy as a donor shipping poison.  None detaches."""
+        self._integrity_reporter = reporter
+
+    def _record_corruption(
+        self,
+        plane: str,
+        seq_hash: Optional[int],
+        chain: Optional[List[int]] = None,
+        donor: Optional[int] = None,
+    ) -> None:
+        """Corruption quarantine, one entry point for every plane:
+        count it, negative-cache the hash (TTL — restore/pull loops must
+        not thrash on it), drop the block and every CHAINED DESCENDANT
+        still held by the local tiers (their contents may be fine, but
+        their chain passes through poison — the radix index must stop
+        advertising the whole run), attribute a wire donor to the health
+        ledger, and report local-tier rot to the serving layer.
+
+        The caller flushes tier events afterwards (this may run in a
+        thread; event emission must happen on the loop)."""
+        from ..llm.metrics import kv_integrity_metrics
+
+        kv_integrity_metrics.corrupt_total[plane] += 1
+        logger.warning(
+            "KV corruption detected on plane %r (block %s): dropped before "
+            "scatter; falling back to recompute",
+            plane, f"{seq_hash:#x}" if seq_hash is not None else "?",
+        )
+        if seq_hash is not None:
+            self.integrity.ban(seq_hash)
+            dropped = 0
+            descendants: List[int] = []
+            if chain:
+                try:
+                    descendants = chain[chain.index(seq_hash) + 1:]
+                except ValueError:
+                    descendants = []
+            for d in [seq_hash, *descendants]:
+                hit = False
+                if self.host_kv is not None and self.host_kv.drop(d):
+                    hit = True
+                if self.disk_kv is not None and self.disk_kv.drop(d):
+                    hit = True
+                if hit and d != seq_hash:
+                    dropped += 1
+            kv_integrity_metrics.descendants_dropped_total += dropped
+        if donor is not None:
+            from ..runtime.health import kv_corruption
+
+            kv_corruption.record(donor)
+        elif plane != "wire" and self._integrity_reporter is not None:
+            try:
+                self._integrity_reporter(plane)
+            except Exception:  # noqa: BLE001 — reporting must never break serving
+                logger.warning("integrity reporter failed", exc_info=True)
 
     def _flush_tier_events(self) -> None:
         """Publish tier transitions recorded by the host/disk stores since
@@ -1226,15 +1305,19 @@ class TpuEngine(
         self.kv.emit_removed(removed)
 
     def local_prefix_blocks(
-        self, token_ids: List[int], salt: Optional[str] = None
+        self, token_ids: List[int], salt: Optional[str] = None,
+        blocks: Optional[List[Any]] = None,
     ) -> int:
         """Leading complete blocks restorable from ANY local tier (HBM,
         host, disk) — what a cross-worker pull must strictly beat before
-        moving bytes (llm/kv_router/pull.py)."""
+        moving bytes (llm/kv_router/pull.py).  ``blocks`` lets a caller
+        that already hashed the chain skip the second O(prompt) walk."""
         from ..tokens import hash_token_blocks
 
+        if blocks is None:
+            blocks = hash_token_blocks(token_ids, self.cfg.block_size, salt)
         n = 0
-        for tb in hash_token_blocks(token_ids, self.cfg.block_size, salt):
+        for tb in blocks:
             h = tb.sequence_hash
             if h in self.kv._by_hash or self._tier_of(h) is not None:
                 n += 1

@@ -18,7 +18,7 @@ from __future__ import annotations
 import logging
 import threading
 from collections import OrderedDict
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -61,11 +61,18 @@ class HostKvStore:
         # reason (drain_transitions runs on the loop).
         self._lock = threading.Lock()
         self._tlock = threading.Lock()
+        # Integrity stamps (engine/integrity.py): hash → CRC-32 of the
+        # block's bytes, computed ONCE at offload (put) and carried to the
+        # disk envelope on demotion and back on promotion.  Multi-host
+        # shard dicts carry None (per-shard stamps would not survive the
+        # broadcast-ordered reassembly; documented restriction).
+        self._sums: Dict[int, Optional[int]] = {}
         # counters (metrics / tests)
         self.stored_blocks = 0
         self.restored_blocks = 0
         self.evicted_blocks = 0
         self.demoted_blocks = 0
+        self.corrupt_blocks = 0
         self._transitions: List[Tuple[str, int]] = []
 
     @staticmethod
@@ -104,17 +111,28 @@ class HostKvStore:
         demoted = False
         if self.on_evict is not None:
             try:
+                # _sums still holds h here: the demotion hook reads
+                # checksum(h) to carry the offload stamp into the disk
+                # envelope; popped only after the hook returns.
                 demoted = bool(self.on_evict(h, old))
             except Exception:
                 # Demotion is an optimization; a failing disk tier must
                 # never break the host tier's eviction path.
                 logger.exception("host-tier demotion failed for %#x", h)
+        self._sums.pop(h, None)
         if demoted:
             self.demoted_blocks += 1
         with self._tlock:
             self._transitions.append(("demote" if demoted else "drop", h))
 
-    def put(self, seq_hash: int, block) -> None:
+    def put(self, seq_hash: int, block, checksum: Optional[int] = None) -> None:
+        from .integrity import block_checksum
+
+        if checksum is None and isinstance(block, np.ndarray):
+            # THE integrity stamp: computed once here (offload commit /
+            # disk promotion passes the carried one instead) and verified
+            # at every later media boundary.  Shard dicts stay unstamped.
+            checksum = block_checksum(block)
         with self._lock:
             if seq_hash in self._data:
                 self._data.move_to_end(seq_hash)
@@ -125,6 +143,7 @@ class HostKvStore:
             while self._bytes + nbytes > self.capacity_bytes and self._data:
                 self._evict_one()
             self._data[seq_hash] = block
+            self._sums[seq_hash] = checksum
             self._bytes += nbytes
             self.stored_blocks += 1
 
@@ -153,3 +172,26 @@ class HostKvStore:
         selection that may be truncated before the restore is broadcast)
         must not reorder the leader's LRU relative to the followers'."""
         return self._data.get(seq_hash)
+
+    def checksum(self, seq_hash: int) -> Optional[int]:
+        """The block's offload-time integrity stamp (None: absent or an
+        unstamped multi-host shard dict).  Lock-free like the other reads
+        — a stale answer degrades to one spurious recompute, never a
+        wrong scatter."""
+        return self._sums.get(seq_hash)
+
+    def drop(self, seq_hash: int) -> bool:
+        """Remove one block WITHOUT demotion (corruption quarantine: the
+        contents failed verification, pushing them down a tier would just
+        relocate the poison).  Records the loss for the engine's event
+        flush so the router stops advertising the prefix."""
+        with self._lock:
+            blk = self._data.pop(seq_hash, None)
+            self._sums.pop(seq_hash, None)
+            if blk is None:
+                return False
+            self._bytes -= self._nbytes(blk)
+            self.corrupt_blocks += 1
+        with self._tlock:
+            self._transitions.append(("drop", seq_hash))
+        return True

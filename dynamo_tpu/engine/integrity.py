@@ -1,0 +1,183 @@
+"""KV integrity plane: content checksums for every tier and wire boundary.
+
+PRs 5/11 made KV blocks a fleet-wide, multi-tier currency — HBM → host →
+disk demotion, cross-worker prefix pull, live migration — but the
+validation on those paths was *structural* (magic/header/shape/dtype/
+byte-length): a single payload bit-flip on disk, in host RAM, or on the
+wire scattered cleanly and silently poisoned every stream reusing that
+prefix, and the pull/migration planes then propagated the poison
+fleet-wide (Llumnix's point: once live state migrates between workers,
+state fidelity is a correctness invariant, not an optimization).
+
+This module is the shared core; the verification points live at each
+media/process boundary:
+
+=========  ==============================================  ==============
+plane      stamped by                                      verified by
+=========  ==============================================  ==============
+``host``   ``HostKvStore.put`` (offload commit)            ``_restore_pass``
+                                                           before scatter
+``disk``   carried from the host stamp into the ``.kvblk`` ``DiskKvStore.read``
+           envelope header (``_demote_to_disk``)           before promote
+``wire``   ``export_prompt_blocks`` (per-block, from HBM)  ``inject_blocks``
+                                                           before seal —
+                                                           covers pull,
+                                                           migration push
+                                                           and disagg
+=========  ==============================================  ==============
+
+The checksum is CRC-32 (zlib) — stdlib, byte-identical in every process
+of a fleet (an algorithm that varies by installed modules would read as
+fleet-wide corruption).  Host and disk share ONE stamp per block (CRC
+over the combined block's ``tobytes()``), computed once at offload and
+carried down and back up the tier chain, so host-RAM rot between offload
+and demotion is caught at the disk write, not laundered into a "valid"
+file.  The wire stamp is computed per exported block from the split K/V
+arrays at export time (a fresh HBM gather — HBM is the source of truth).
+
+A verification failure is never a crash or a wrong token: the block and
+its chained descendants are dropped from the tiers (``Removed`` events
+stop the router advertising the prefix), the hash is negative-cached
+(``CorruptionCache``, TTL) so restore/pull loops cannot thrash on it,
+the stream falls back to recompute (byte-identical by construction), and
+repeated corruption from one donor feeds the health watchdog's
+quarantine path (``runtime/health.py kv_corruption``).
+
+Wire compat is omit-when-absent: payloads without ``checksums`` (older
+peers) stay servable — verification simply has nothing to check.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def block_checksum(block) -> int:
+    """CRC-32 of one combined KV block's bytes ([L, ps, 2KV, hd]) — the
+    identity stamped at offload and carried host → disk → host."""
+    return zlib.crc32(np.ascontiguousarray(block).tobytes()) & 0xFFFFFFFF
+
+
+def bytes_checksum(payload: bytes) -> int:
+    """CRC-32 of raw payload bytes (the ``.kvblk`` envelope check).  For
+    an array this equals ``block_checksum`` of the same values because
+    the envelope payload IS ``tobytes()`` of the contiguous array."""
+    return zlib.crc32(payload) & 0xFFFFFFFF
+
+
+def payload_block_checksums(k, v) -> List[int]:
+    """Per-block wire checksums over a transfer payload's split K/V
+    arrays ([L, n, ps, KV, hd] each): block i's CRC chains K then V.
+
+    Per-block (not per-payload) so the importer can seal the verified
+    prefix and drop only the corrupt block + its chained descendants —
+    one flipped byte costs one block's recompute, not the whole
+    transfer."""
+    out: List[int] = []
+    for i in range(k.shape[1]):
+        c = zlib.crc32(np.ascontiguousarray(k[:, i]).tobytes())
+        c = zlib.crc32(np.ascontiguousarray(v[:, i]).tobytes(), c)
+        out.append(c & 0xFFFFFFFF)
+    return out
+
+
+def flip_array_byte(arr) -> np.ndarray:
+    """Fault-injection helper (``kv_corrupt``): copy ``arr`` and flip one
+    byte in the middle — a deterministic stand-in for media/DMA rot.  The
+    copy matters: the source buffer (a host-tier entry, a wire view) must
+    stay pristine so the fault models corruption *in flight*."""
+    a = np.ascontiguousarray(arr).copy()
+    flat = a.reshape(-1).view(np.uint8)
+    flat[flat.size // 2] ^= 0xFF
+    return a
+
+
+def flip_blob_byte(blob: bytes, offset: int) -> bytes:
+    """Flip one payload byte of a serialized envelope at/after ``offset``
+    (keeps the header intact so structural validation still passes — the
+    checksum is what must catch it)."""
+    b = bytearray(blob)
+    i = offset + max(0, (len(b) - offset) // 2)
+    i = min(i, len(b) - 1)
+    b[i] ^= 0xFF
+    return bytes(b)
+
+
+class CorruptionCache:
+    """TTL negative cache of checksum-failed block hashes.
+
+    Restore, promotion and cross-worker pull consult it before touching a
+    hash: without it, a corrupt block on a donor (which the donor still
+    holds — we can only drop OUR copies) would be re-pulled and re-fail
+    on every admission of the prefix, and a flaky medium could thrash
+    promote→corrupt→drop loops.  Entries expire after ``ttl_s`` so a
+    healthy copy (new donor, rewritten tier) becomes reachable again —
+    the ban is a thrash guard, not a permanent verdict.
+
+    Bounded (the entry expiring soonest is evicted first) and
+    clock-injectable for deterministic tests.  Mutations take a lock:
+    callers mix the event loop with ``asyncio.to_thread`` contexts
+    (promotion, offload staging), and the bounded-eviction ``min()`` scan
+    iterating a dict another thread mutates would crash the very
+    corruption-handling path that must degrade gracefully.
+    """
+
+    def __init__(
+        self,
+        ttl_s: float = 30.0,
+        max_entries: int = 4096,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.ttl_s = ttl_s
+        self.max_entries = max_entries
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._banned: Dict[int, float] = {}  # hash → ban deadline
+        self.bans_total = 0
+
+    def __len__(self) -> int:
+        return len(self._banned)
+
+    def ban(self, seq_hash: int) -> None:
+        with self._lock:
+            if (
+                len(self._banned) >= self.max_entries
+                and seq_hash not in self._banned
+            ):
+                # Evict the entry expiring soonest; the newest ban is the
+                # one actively guarding a live thrash loop.
+                oldest = min(self._banned, key=self._banned.__getitem__)
+                self._banned.pop(oldest, None)
+            self._banned[seq_hash] = self._clock() + self.ttl_s
+            self.bans_total += 1
+
+    def banned(self, seq_hash: int) -> bool:
+        deadline = self._banned.get(seq_hash)  # GIL-atomic read
+        if deadline is None:
+            return False
+        if self._clock() >= deadline:
+            with self._lock:
+                # Re-check under the lock: a concurrent ban() may have
+                # refreshed the deadline since the read above.
+                if (d := self._banned.get(seq_hash)) is not None and (
+                    self._clock() >= d
+                ):
+                    self._banned.pop(seq_hash, None)
+                return False if d is None else self._clock() < d
+        return True
+
+    def any_banned(self, seq_hashes: Sequence[int]) -> Optional[int]:
+        """First banned hash in ``seq_hashes``, or None."""
+        for h in seq_hashes:
+            if self.banned(h):
+                return h
+        return None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._banned.clear()

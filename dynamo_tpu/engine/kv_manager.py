@@ -218,6 +218,36 @@ class KvBlockManager:
             self._blocks[bid].ref_count = 1
         return bid
 
+    def evict_hashes(self, seq_hashes: Sequence[int]) -> int:
+        """Force-evict specific REUSABLE (ref==0, sealed-hash) blocks as if
+        allocation pressure had recycled them: contents forgotten, the
+        tier-aware Removed/tiered event emitted, the block returned to the
+        anonymous pool.  Deterministic HBM-pressure simulation for chaos /
+        bench harnesses (benchmarks/goodput.py L7 storm) — the real LRU
+        path runs end to end, so event semantics cannot drift from organic
+        eviction.  Active (referenced) blocks are never touched."""
+        n = 0
+        for h in list(seq_hashes):
+            bid = self._by_hash.get(h)
+            if bid is None:
+                continue
+            blk = self._blocks[bid]
+            if blk.ref_count > 0 or bid not in self._free_reusable:
+                continue
+            # Rotate the victim to the LRU head and mask the anonymous
+            # pool (the allocator prefers it); _take_free_block then
+            # evicts exactly this block through the ordinary path.
+            self._free_reusable.move_to_end(bid, last=False)
+            anon, self._free_anon = self._free_anon, []
+            try:
+                got = self._take_free_block()
+            finally:
+                self._free_anon = anon
+            if got is not None:
+                self._free_anon.append(got)
+                n += 1
+        return n
+
     def _take_free_block(self) -> Optional[int]:
         if self._free_anon:
             return self._free_anon.pop()

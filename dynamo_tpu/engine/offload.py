@@ -195,12 +195,27 @@ class HostOffloadMixin:
         an oversized batch rejects early instead of transiently blowing
         the host budget (and evicting the working set for nothing).
         ``stop_on_miss`` stops at the first unavailable hash (prefix
-        restores need a contiguous leading run); prefetch skips instead."""
+        restores need a contiguous leading run); prefetch skips instead.
+
+        Integrity: the envelope checksum verifies inside ``read`` (a
+        corrupt file is a quarantine event — the chain's deeper tier
+        blocks drop with it and the hash is negative-cached), and the
+        carried stamp rides into the host entry so the later host→HBM
+        scatter re-verifies the same identity."""
+        from ..llm.metrics import kv_integrity_metrics
+
         L, _, ps, KV2, hd = self.cache.pages.shape
         shape, dtype = (L, ps, KV2, hd), self.cache.pages.dtype
         staged = 0
         promoted: List[int] = []
         for h in seq_hashes:
+            if self.integrity.banned(h):
+                # Recently corrupt: treat as a miss for the TTL so a
+                # promote→corrupt→drop loop cannot thrash on the hash.
+                kv_integrity_metrics.negative_cache_hits_total += 1
+                if stop_on_miss:
+                    break
+                continue
             if self.host_kv.contains(h):
                 continue
             nbytes = self.disk_kv.block_nbytes(h)
@@ -210,12 +225,24 @@ class HostOffloadMixin:
                 continue
             if not self.host_kv.admit_bytes(staged + nbytes):
                 break  # destination budget exhausted: reject BEFORE copying
-            arr = self.disk_kv.get(h, expected_shape=shape, expected_dtype=dtype)
-            if arr is None:  # corrupt/truncated file: dropped, a miss
+            arr, checksum, corrupt = self.disk_kv.read(
+                h, expected_shape=shape, expected_dtype=dtype
+            )
+            if corrupt:
+                # The file was already dropped by read(); quarantine the
+                # chain (descendants + negative cache) and recompute.
+                self._record_corruption("disk", h, chain=seq_hashes)
+                kv_integrity_metrics.recomputed_total += 1
                 if stop_on_miss:
                     break
                 continue
-            self.host_kv.put(h, arr)
+            if arr is None:
+                if stop_on_miss:
+                    break
+                continue
+            if checksum is not None:
+                kv_integrity_metrics.verified_total["disk"] += 1
+            self.host_kv.put(h, arr, checksum=checksum)
             staged += nbytes
             promoted.append(h)
         if promoted:
@@ -310,14 +337,45 @@ class HostOffloadMixin:
                 True,
             )
             self._emit_promotions(promoted)
+        from ..llm.metrics import kv_integrity_metrics
+        from ..runtime.faultinject import faults
+        from .integrity import block_checksum, flip_array_byte
+
+        chain = [tb.sequence_hash for tb in blocks]
         run: List[Tuple[Any, np.ndarray]] = []
         for tb in blocks[resident:]:
+            if self.integrity.banned(tb.sequence_hash):
+                kv_integrity_metrics.negative_cache_hits_total += 1
+                break  # recently corrupt: a miss; the tail recomputes
             # peek, not get: this is candidate selection (possibly
             # truncated below); touching the LRU here would diverge the
             # leader's eviction order from the followers'.
             host = self.host_kv.peek(tb.sequence_hash)
             if host is None:
                 break
+            if isinstance(host, np.ndarray):
+                # The host→HBM media boundary: verify the offload stamp
+                # BEFORE the scatter (host RAM rots too — ECC is not a
+                # guarantee, and this array may have round-tripped disk).
+                stamp = self.host_kv.checksum(tb.sequence_hash)
+                if (
+                    stamp is not None
+                    and faults.enabled
+                    and faults.should("kv_corrupt", "host")
+                ):
+                    # Chaos hook gated on a present stamp: flipping an
+                    # unstamped (legacy) entry would SCATTER the flip —
+                    # the fault tests detection, not legacy exposure.
+                    host = flip_array_byte(host)
+                if stamp is not None:
+                    if block_checksum(host) != stamp:
+                        self._record_corruption(
+                            "host", tb.sequence_hash, chain=chain
+                        )
+                        self._flush_tier_events()
+                        kv_integrity_metrics.recomputed_total += 1
+                        break  # verified prefix still restores below
+                    kv_integrity_metrics.verified_total["host"] += 1
             run.append((tb, host))
         run = run[: max(0, self.kv.free_blocks - 1)]
         if not run:

@@ -83,6 +83,8 @@ class KvTransferMixin:
             pages = np.asarray(self.cache.pages[:, np.asarray(ids, np.int32)])
         k = pages[:, :, :, 0::2]  # [L, n, page_size, KV, hd]
         v = pages[:, :, :, 1::2]
+        from .integrity import payload_block_checksums
+
         return {
             "n_blocks": len(ids),
             "start_block": start_block,
@@ -93,6 +95,12 @@ class KvTransferMixin:
             # KV under valid hashes).
             "kv_scale": self._kv_scale_repr(),
             "shape": list(k.shape),
+            # Per-block content checksums stamped from the HBM gather (the
+            # source of truth) — the importer verifies before sealing, so
+            # a wire/staging bit-flip costs one block's recompute instead
+            # of fleet-wide poison.  Omit-when-absent on the importer side
+            # keeps checksum-less peers servable.
+            "checksums": payload_block_checksums(k, v),
             "k": np.ascontiguousarray(k).tobytes(),
             "v": np.ascontiguousarray(v).tobytes(),
         }
@@ -102,6 +110,7 @@ class KvTransferMixin:
         token_ids: List[int],
         payload: Dict[str, Any],
         salt: Optional[str] = None,
+        donor: Optional[int] = None,
     ) -> int:
         """Write transferred KV into this engine's cache as sealed blocks.
 
@@ -110,6 +119,15 @@ class KvTransferMixin:
         overlap with the remaining chunks' transfer (match_prefix walks from
         block 0, so chunks are useful as soon as their predecessors landed —
         the sender streams them in order).
+
+        When the payload carries per-block ``checksums`` they are VERIFIED
+        against the parsed arrays before anything is allocated or sealed
+        (the wire integrity boundary — covers cross-worker pull, migration
+        push and disagg import alike): the verified prefix seals, the first
+        corrupt block and everything after it is dropped and the hash
+        negative-cached.  Payloads without checksums (older peers) inject
+        unverified — omit-when-absent wire compat.  ``donor`` attributes a
+        corrupt payload to its sender for the health watchdog's ledger.
 
         Returns the number of tokens covered by this injection.  The blocks
         are immediately released to the reuse pool (contents intact), so the
@@ -191,6 +209,46 @@ class KvTransferMixin:
         except ValueError:
             logger.warning("rejecting KV import: malformed payload arrays")
             return 0
+        from ..runtime.faultinject import faults
+
+        if faults.enabled and faults.should("kv_corrupt", "wire"):
+            # Chaos hook: flip one byte of the staged K payload — models a
+            # wire/staging bit-flip the structural checks cannot see.
+            from .integrity import flip_array_byte
+
+            k = flip_array_byte(k)
+        sums = payload.get("checksums")
+        if sums is not None:
+            # The wire integrity boundary: verify every block BEFORE the
+            # interleave copy (and long before allocation/sealing).  The
+            # verified prefix stays usable; the first corrupt block
+            # truncates the import — its chained descendants are
+            # unreachable without it, so nothing poisoned can ever seal.
+            from ..llm.metrics import kv_integrity_metrics
+            from .integrity import payload_block_checksums
+
+            got = payload_block_checksums(k, v)
+            valid = n
+            for i in range(n):
+                if i >= len(sums) or int(sums[i]) != got[i]:
+                    valid = i
+                    break
+            kv_integrity_metrics.verified_total["wire"] += valid
+            if valid < n:
+                self._record_corruption(
+                    "wire", blocks[valid].sequence_hash, donor=donor
+                )
+                self._flush_tier_events()
+                logger.warning(
+                    "KV import failed checksum at block %d/%d; sealing the "
+                    "verified prefix only", valid, n,
+                )
+                n = valid
+                if n == 0:
+                    return 0
+                blocks = blocks[:n]
+                k = k[:, :n]
+                v = v[:, :n]
         # Interleave back to combined pages [L, n, ps, 2KV, hd] (K even).
         comb = np.stack([k, v], axis=4).reshape(
             k.shape[0], n, k.shape[2], 2 * k.shape[3], k.shape[4]

@@ -232,6 +232,7 @@ class HttpService:
         from ..runtime.health import health_metrics
         from .metrics import (
             engine_dispatch_metrics,
+            kv_integrity_metrics,
             kv_tier_metrics,
             migration_metrics,
             spec_metrics,
@@ -249,6 +250,7 @@ class HttpService:
             + qos_metrics.render(self._metrics_prefix).encode()
             + engine_dispatch_metrics.render(self._metrics_prefix).encode()
             + kv_tier_metrics.render(self._metrics_prefix).encode()
+            + kv_integrity_metrics.render(self._metrics_prefix).encode()
         )
         return web.Response(body=body, content_type="text/plain")
 

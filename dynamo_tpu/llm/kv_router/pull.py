@@ -110,12 +110,30 @@ class PrefixPuller:
             peer_blocks = int(hint.get("blocks", 0))
         except (KeyError, TypeError, ValueError):
             return 0
-        local = self.engine.local_prefix_blocks(token_ids, salt)
+        # Hash the chain ONCE: the local-depth walk and the integrity
+        # negative-cache check below both consume it, and chained hashing
+        # is O(prompt length) on every admission with a peer hint.
+        from ...tokens import hash_token_blocks
+
+        chain = hash_token_blocks(token_ids, self.engine.cfg.block_size, salt)
+        local = self.engine.local_prefix_blocks(token_ids, salt, blocks=chain)
         if peer_blocks <= local:
             return 0  # local tiers already reach (or beat) the peer
         block_bytes = max(1, self.engine.block_nbytes())
         budget_blocks = max(0, int(self.max_bytes) // block_bytes)
         want = min(peer_blocks - local, budget_blocks)
+        # Integrity negative cache: a recently checksum-failed hash in the
+        # wanted delta means a pull would re-ship and re-fail the same
+        # poison (the donor still HOLDS its corrupt copy — we can only
+        # drop ours); recompute locally until the TTL expires.
+        delta = chain[local : local + max(want, 0)]
+        if self.engine.integrity.any_banned(
+            [tb.sequence_hash for tb in delta]
+        ) is not None:
+            from ..metrics import kv_integrity_metrics
+
+            kv_integrity_metrics.negative_cache_hits_total += 1
+            return 0
         # Count the attempt BEFORE any bail-out so failed can never
         # exceed started (dashboards derive success rate from the pair).
         kv_tier_metrics.pulls_started_total += 1
@@ -137,7 +155,12 @@ class PrefixPuller:
             if not payload:
                 kv_tier_metrics.pulls_failed_total += 1
                 return 0
-            covered = await self.engine.inject_blocks(token_ids, payload, salt)
+            # donor=peer: a checksum-failed payload is attributed to its
+            # sender in the health ledger (runtime/health.py) — repeated
+            # poison from one donor feeds the watchdog's quarantine path.
+            covered = await self.engine.inject_blocks(
+                token_ids, payload, salt, donor=peer
+            )
         except asyncio.CancelledError:
             raise
         except Exception:  # noqa: BLE001 — degraded mode: prefill locally

@@ -755,6 +755,95 @@ class KvTierMetrics:
 
 kv_tier_metrics = KvTierMetrics()
 
+# The integrity plane's verification boundaries (engine/integrity.py):
+# ``disk`` = .kvblk envelope reads, ``host`` = host-tier entries verified
+# before the HBM scatter (plus demotion-time re-verification), ``wire`` =
+# transfer-plane payloads (cross-worker pull, migration push, disagg
+# import) verified before sealing.
+INTEGRITY_PLANES = ("disk", "host", "wire")
+
+
+class KvIntegrityMetrics:
+    """KV integrity-plane counters (docs/kv_tiering.md §integrity):
+    per-plane verified/corrupt, plus the quarantine machinery's activity
+    — negative-cache hits, chained-descendant drops, recompute fallbacks,
+    and corruption-attributed worker quarantines.  Module-level singleton
+    rendered as Prometheus text and appended to ``/metrics``."""
+
+    def __init__(self):
+        self.verified_total: Dict[str, int] = {p: 0 for p in INTEGRITY_PLANES}
+        self.corrupt_total: Dict[str, int] = {p: 0 for p in INTEGRITY_PLANES}
+        # blocks dropped from the tiers because their chain passes through
+        # a corrupt block (the corrupt block itself is not counted here)
+        self.descendants_dropped_total = 0
+        # restore/promotion/pull attempts skipped on a negative-cached hash
+        self.negative_cache_hits_total = 0
+        # corruption events that degraded a live request to recompute
+        # (the disagg degraded-mode shape — never a drop, never a wrong token)
+        self.recomputed_total = 0
+        # watchdog quarantines attributed to repeated KV corruption
+        self.quarantined_total = 0
+
+    def reset(self) -> None:
+        self.__init__()
+
+    def corrupt_sum(self) -> int:
+        return sum(self.corrupt_total.values())
+
+    def verified_sum(self) -> int:
+        return sum(self.verified_total.values())
+
+    def snapshot(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for p in INTEGRITY_PLANES:
+            out[f"verified_{p}_total"] = float(self.verified_total[p])
+            out[f"corrupt_{p}_total"] = float(self.corrupt_total[p])
+        out["descendants_dropped_total"] = float(self.descendants_dropped_total)
+        out["negative_cache_hits_total"] = float(self.negative_cache_hits_total)
+        out["recomputed_total"] = float(self.recomputed_total)
+        out["quarantined_total"] = float(self.quarantined_total)
+        return out
+
+    def render(self, prefix: str = "dynamo_tpu") -> str:
+        ns = f"{prefix}_kv_integrity"
+        lines = []
+
+        def per_plane(name: str, help_: str, values: Dict[str, int]) -> None:
+            lines.append(f"# HELP {ns}_{name} {help_}")
+            lines.append(f"# TYPE {ns}_{name} counter")
+            for p in INTEGRITY_PLANES:  # bounded constant label set
+                lines.append(
+                    f'{ns}_{name}{{plane="{escape_label(p)}"}} {values[p]}'
+                )
+
+        def emit(name: str, help_: str, value: int) -> None:
+            lines.append(f"# HELP {ns}_{name} {help_}")
+            lines.append(f"# TYPE {ns}_{name} counter")
+            lines.append(f"{ns}_{name} {value}")
+
+        per_plane("verified_total",
+                  "KV blocks whose checksum verified at this plane's boundary",
+                  self.verified_total)
+        per_plane("corrupt_total",
+                  "KV blocks that FAILED checksum verification at this plane",
+                  self.corrupt_total)
+        emit("descendants_dropped_total",
+             "Tier blocks dropped because their chain passes through a "
+             "corrupt block", self.descendants_dropped_total)
+        emit("negative_cache_hits_total",
+             "Restore/promotion/pull attempts skipped on a negative-cached "
+             "(recently corrupt) hash", self.negative_cache_hits_total)
+        emit("recomputed_total",
+             "Corruption events degraded to local recompute (streams stay "
+             "byte-identical)", self.recomputed_total)
+        emit("quarantined_total",
+             "Worker quarantines attributed to repeated KV corruption",
+             self.quarantined_total)
+        return "\n".join(lines) + "\n"
+
+
+kv_integrity_metrics = KvIntegrityMetrics()
+
 
 class InflightGuard:
     """Tracks one request: inflight gauge, duration, TTFT, ITL, final status.

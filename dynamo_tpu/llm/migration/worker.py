@@ -318,12 +318,21 @@ class MigratableWorker(AsyncEngine):
             if not resp.get("ok"):
                 raise MigrationTargetError(resp.get("error", "blocks refused"))
             n = int(payload["n_blocks"])
-            sent += n
-            metrics.blocks_total += n
+            # The target reports what actually SEALED: integrity
+            # verification (engine/integrity.py) may have truncated the
+            # import at a corrupt block, and advancing the cursor past
+            # unsealed blocks would leave a hole the target's prefix match
+            # can never cross.  The next round re-exports from the
+            # verified frontier (a fresh HBM gather — transient wire
+            # corruption heals; persistent corruption ends the copy phase
+            # and the target recomputes the tail as a prefix miss).
+            got = min(n, int(resp.get("tokens_covered", n * bs)) // bs)
+            sent += got
+            metrics.blocks_total += got
             metrics.bytes_total += len(payload.get("k", b"")) + len(
                 payload.get("v", b"")
             )
-            if n < self.chunk_blocks:
+            if got < n or n < self.chunk_blocks:
                 return sent
 
     async def _send(

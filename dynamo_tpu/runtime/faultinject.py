@@ -32,12 +32,26 @@ point              hooked in                                  simulates
                    (``delay_s`` = rate multiplier; a seeded   one tenant
                    flood trace replays over the fault's       floods the fleet
                    scheduled window)
+``kv_corrupt``     per-plane KV integrity boundaries          KV payload
+                   (``match`` names the plane): ``disk`` =    bit-rot on the
+                   ``DiskKvStore.read`` post-OS-read flip,    named medium /
+                   ``host`` = ``_restore_pass`` pre-scatter   boundary; the
+                   flip, ``wire`` = ``inject_blocks``         checksum plane
+                   post-parse flip (covers pull, migration    must detect it
+                   push, disagg import)                       before scatter
 =================  =========================================  ==============
 
 ``tenant_flood`` is a *traffic* fault, not a transport one: the armed level
 is read by the overload-rung trace driver as the flooding tenant's rate
 multiplier, and the system under test is the QoS plane (scheduler WFQ,
 edge quotas — llm/qos.py), whose job is to keep the OTHER tenants whole.
+
+``kv_corrupt`` is a *data* fault: it flips one payload byte after the
+structural checks' vantage point, and the system under test is the KV
+integrity plane (engine/integrity.py) — detection before any scatter,
+descendant drop + negative cache, byte-identical recompute fallback.
+Arm per plane (``kv_corrupt:disk``, ``kv_corrupt:host``,
+``kv_corrupt:wire``) or ``kv_corrupt`` for all three.
 
 Arming: programmatic (``faults.arm("connect_error", match=addr, count=2)``)
 or env-driven for subprocess workers — ``DYN_FAULTS`` is a comma-separated

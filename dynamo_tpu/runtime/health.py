@@ -136,6 +136,65 @@ worker_latency = WorkerLatencyTracker()
 
 
 # --------------------------------------------------------------------------
+# KV corruption ledger (the integrity plane's watchdog feed)
+# --------------------------------------------------------------------------
+
+
+class KvCorruptionLedger:
+    """Sliding-window count of checksum-failed KV payloads per source
+    worker (engine/integrity.py; docs/kv_tiering.md §integrity).
+
+    Fed by ``inject_blocks(donor=...)`` when a pulled/transferred payload
+    fails verification, and by engines whose OWN tiers detect rot (via
+    ``set_integrity_reporter`` wiring).  The watchdog folds the counts
+    into its scan: one flipped byte is weather, but a donor (or a local
+    medium) that keeps shipping poison is a sick worker — every pull from
+    it costs a detection + recompute, so it gets the same quarantine →
+    drain → eject path as a prober failure.  Counts age out of the
+    ``window_s`` horizon so a healed worker reinstates."""
+
+    def __init__(self, window_s: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.window_s = window_s
+        self._clock = clock
+        self._events: Dict[int, deque] = {}
+
+    def record(self, worker_id: int, n: int = 1) -> None:
+        if worker_id is None:
+            return
+        q = self._events.setdefault(worker_id, deque(maxlen=256))
+        now = self._clock()
+        for _ in range(n):
+            q.append(now)
+
+    def count(self, worker_id: int) -> int:
+        q = self._events.get(worker_id)
+        if not q:
+            return 0
+        horizon = self._clock() - self.window_s
+        while q and q[0] < horizon:
+            q.popleft()
+        if not q:
+            self._events.pop(worker_id, None)
+            return 0
+        return len(q)
+
+    def counts(self) -> Dict[int, int]:
+        return {
+            wid: c for wid in list(self._events)
+            if (c := self.count(wid)) > 0
+        }
+
+    def reset(self) -> None:
+        self._events.clear()
+
+
+# Process-global ledger: engines record into it (transfer/offload paths),
+# the watchdog scans it each tick.
+kv_corruption = KvCorruptionLedger()
+
+
+# --------------------------------------------------------------------------
 # Probing
 # --------------------------------------------------------------------------
 
@@ -190,6 +249,10 @@ class HealthConfig:
     straggler_min_ms: float = 50.0
     straggler_min_samples: int = 5
     straggler_streak: int = 2
+    # KV-corruption quarantine bar: checksum-failed payloads attributed to
+    # one worker within the ledger window (``kv_corruption``) before it is
+    # quarantined — one flip is weather, a streak is a sick medium/donor
+    corrupt_after: int = 3
     # quarantine → eject grace (drain budget); recovery within it reinstates
     eject_grace_s: float = 5.0
     # eject = delete the worker's instance registrations (permanent until
@@ -207,7 +270,7 @@ class HealthConfig:
             if cfg.get(f) is not None:
                 kw[f] = float(cfg[f])
         for f in ("quarantine_after", "straggler_min_samples",
-                  "straggler_streak"):
+                  "straggler_streak", "corrupt_after"):
             if cfg.get(f) is not None:
                 kw[f] = int(cfg[f])
         if cfg.get("eject") is not None:
@@ -242,6 +305,7 @@ class HealthMetrics:
         self.drains_total = 0
         self.drained_sequences_total = 0
         self.ejections_total = 0
+        self.corruption_quarantines_total = 0
         self.state_counts: Dict[str, int] = {}
 
     def reset(self) -> None:
@@ -271,6 +335,9 @@ class HealthMetrics:
                 self.drained_sequences_total)
         counter("ejections_total", "Workers ejected from the fleet",
                 self.ejections_total)
+        counter("corruption_quarantines_total",
+                "Quarantines attributed to repeated KV corruption",
+                self.corruption_quarantines_total)
         lines.append(f"# HELP {ns}_workers Worker count by health state")
         lines.append(f"# TYPE {ns}_workers gauge")
         for state in ("healthy", "quarantined", "ejected"):
@@ -417,21 +484,39 @@ class HealthWatchdog:
                 health_metrics.probe_failures_total += 1
         # Straggler scan: each worker's p50 vs the fleet median.
         self._scan_stragglers()
+        # KV-corruption ledger scan (engine/integrity.py feeds it through
+        # inject_blocks donor attribution + local-tier reporters): repeated
+        # checksum failures attributed to one worker inside the ledger
+        # window quarantine it like a probe-failure streak would.
+        corrupt_counts = kv_corruption.counts()
         # State transitions + actions.
         now = self._clock()
         for rec in list(self.workers.values()):
+            poisoning = (
+                corrupt_counts.get(rec.worker_id, 0) >= cfg.corrupt_after
+            )
             if rec.state == "healthy":
                 sick = rec.fail_streak >= cfg.quarantine_after
                 slow = rec.straggler_streak >= cfg.straggler_streak
-                if sick or slow:
-                    rec.reason = (
-                        f"probe_failures={rec.fail_streak}" if sick
-                        else "latency_outlier"
-                    )
+                if sick or slow or poisoning:
+                    if sick:
+                        rec.reason = f"probe_failures={rec.fail_streak}"
+                    elif slow:
+                        rec.reason = "latency_outlier"
+                    else:
+                        rec.reason = (
+                            f"kv_corruption={corrupt_counts[rec.worker_id]}"
+                        )
+                        health_metrics.corruption_quarantines_total += 1
+                        from ..llm.metrics import kv_integrity_metrics
+
+                        kv_integrity_metrics.quarantined_total += 1
                     await self._quarantine(rec, now)
             elif rec.state == "quarantined":
                 recovered = (
-                    rec.fail_streak == 0 and rec.straggler_streak == 0
+                    rec.fail_streak == 0
+                    and rec.straggler_streak == 0
+                    and not poisoning  # ledger entries age out of the window
                 )
                 if recovered:
                     await self._reinstate(rec)

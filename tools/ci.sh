@@ -50,6 +50,12 @@ echo "   cross-worker pull exactness) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_kv_tiering.py -q -m tiering \
   -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== kv-integrity suite (checksummed blocks on every tier + wire plane:"
+echo "   corruption plane matrix, descendant drop, negative cache,"
+echo "   byte-identical recompute, donor quarantine) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_kv_integrity.py -q -m integrity \
+  -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== prefix-reuse smoke (BENCH_PREFIX=1: tiers off/host/disk/pull;"
 echo "   bars: >=90% prefill skipped on 2nd occurrence, pull serves a"
 echo "   never-computed prefix, byte-identical streams, stable compiles) =="
@@ -105,10 +111,12 @@ print(f"churn smoke ok: kernel={r['decode_kernel']} "
       f"retired={r['continuous_retired']} host_gap={g}")
 PYEOF
 
-echo "== chaos ladder L0-L2 + L5 respawn + L6 overload (seeded goodput"
-echo "   smoke; bars: 0 dropped, byte-identity incl. unseeded streams,"
-echo "   respawn on L5, non-flooding tenants >= 0.9x isolated on L6) =="
-env JAX_PLATFORMS=cpu python benchmarks/goodput.py --levels 0,1,2,5,6 \
+echo "== chaos ladder L0-L2 + L5 respawn + L6 overload + L7 corruption"
+echo "   storm (seeded goodput smoke; bars: 0 dropped, byte-identity incl."
+echo "   unseeded streams, respawn on L5, non-flooding tenants >= 0.9x"
+echo "   isolated on L6, every injected kv_corrupt flip detected before"
+echo "   scatter on L7) =="
+env JAX_PLATFORMS=cpu python benchmarks/goodput.py --levels 0,1,2,5,6,7 \
   --seed 7 --duration 5 --rate 2.5 --check --json /tmp/_goodput_smoke.json
 
 echo "== tier-1 tests =="

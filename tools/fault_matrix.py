@@ -370,6 +370,89 @@ async def sweep_engine() -> list:
     return rows
 
 
+async def sweep_integrity() -> list:
+    """``kv_corrupt`` per plane against the real integrity boundaries
+    (engine/integrity.py; docs/kv_tiering.md §integrity) — store-level, so
+    the sweep needs no engine build and runs in every matrix:
+
+    - ``disk``: the ARMED fault flips a payload byte inside
+      ``DiskKvStore.read`` (the real hook site); the envelope checksum
+      must turn it into a recorded miss, never an array.
+    - ``host``: a host-tier entry is bit-flipped in RAM; the offload
+      stamp (``HostKvStore.checksum``) must disagree — the check
+      ``_restore_pass`` runs before every scatter.
+    - ``wire``: a transfer payload's K bytes are flipped; the per-block
+      ``payload_block_checksums`` must localize the corrupt block — the
+      check ``inject_blocks`` runs before sealing.
+
+    The engine-level consequences (descendant drop, negative cache,
+    byte-identical recompute, donor quarantine) are gated by
+    tests/test_kv_integrity.py and the goodput L7 rung."""
+    import tempfile
+
+    import numpy as np
+
+    from dynamo_tpu.engine.disk_cache import DiskKvStore
+    from dynamo_tpu.engine.host_cache import HostKvStore
+    from dynamo_tpu.engine.integrity import (
+        block_checksum,
+        flip_array_byte,
+        payload_block_checksums,
+    )
+
+    rows = []
+    blk = np.arange(2 * 4 * 4 * 8, dtype=np.float32).reshape(2, 4, 4, 8)
+    with tempfile.TemporaryDirectory() as d:
+        store = DiskKvStore(1 << 20, d)
+        assert store.put(7, blk, checksum=block_checksum(blk))
+        faults.arm("kv_corrupt", match="disk", count=1)
+        arr, _chk, corrupt = store.read(7)
+        faults.reset()
+        dropped = not store.contains(7)
+        rows.append({
+            "fault": "kv_corrupt disk",
+            "injected_at": "DiskKvStore.read (payload byte flipped after "
+                           "the OS read; armed fault point)",
+            "observed": (
+                "envelope checksum caught the flip, file deleted + loss "
+                "recorded" if arr is None and corrupt and dropped
+                else "UNEXPECTED: corrupt payload survived validation"
+            ),
+            "status": "tier miss -> recompute",
+        })
+    host = HostKvStore(1 << 20)
+    host.put(5, blk.copy())
+    entry = host.peek(5)
+    flipped = flip_array_byte(entry)
+    caught = block_checksum(flipped) != host.checksum(5)
+    rows.append({
+        "fault": "kv_corrupt host",
+        "injected_at": "host tier entry (bit flipped in RAM; "
+                       "_restore_pass verifies before every scatter)",
+        "observed": ("offload stamp disagreed with the flipped bytes"
+                     if caught else "UNEXPECTED: flip not detected"),
+        "status": "tier drop -> recompute",
+    })
+    k = blk.reshape(2, 1, 4, 4, 8).repeat(3, axis=1).copy()
+    v = k + 1.0
+    sums = payload_block_checksums(k, v)
+    sums2 = payload_block_checksums(flip_array_byte(k), v)
+    bad = [i for i in range(3) if sums[i] != sums2[i]]
+    rows.append({
+        "fault": "kv_corrupt wire",
+        "injected_at": "transfer payload K bytes (inject_blocks verifies "
+                       "per block before sealing; covers pull + migration "
+                       "push + disagg import)",
+        "observed": (
+            f"per-block checksums localized the flip to block {bad[0]} "
+            "(verified prefix still seals)" if len(bad) == 1
+            else "UNEXPECTED: flip not localized"
+        ),
+        "status": "truncated import -> recompute",
+    })
+    return rows
+
+
 async def sweep_http() -> list:
     """HTTP-edge behaviours: admission shed + deadline + no instances."""
     from aiohttp import ClientSession
@@ -467,7 +550,8 @@ async def main() -> int:
                     help="include the kv_pressure sweep (builds a real engine)")
     args = ap.parse_args()
 
-    rows = await sweep_runtime() + await sweep_chaos() + await sweep_http()
+    rows = (await sweep_runtime() + await sweep_chaos() + await sweep_http()
+            + await sweep_integrity())
     if args.engine:
         rows += await sweep_engine()
     md = to_markdown(rows)
