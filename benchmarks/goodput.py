@@ -572,22 +572,29 @@ async def prewarm_engine(engine, seed: int = 0) -> None:
 async def _one_request(
     client, i: int, isl: int, osl: int, seed: int,
     prompt_i: Optional[int] = None,
+    trace_ctx=None,
 ) -> Outcome:
     from dynamo_tpu.runtime.engine import Context
 
     out = Outcome(i=i, tenant=_tenant_for(i))
     tokens: List[int] = []
     t0 = time.monotonic()
+    t0_perf = time.perf_counter()
     last = None
     try:
         # FIXED request id: unseeded requests derive their engine-resolved
         # seed from it (crc32(id) ^ engine seed), so the same (ladder seed,
         # i) replays byte-identically on any worker and across rungs.
-        stream = await client.generate(
-            Context.with_id(
-                _request_dict(i, isl, osl, seed, prompt_i), f"g{seed}-{i}"
-            )
-        )
+        req = _request_dict(i, isl, osl, seed, prompt_i)
+        ctx = Context.with_id(req, f"g{seed}-{i}")
+        if trace_ctx is not None:
+            # L0 trace stamping (docs/tracing.md): annotations.trace rides
+            # to the engine (queue/prefill/decode spans) and ctx.trace lets
+            # the routed client record its route/failover spans — the
+            # ladder's cross-runtime assembly is scored in run_rung.
+            req["annotations"]["trace"] = trace_ctx.to_dict()
+            ctx.ctx.trace = trace_ctx
+        stream = await client.generate(ctx)
         async for item in stream:
             now = time.monotonic()
             got = item.get("token_ids") or ()
@@ -609,6 +616,16 @@ async def _one_request(
     out.token_hash = hashlib.sha256(
         json.dumps(tokens).encode()
     ).hexdigest()[:16]
+    if trace_ctx is not None:
+        # The driver IS this harness's edge: its root span anchors the
+        # aggregator's assembly (and the TTFT decomposition window).
+        from dynamo_tpu.runtime.tracing import collector as _trace_collector
+
+        _trace_collector.record(
+            trace_ctx, "driver.request", "driver",
+            t0_perf, time.perf_counter(),
+            attrs={"request": i}, parent_id=None,
+        )
     return out
 
 
@@ -813,6 +830,38 @@ async def _drive_corruption(
     return outcomes
 
 
+async def _score_tracing(trace_agg, trace_exporter, trace_ctxs) -> Dict[str, Any]:
+    """The L0 rung's ``tracing`` block: a stamped trace counts as ASSEMBLED
+    once the aggregator holds its driver root span plus an ENGINE span —
+    i.e. the worker-side instrumentation recorded under the same trace_id
+    and the batch crossed the hub event plane.  (driver/client spans are
+    recorded by the driving process itself, so they alone prove nothing
+    about the worker side.)  ``--check`` bars assembled == sampled."""
+    await trace_exporter.flush()
+    want = {i: tc.trace_id for i, tc in trace_ctxs.items()}
+
+    def _assembled(tid: str) -> bool:
+        t = trace_agg.get(tid)
+        if t is None:
+            return False
+        comps = set(t["components"])
+        return "driver" in comps and "engine" in comps
+
+    # Subscription delivery is asynchronous: give late batches a moment.
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if all(_assembled(tid) for tid in want.values()):
+            break
+        await asyncio.sleep(0.1)
+        await trace_exporter.flush()
+    assembled = sum(1 for tid in want.values() if _assembled(tid))
+    return {
+        "sampled": len(want),
+        "assembled": assembled,
+        "orphan_spans": trace_agg.orphan_spans_total,
+    }
+
+
 async def run_rung(
     engines: List[Any],
     rung: Dict[str, Any],
@@ -860,6 +909,36 @@ async def run_rung(
     ).start()
     if rung.get("supervise"):
         await fleet.start_supervisor()
+    # L0 trace stamping (docs/tracing.md): every 5th seeded request carries
+    # a forced TraceContext; span batches publish on the hub's ``traces``
+    # subject (the REAL cross-runtime plane) and an aggregator subscribed
+    # through the client runtime scores assembly in the rung report.
+    trace_agg = trace_exporter = None
+    trace_ctxs: Dict[int, Any] = {}
+    if rung["level"] == 0:
+        from dynamo_tpu.llm.trace_service import TraceAggregator
+        from dynamo_tpu.runtime.tracing import (
+            TRACES_TOPIC,
+            SpanExporter,
+            TraceContext,
+        )
+
+        tns = fleet.client_rt.namespace(NAMESPACE)
+        trace_agg = await TraceAggregator().start(tns)
+
+        async def _publish_spans(payload):
+            await tns.publish(TRACES_TOPIC, payload)
+
+        trace_exporter = await SpanExporter(
+            [_publish_spans], interval_s=0.1
+        ).start()
+        # i % 5 == 0 requests are all SEEDED (unseeded ids are i % 5 == 2),
+        # so the stamp set is exactly "every 5th seeded request".
+        trace_ctxs = {
+            i: TraceContext.new()
+            for i in range(len(trace))
+            if i % UNSEEDED_EVERY == 0
+        }
     t_start = time.monotonic()
     armed: List[Any] = []
     fault_tasks = [
@@ -877,6 +956,7 @@ async def run_rung(
             )
         )
     corrupt_events = [ev for ev in rung["events"] if ev.kind == "kv_corrupt"]
+    tracing_block = None
     storm_task = None
     if corrupt_events:
         storm_task = asyncio.ensure_future(
@@ -892,10 +972,17 @@ async def run_rung(
                 await asyncio.sleep(delay)
             req_tasks.append(
                 asyncio.ensure_future(
-                    _one_request(fleet.client, i, arrival.isl, arrival.osl, seed)
+                    _one_request(
+                        fleet.client, i, arrival.isl, arrival.osl, seed,
+                        trace_ctx=trace_ctxs.get(i),
+                    )
                 )
             )
         outcomes = list(await asyncio.gather(*req_tasks))
+        if trace_agg is not None:
+            tracing_block = await _score_tracing(
+                trace_agg, trace_exporter, trace_ctxs
+            )
         if flood_task is not None:
             # The flood's streams are admitted work too: they count against
             # the 0-dropped bar (and are reported under their own tenant).
@@ -912,6 +999,10 @@ async def run_rung(
             flood_task.cancel()
         if storm_task is not None:
             storm_task.cancel()
+        if trace_exporter is not None:
+            await trace_exporter.stop(final_flush=False)
+        if trace_agg is not None:
+            await trace_agg.stop()
         faults.reset()
         await fleet.close()
     # -- scoring ------------------------------------------------------------
@@ -982,6 +1073,8 @@ async def run_rung(
             "dropped": len(dropped),
         },
     }
+    if tracing_block is not None:
+        report["tracing"] = tracing_block
     if corrupt_events:
         # The L7 bars: every armed kv_corrupt firing is one injected flip,
         # and the integrity plane's corrupt counters advance exactly once
@@ -1025,6 +1118,16 @@ def check_report(
     l0 = rungs[0]
     if l0["completed"] == 0:
         problems.append("L0 completed no requests")
+    tracing = l0.get("tracing")
+    if tracing is not None and tracing["assembled"] != tracing["sampled"]:
+        # Cross-runtime span assembly over the hub event plane is a
+        # correctness surface of the tracing subsystem (docs/tracing.md):
+        # every stamped trace must assemble at the aggregator.
+        problems.append(
+            f"L0: {tracing['sampled'] - tracing['assembled']} of "
+            f"{tracing['sampled']} stamped trace(s) failed to assemble "
+            f"(orphan_spans={tracing['orphan_spans']})"
+        )
     control = {o[0]: o[3] for o in l0["deterministic"]["outcomes"] if o[1] == "ok"}
     for level, rung in sorted(rungs.items()):
         if rung["dropped"] != 0:

@@ -50,6 +50,9 @@ class RequestResult:
     tokens: int = 0
     wall_s: float = 0.0
     error: Optional[str] = None
+    # x-trace-id response header when the request forced tracing
+    # (--trace-report); the key for the post-run /traces/{id} fetch.
+    trace_id: Optional[str] = None
 
 
 def _pct(xs: List[float], p: float) -> float:
@@ -65,17 +68,20 @@ def _prompt_tokens(i: int, isl: int, vocab: int) -> List[int]:
 
 
 async def _one(session: ClientSession, url: str, model: str, prompt: List[int],
-               osl: int, adapter: str = None, schema: dict = None) -> RequestResult:
+               osl: int, adapter: str = None, schema: dict = None,
+               trace: bool = False) -> RequestResult:
     # Multi-tenant replay (llm/tenancy): an ``adapter`` trace field routes
     # the request to that served model name (LoRA); a ``schema`` field adds
     # an OpenAI response_format constraint (grammar-masked decoding).
+    # ``trace`` forces distributed tracing (nvext.trace — docs/tracing.md);
+    # the x-trace-id response header keys the post-run /traces fetch.
     payload = {
         "model": adapter or model,
         "prompt": prompt,
         "stream": True,
         "max_tokens": osl,
         "temperature": 0.0,
-        "nvext": {"ignore_eos": True},
+        "nvext": {"ignore_eos": True, **({"trace": True} if trace else {})},
     }
     if schema is not None:
         payload["response_format"] = {
@@ -92,6 +98,7 @@ async def _one(session: ClientSession, url: str, model: str, prompt: List[int],
             if resp.status != 200:
                 body = (await resp.text())[:200]
                 return RequestResult(0, error=f"HTTP {resp.status}: {body}")
+            trace_id = resp.headers.get("x-trace-id")
             buf = b""
             done = False
             async for raw in resp.content:
@@ -133,11 +140,84 @@ async def _one(session: ClientSession, url: str, model: str, prompt: List[int],
         raise
     except Exception as e:  # connection errors count as failures, not crashes
         return RequestResult(0, error=f"{type(e).__name__}: {e}")
-    return RequestResult(ttft, itls, ntok, time.perf_counter() - t0)
+    return RequestResult(ttft, itls, ntok, time.perf_counter() - t0,
+                         trace_id=trace_id)
+
+
+# ------------------------------------------------------- trace-report mode
+# Every Nth request forces distributed tracing; the post-run /traces fetch
+# decomposes TTFT per hop (docs/tracing.md TTFT_HOPS order).
+TRACE_EVERY = 5
+
+
+async def _trace_report(url: str, results: List[RequestResult],
+                        session: ClientSession) -> dict:
+    """Fetch each traced request's assembled timeline from /traces/{id} and
+    roll per-hop TTFT decomposition percentiles — the artifact the v5e
+    carry-over runs need (edge-queue / preprocess / route / prefill-or-pull
+    / first-decode, docs/tracing.md)."""
+    ids = [r.trace_id for r in results if r.trace_id]
+    per_hop: dict = {}
+    ttfts: List[float] = []
+    unattributed: List[float] = []
+    # Concurrent fetch under ONE shared deadline: fetches are independent,
+    # and per-id sequential retries would stall a large sweep for minutes
+    # when traces fail to assemble (errored requests, expired TTL).
+    deadline = time.perf_counter() + 10.0
+
+    async def fetch(tid):
+        rollup = None
+        while True:
+            try:
+                async with session.get(f"{url}/traces/{tid}") as resp:
+                    if resp.status == 200:
+                        rollup = (await resp.json()).get("rollup") or {}
+                        if rollup.get("ttft_ms") is not None:
+                            return rollup
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+            # Export interval + hub hop: retry briefly for late batches.
+            if time.perf_counter() >= deadline:
+                return rollup
+            await asyncio.sleep(0.25)
+
+    rollups = await asyncio.gather(*[fetch(tid) for tid in ids])
+    assembled = 0
+    for rollup in rollups:
+        if rollup is None:
+            continue
+        assembled += 1
+        for hop, dur in (rollup.get("hops") or {}).items():
+            per_hop.setdefault(hop, []).append(dur / 1e3)
+        if rollup.get("ttft_ms") is not None:
+            ttfts.append(rollup["ttft_ms"] / 1e3)
+            unattributed.append(rollup.get("unattributed_ms", 0.0) / 1e3)
+    report = {
+        "requested": len(ids),
+        "assembled": assembled,
+        "hops": {
+            hop: {
+                "n": len(xs),
+                "p50_ms": round(_pct(xs, 0.5) * 1e3, 2),
+                "p95_ms": round(_pct(xs, 0.95) * 1e3, 2),
+            }
+            for hop, xs in sorted(per_hop.items())
+        },
+    }
+    if ttfts:
+        report["ttft_p50_ms"] = round(_pct(ttfts, 0.5) * 1e3, 2)
+        report["ttft_p95_ms"] = round(_pct(ttfts, 0.95) * 1e3, 2)
+        report["unattributed_p95_ms"] = round(
+            _pct(unattributed, 0.95) * 1e3, 2
+        )
+    return report
 
 
 async def _sweep_level(url: str, model: str, conc: int, n_requests: int,
-                       isl: int, osl: int, vocab: int) -> dict:
+                       isl: int, osl: int, vocab: int,
+                       trace_every: int = 0) -> dict:
     queue: asyncio.Queue = asyncio.Queue()
     for i in range(n_requests):
         queue.put_nowait(i)
@@ -150,14 +230,20 @@ async def _sweep_level(url: str, model: str, conc: int, n_requests: int,
             except asyncio.QueueEmpty:
                 return
             indexed.append(
-                (i, await _one(session, url, model, _prompt_tokens(i, isl, vocab), osl))
+                (i, await _one(session, url, model, _prompt_tokens(i, isl, vocab), osl,
+                               trace=bool(trace_every) and i % trace_every == 0))
             )
 
     timeout = ClientTimeout(total=3600, sock_read=600)
     t0 = time.perf_counter()
+    trace_rep = None
     async with ClientSession(timeout=timeout) as session:
         await asyncio.gather(*[worker(session) for _ in range(conc)])
-    wall = time.perf_counter() - t0
+        wall = time.perf_counter() - t0
+        if trace_every:
+            trace_rep = await _trace_report(
+                url, [r for _, r in indexed], session
+            )
 
     results = [r for _, r in sorted(indexed)]  # start order
     ok = [r for r in results if r.error is None]
@@ -184,6 +270,8 @@ async def _sweep_level(url: str, model: str, conc: int, n_requests: int,
         # raw data (r4's table/artifact divergence + unexplained ~8s
         # outliers; VERDICT r4 weak #1).
         "ttfts_ms": [round(r.ttft_s * 1e3, 1) for r in results if r.error is None],
+        # --trace-report: per-hop TTFT decomposition (docs/tracing.md).
+        **({"trace_report": trace_rep} if trace_rep is not None else {}),
     }
 
 
@@ -255,7 +343,8 @@ async def _session_sweep(url: str, model: str, args, vocab: int) -> dict:
 
 
 # ------------------------------------------------------------- trace mode
-async def _run_trace(url: str, model: str, arrivals, vocab: int) -> dict:
+async def _run_trace(url: str, model: str, arrivals, vocab: int,
+                     trace_every: int = 0) -> dict:
     """Open-loop replay: request i fires at its trace timestamp (late
     arrivals fire immediately), unlike the closed-loop concurrency sweep."""
     indexed: List[tuple] = []
@@ -270,12 +359,18 @@ async def _run_trace(url: str, model: str, arrivals, vocab: int) -> dict:
             (i, await _one(session, url, model,
                            _prompt_tokens(i, a.isl, vocab), a.osl,
                            adapter=getattr(a, "adapter", None),
-                           schema=getattr(a, "schema", None)))
+                           schema=getattr(a, "schema", None),
+                           trace=bool(trace_every) and i % trace_every == 0))
         )
 
+    trace_rep = None
     async with ClientSession(timeout=timeout) as session:
         await asyncio.gather(*[fire(i, a, session) for i, a in enumerate(arrivals)])
-    wall = time.perf_counter() - t0
+        wall = time.perf_counter() - t0
+        if trace_every:
+            trace_rep = await _trace_report(
+                url, [r for _, r in indexed], session
+            )
 
     results = [r for _, r in sorted(indexed)]
     ok = [r for r in results if r.error is None]
@@ -298,6 +393,7 @@ async def _run_trace(url: str, model: str, arrivals, vocab: int) -> dict:
         "itl_p95_ms": round(_pct(all_itls, 0.95) * 1e3, 2),
         "itl_p99_ms": round(_pct(all_itls, 0.99) * 1e3, 2),
         "ttfts_ms": [round(r.ttft_s * 1e3, 1) for r in results if r.error is None],
+        **({"trace_report": trace_rep} if trace_rep is not None else {}),
     }
 
 
@@ -410,7 +506,23 @@ async def _self_host(args):
     pipeline = build_pipeline(
         [OpenAIPreprocessor(tokenizer, "bench"), Backend(tokenizer)], engine
     )
-    service = HttpService(host="127.0.0.1", port=args.port)
+    tracing = aggregator = None
+    if getattr(args, "trace_report", False):
+        # Colocated span plane (docs/tracing.md): sampler at the edge,
+        # exporter feeding the aggregator directly, /traces served by the
+        # same HttpService the load hits.  Only --trace-report pays for it.
+        from dynamo_tpu.llm.trace_service import TraceAggregator
+        from dynamo_tpu.runtime.tracing import (
+            SpanExporter,
+            TraceSampler,
+            TracingConfig,
+        )
+
+        tracing = TraceSampler(TracingConfig())
+        aggregator = TraceAggregator()
+        args._trace_exporter = await SpanExporter([aggregator]).start()
+    service = HttpService(host="127.0.0.1", port=args.port,
+                          tracing=tracing, trace_aggregator=aggregator)
     service.models.add_completion_model("bench", pipeline)
     service.models.add_chat_model("bench", pipeline)
     await service.start()
@@ -448,6 +560,14 @@ async def main() -> None:
     ap.add_argument("--trace-seed", type=int, default=0, dest="trace_seed")
     ap.add_argument("--spike-mult", type=float, default=3.0, dest="spike_mult",
                     help="burst/ramp peak multiplier over --trace-rate")
+    # Per-hop TTFT decomposition from distributed traces (docs/tracing.md):
+    # every 5th request forces nvext.trace; after the run the assembled
+    # timelines are fetched from /traces/{id} and rolled into per-hop
+    # percentiles in the results JSON ("trace_report" key).
+    ap.add_argument("--trace-report", action="store_true", dest="trace_report",
+                    help="sample distributed traces and emit the per-hop "
+                    "TTFT decomposition (edge-queue / preprocess / route / "
+                    "prefill-or-pull / first-decode) in the results JSON")
     # Shared-prefix multi-turn session mode (docs/kv_tiering.md): every
     # session shares one system prompt; each turn extends its history —
     # the tiered-KV / cross-worker-pull reuse workload.
@@ -478,6 +598,17 @@ async def main() -> None:
     if url is None:
         engine, service, url, vocab = await _self_host(args)
 
+    async def _teardown():
+        exporter = getattr(args, "_trace_exporter", None)
+        if exporter is not None:
+            await exporter.stop()
+        if service is not None:
+            await service.close()
+        if engine is not None:
+            await engine.close()
+
+    trace_every = TRACE_EVERY if args.trace_report else 0
+
     if args.sessions > 0:
         try:
             print(
@@ -492,10 +623,7 @@ async def main() -> None:
                 with open(args.out, "w") as f:
                     json.dump({"mode": "sessions", "rows": [row]}, f, indent=1)
         finally:
-            if service is not None:
-                await service.close()
-            if engine is not None:
-                await engine.close()
+            await _teardown()
         return
 
     if trace_mode:
@@ -505,16 +633,14 @@ async def main() -> None:
                 f"{arrivals[-1].t:.1f}s" if arrivals else "loadgen: empty trace",
                 file=sys.stderr,
             )
-            row = await _run_trace(url, args.model, arrivals, vocab)
+            row = await _run_trace(url, args.model, arrivals, vocab,
+                                   trace_every=trace_every)
             print(json.dumps(row), flush=True)
             if args.out:
                 with open(args.out, "w") as f:
                     json.dump({"mode": "trace", "rows": [row]}, f, indent=1)
         finally:
-            if service is not None:
-                await service.close()
-            if engine is not None:
-                await engine.close()
+            await _teardown()
         return
 
     levels = [int(c) for c in args.conc.split(",")]
@@ -529,7 +655,8 @@ async def main() -> None:
                 engine.scheduler.admission_waits.clear()
                 compiles_before = engine.compile_counts()
             row = await _sweep_level(url, args.model, conc, n, args.isl,
-                                     args.osl, vocab)
+                                     args.osl, vocab,
+                                     trace_every=trace_every)
             if engine is not None:
                 # A first-hit XLA compile inside a timed level would show up
                 # as a multi-second TTFT outlier (suspected cause of the r4
@@ -565,10 +692,7 @@ async def main() -> None:
                     file=sys.stderr,
                 )
     finally:
-        if service is not None:
-            await service.close()
-        if engine is not None:
-            await engine.close()
+        await _teardown()
 
     hdr = ("| conc | reqs | ok | tok/s | req/s | TTFT p50 | TTFT p99 "
            "| ITL p50 | ITL p99 |")

@@ -86,6 +86,21 @@ async def _run_hub(args) -> None:
     await _wait_forever()
 
 
+def _edge_tracing():
+    """Edge-side tracing surfaces (runtime/tracing.py, docs/tracing.md):
+    the TraceSampler (head + forced + tail-keep sampling decisions) and a
+    TraceAggregator serving /traces.  Returns (sampler, aggregator, cfg)
+    — (None, None, cfg) when the ``tracing`` config section disables the
+    plane, which removes every per-request cost at the edge."""
+    from .llm.trace_service import TraceAggregator
+    from .runtime.tracing import TraceSampler, TracingConfig
+
+    cfg = TracingConfig.from_config(RuntimeConfig.from_layers().tracing)
+    if not cfg.enabled:
+        return None, None, cfg
+    return TraceSampler(cfg), TraceAggregator(ttl_s=cfg.ttl_s), cfg
+
+
 def _edge_qos(args):
     """QosController for the HTTP edge from the layered ``qos`` config
     section under explicit --qos-*/--brownout flags (llm/qos.py).  Returns
@@ -119,6 +134,7 @@ async def _run_http_frontend(args) -> None:
     res = RuntimeConfig.from_layers().resilience
     raw_inflight = res.get("http_max_inflight")
     qos_ctl = _edge_qos(args)
+    sampler, aggregator, tracing_cfg = _edge_tracing()
     service = HttpService(
         host=args.host,
         port=args.port,
@@ -143,6 +159,8 @@ async def _run_http_frontend(args) -> None:
             else res.get("request_deadline_s")
         ),
         qos=qos_ctl,
+        tracing=sampler,
+        trace_aggregator=aggregator,
     )
     mode = RouterMode(getattr(args, "router", "round_robin"))
     watcher = await ModelWatcher(runtime, service.models, router_mode=mode).start()
@@ -155,10 +173,28 @@ async def _run_http_frontend(args) -> None:
     slo_pub = await EdgeSloPublisher(
         runtime.namespace(ns), service.metrics, qos=qos_ctl
     ).start()
+    exporter = None
+    if aggregator is not None:
+        # Span plane (docs/tracing.md): workers publish span batches on the
+        # namespace's ``traces`` subject — the aggregator subscribes and
+        # assembles them with the edge's own spans (client.route, the
+        # edge.request root), which export straight into it in-process.
+        from .runtime.tracing import SpanExporter
+
+        await aggregator.start(runtime.namespace(ns))
+        exporter = await SpanExporter(
+            [aggregator],
+            interval_s=tracing_cfg.export_interval_s,
+            proc="edge",
+        ).start()
     print(f"OpenAI frontend on http://{service.host}:{service.port}", flush=True)
     try:
         await _wait_forever()
     finally:
+        if exporter is not None:
+            await exporter.stop()
+        if aggregator is not None:
+            await aggregator.stop()
         await slo_pub.stop()
         await watcher.stop()
         await service.close()
@@ -272,9 +308,21 @@ async def _run(args) -> None:
             from .llm.metrics import kv_tier_metrics
 
             kv_tier_metrics.set_source(engine.kv_tier_summary)
+        # Colocated tracing (docs/tracing.md): edge and engine share this
+        # process, so the exporter feeds the aggregator directly — no hub
+        # hop; /traces serves assembled timelines immediately.
+        sampler, aggregator, _tcfg = _edge_tracing()
+        exporter = None
+        if aggregator is not None:
+            from .runtime.tracing import SpanExporter
+
+            exporter = await SpanExporter(
+                [aggregator], interval_s=_tcfg.export_interval_s
+            ).start()
         service = HttpService(
             host=args.host, port=args.port,
             qos=_edge_qos(args), kv_usage_fn=kv_usage_fn,
+            tracing=sampler, trace_aggregator=aggregator,
         )
         pipeline = _console_pipeline()
         service.models.add_chat_model(args.model, pipeline)
@@ -306,7 +354,11 @@ async def _run(args) -> None:
             + f" on http://{args.host}:{args.port}",
             flush=True,
         )
-        await service.run()
+        try:
+            await service.run()
+        finally:
+            if exporter is not None:
+                await exporter.stop()
     elif inp == "none":
         # Start the engine with no input surface (reference Input::None,
         # opt.rs:40-43: externally-coordinated deployments — here, e.g., a
@@ -349,6 +401,35 @@ async def _run(args) -> None:
         runtime = await DistributedRuntime.connect(args.hub)
         ns, comp, ep = parse_endpoint_path(inp)
         endpoint = runtime.namespace(ns).component(comp).endpoint(ep)
+        # Span plane (docs/tracing.md): ONE exporter per worker process —
+        # the process-global collector holds every role's spans (engine
+        # queue/prefill/decode, disagg, migration, kv donor), and batches
+        # publish on the namespace's ``traces`` subject for the edge-side
+        # aggregator.  Nothing to drain when tracing is disabled or no
+        # request is sampled; the hub client re-arms publishes across hub
+        # restarts like every other publisher.
+        from .runtime.tracing import TRACES_TOPIC, SpanExporter, TracingConfig
+
+        trace_exporter = None
+        tcfg = TracingConfig.from_config(RuntimeConfig.from_layers().tracing)
+        if tcfg.enabled:
+            # Honor ``tracing.ring`` here too: workers are the span-heaviest
+            # processes (decode chunks), and only the edge's TraceSampler
+            # otherwise applies the capacity.
+            from .runtime.tracing import collector as trace_collector
+
+            if tcfg.ring != trace_collector._ring.maxlen:
+                trace_collector.set_capacity(tcfg.ring)
+            namespace = runtime.namespace(ns)
+
+            async def _publish_spans(payload):
+                await namespace.publish(TRACES_TOPIC, payload)
+
+            trace_exporter = await SpanExporter(
+                [_publish_spans],
+                interval_s=tcfg.export_interval_s,
+                proc=f"worker-{runtime.worker_id}",
+            ).start()
         roles = WorkerRoles(args, runtime, endpoint, engine, _tokenizer_spec(args))
         if role == "prefill":
             await roles.start_prefill()
@@ -392,6 +473,10 @@ async def _run(args) -> None:
             if flipper is not None:
                 await flipper.stop()
             await roles.shutdown()
+            if trace_exporter is not None:
+                # Final flush ships the last spans before the hub client
+                # closes (best-effort: a dead hub just counts an error).
+                await trace_exporter.stop()
             await runtime.close()
     else:
         raise SystemExit(f"unknown in= input: {inp!r}")

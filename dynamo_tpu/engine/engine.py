@@ -242,6 +242,13 @@ class TpuEngine(
         )
         self.decode_stalls = 0  # fetches that exceeded the threshold
         self.last_stall: Optional[Dict[str, Any]] = None
+        # Injectable pace hook: awaited before every device-op await
+        # (_await_device) when set.  None (the default) is a single attr
+        # check — zero hot-path cost.  Tests use it to throttle decode
+        # deterministically (e.g. so a migration's copy loop provably
+        # outpaces the sequence on slow containers) instead of racing
+        # wall-clock sleeps.
+        self.pace_hook: Optional[Callable[[], Any]] = None
         # Multi-tenancy (llm/tenancy): LoRA adapter registry (None = LoRA
         # disabled), optional served-model allowlist (unknown names →
         # ModelNotFoundError → 404 at the edge), and the deserialized
@@ -1000,6 +1007,16 @@ class TpuEngine(
         # below hashes with it, so a tenant request can only ever see —
         # and seal — blocks under its own chain.
         salt = pre.annotations.get("kv_salt") or None
+        # Distributed tracing (runtime/tracing.py): the context arrives via
+        # annotations.trace (preprocessor / disagg item / migration resume)
+        # or the service-transport header (request.ctx.trace); None keeps
+        # every instrumentation point below a single attr check.
+        from ..runtime.tracing import parse_trace as _parse_trace
+        from ..runtime.tracing import span as _trace_span
+
+        trace = _parse_trace(pre.annotations.get("trace")) or getattr(
+            request.ctx, "trace", None
+        )
         self._ensure_loop()
         prepared = 0
         if self.host_kv is not None and (
@@ -1014,9 +1031,11 @@ class TpuEngine(
             from ..llm.metrics import kv_tier_metrics
 
             t0 = time.perf_counter()
-            restored = await self._restore_from_host(
-                list(pre.token_ids), salt
-            )
+            with _trace_span(trace, "engine.kv_restore", "engine") as rs:
+                restored = await self._restore_from_host(
+                    list(pre.token_ids), salt
+                )
+                rs.set(restored_tokens=restored)
             prepared += restored
             if restored:
                 kv_tier_metrics.restore_latency_ms.observe(
@@ -1032,9 +1051,13 @@ class TpuEngine(
             # plane instead of recomputing prefill.  Bounded by the
             # configured byte/latency budgets; ANY failure degrades to
             # local prefill (the disagg degraded-mode shape).
-            prepared += await self._prefix_puller.pull(
-                list(pre.token_ids), salt, pre.annotations["kv_pull"]
-            )
+            with _trace_span(trace, "engine.kv_pull", "engine") as ps:
+                pulled = await self._prefix_puller.pull(
+                    list(pre.token_ids), salt, pre.annotations["kv_pull"],
+                    trace=trace,
+                )
+                ps.set(pulled_tokens=pulled)
+            prepared += pulled
         if (
             self._sp_fn is not None
             and len(pre.token_ids) >= self.cfg.sp_prefill_min
@@ -1051,6 +1074,12 @@ class TpuEngine(
             # plane (the reference's disagg split, docs/architecture.md).
             prepared += await self._sp_prefill(list(pre.token_ids))
         seq = SequenceState.from_request(request.id, pre, self.cfg)
+        if trace is not None:
+            from ..runtime.tracing import SeqTrace
+
+            # Anchors queue-wait (scheduler._record_admission) and prefill
+            # (first-token accept, pipeline._trace_first_token) spans.
+            seq.trace = SeqTrace(trace)
         if automaton is not None:
             seq.grammar = automaton
             # Resumed sequences (llm/migration splice, seeded crash

@@ -121,6 +121,10 @@ class MigrationMixin:
             tenant=seq.tenant or None,
             priority=seq.priority or None,
             grammar=seq.grammar.to_dict() if seq.grammar is not None else None,
+            # Tracing continuity (runtime/tracing.py): only the CONTEXT
+            # travels — the target opens its own spans under the same
+            # trace_id; source-side anchors stay source-local.
+            trace=seq.trace.ctx.to_dict() if seq.trace is not None else None,
         )
 
     async def freeze_sequence(

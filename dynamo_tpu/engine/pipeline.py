@@ -327,6 +327,10 @@ class DecodePipelineMixin:
         dispatch-order invariants).  Dispatch awaits are covered too: a
         wedge can just as well surface one await earlier, blocking the
         ``to_thread(run)`` handoff with no fetch outstanding."""
+        if self.pace_hook is not None:
+            # Injectable test pace (engine.py): deterministic decode
+            # throttling without wall-clock sleeps in the tests themselves.
+            await self.pace_hook()
         thr = self._stall_threshold_s
         if thr <= 0:
             return await task
@@ -803,11 +807,13 @@ class DecodePipelineMixin:
                     self._device_task(run), "decode_dispatch", n_active
                 )
             carry = new_carry
-            wall = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            wall = t1 - t0
             self.decode_busy_s += wall  # unbounded host-gap accounting
             self.step_trace.append(
                 ("decode_dispatch", wall, n_active, n_active * T)
             )
+            self._trace_decode_chunk(slots.active(), t0, t1, T)
             # Start the D2H copy NOW: it proceeds in the background while
             # later chunks compute, so the wait below pays ~zero round trip
             # instead of compute + full link latency.
@@ -1025,9 +1031,9 @@ class DecodePipelineMixin:
             outs, carry = await self._await_device(
                 self._device_task(run), "burst_dispatch", n
             )
-        self.step_trace.append(
-            ("decode_burst", time.perf_counter() - t0, n, n * T)
-        )
+        t1 = time.perf_counter()
+        self.step_trace.append(("decode_burst", t1 - t0, n, n * T))
+        self._trace_decode_chunk(enumerate(members), t0, t1, T)
         self._stash_fetch("burst", outs, need_lp, members, pos0, chain)
         if not chain:
             return True
@@ -1059,9 +1065,9 @@ class DecodePipelineMixin:
             outs_b = await self._await_device(
                 self._device_task(run_b), "burst_dispatch", n
             )
-        self.step_trace.append(
-            ("decode_burst", time.perf_counter() - t0, n, n * T)
-        )
+        t1 = time.perf_counter()
+        self.step_trace.append(("decode_burst", t1 - t0, n, n * T))
+        self._trace_decode_chunk(enumerate(members), t0, t1, T)
         self._stash_fetch("burst", outs_b, need_lp, members, pos0b, False)
         return True
 
@@ -1135,6 +1141,12 @@ class DecodePipelineMixin:
             n_cap = min(T, len(seq.block_ids) * bs - p0)
             if n_cap <= 0:
                 continue  # beyond allocation: tokens were never KV-backed
+            if seq.trace is not None:
+                # Normally latched by the "first" harvest path; belt for a
+                # traced row whose first token rides a fused chunk.  AFTER
+                # the n_cap guard: a row that accepts zero tokens from this
+                # chunk has not produced its first token yet.
+                self._trace_first_token(seq)
             col = np.asarray(sampled[:, i])
             # LENGTH cutoff: the token that reaches the budget is accepted
             # (and emitted) with finish_reason length, exactly as
@@ -1237,6 +1249,49 @@ class DecodePipelineMixin:
             ],
         }
 
+    def _trace_first_token(self, seq: SequenceState) -> None:
+        """First output token of a traced sequence: record the
+        ``engine.prefill`` span (admission → first token — chunked prompt
+        compute plus the first sampled fetch) with a ``first_token`` event,
+        the TTFT decomposition's engine-side anchor.  One latch per
+        sequence; untraced rows cost a single attr check."""
+        st = seq.trace
+        if st is None or st.first_done:
+            return
+        st.first_done = True
+        from ..runtime.tracing import _wall_ms
+        from ..runtime.tracing import collector as trace_collector
+
+        now = time.perf_counter()
+        trace_collector.record(
+            st.ctx, "engine.prefill", "engine",
+            st.t_admit or st.t_enqueue, now,
+            attrs={
+                "prompt_tokens": len(seq.prompt),
+                "cached_tokens": seq.num_cached_prompt,
+            },
+            events=[{"name": "first_token", "t_ms": round(_wall_ms(now), 3)}],
+        )
+
+    def _trace_decode_chunk(self, rows, t0: float, t1: float, steps: int) -> None:
+        """One ``engine.decode_chunk`` span per TRACED row per fused
+        dispatch — the ISSUE 15 granularity contract: decode records at
+        chunk (dispatch) granularity only, never per token.  Untraced rows
+        cost one attr check per chunk; rows whose first token hasn't
+        landed yet are skipped (their wall belongs to engine.prefill)."""
+        for _i, seq in rows:
+            if seq is None:
+                continue
+            st = seq.trace
+            if st is None or not st.first_done:
+                continue
+            from ..runtime.tracing import collector as trace_collector
+
+            trace_collector.record(
+                st.ctx, "engine.decode_chunk", "engine", t0, t1,
+                attrs={"steps": steps},
+            )
+
     def _accept_token(
         self,
         seq: SequenceState,
@@ -1244,6 +1299,8 @@ class DecodePipelineMixin:
         defer_removal: bool = False,
         logprobs: Optional[Dict[str, Any]] = None,
     ) -> None:
+        if seq.trace is not None:
+            self._trace_first_token(seq)
         seq.output.append(token)
         reason = self._check_stop(seq, token)
         # Grammar advance (llm/tenancy): the automaton state moves per

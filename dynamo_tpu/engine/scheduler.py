@@ -135,6 +135,13 @@ class SequenceState:
     # shed first under brownout).  Threaded from nvext.priority via
     # PreprocessedRequest.priority.
     priority: str = INTERACTIVE
+    # --- distributed tracing (runtime/tracing.py) ---
+    # SeqTrace (context + timing anchors + first-token latch) for sampled
+    # requests, parsed from ``annotations.trace`` at engine admission; None
+    # = untraced (the zero-cost path — every engine instrumentation point
+    # is behind this check).  The CONTEXT travels in the migration snapshot
+    # (SequenceSnapshot.trace) so a migrated stream stays one trace.
+    trace: Any = None
 
     def __post_init__(self) -> None:
         if self.orig_prompt_len == 0:
@@ -517,6 +524,25 @@ class Scheduler:
         seq.enqueue_t = time.perf_counter()
         self.waiting.append(seq)
 
+    def _record_admission(self, seq: SequenceState) -> None:
+        """Shared admission bookkeeping: the queue→admission latency sample
+        plus, for traced requests, the ``engine.queue_wait`` span — the
+        dominant TTFT-tail term at saturation (a newcomer waiting out a
+        fused pure-decode session) finally attributable per request."""
+        now = time.perf_counter()
+        if seq.enqueue_t:
+            self.admission_waits.append(now - seq.enqueue_t)
+        st = seq.trace
+        if st is not None:
+            from ..runtime.tracing import collector as trace_collector
+
+            st.t_admit = now
+            trace_collector.record(
+                st.ctx, "engine.queue_wait", "engine",
+                seq.enqueue_t or now, now,
+                attrs={"request_id": seq.request_id},
+            )
+
     def remove(self, seq: SequenceState) -> None:
         """Drop a sequence (finished or cancelled) and release its blocks."""
         if seq in self.running:
@@ -645,8 +671,7 @@ class Scheduler:
                 break
             self.waiting.popleft()
             self.running.append(seq)
-            if seq.enqueue_t:
-                self.admission_waits.append(time.perf_counter() - seq.enqueue_t)
+            self._record_admission(seq)
             # Admission always leaves >= 1 prompt token to compute (a fully
             # cached prompt still recomputes its last token for logits).
             chunk = min(budget, len(seq.prompt) - seq.num_computed)
@@ -740,8 +765,7 @@ class Scheduler:
                 break
             self.waiting.popleft()
             self.running.append(seq)
-            if seq.enqueue_t:
-                self.admission_waits.append(time.perf_counter() - seq.enqueue_t)
+            self._record_admission(seq)
             admitted.append(seq)
             limit -= 1
         return admitted

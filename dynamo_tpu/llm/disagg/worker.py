@@ -31,6 +31,7 @@ from typing import Any, AsyncIterator, Dict, Optional
 
 from ...runtime.client import Client
 from ...runtime.engine import AsyncEngine, Context, ResponseStream
+from ...runtime.tracing import parse_trace, span as trace_span
 from ..protocols import PreprocessedRequest
 from .prefill_queue import PrefillQueue
 from .router import DisaggregatedRouter
@@ -98,9 +99,15 @@ class DisaggDecodeWorker(AsyncEngine):
         tokens = data["token_ids"]
         # Tenant transfers (llm/tenancy) seal under the tenant's salted hash
         # chain — same identity the prefill engine sealed them under.
-        covered = await self.engine.inject_blocks(
-            tokens, data["payload"], data.get("salt")
-        )
+        # ``data["trace"]`` (omit-when-absent) joins the import to the
+        # request's trace — the decode-side half of the transfer.
+        with trace_span(
+            parse_trace(data.get("trace")), "disagg.kv_import", "disagg"
+        ) as ispan:
+            covered = await self.engine.inject_blocks(
+                tokens, data["payload"], data.get("salt")
+            )
+            ispan.set(tokens_covered=covered)
         self._covered[data["transfer_id"]] = (
             self._covered.get(data["transfer_id"], 0) + covered
         )
@@ -192,6 +199,14 @@ class DisaggDecodeWorker(AsyncEngine):
         _metrics.degraded_prefills_total += 1
 
     async def _remote_prefill(self, tokens, deadline=None, annotations=None) -> None:
+        # Tracing (runtime/tracing.py): the queue item's annotations carry
+        # the trace, so the prefill worker's engine spans — and its
+        # transfer span — join the request's trace; this side records the
+        # decode worker's WAIT (the remote-prefill share of TTFT).
+        wspan = trace_span(
+            parse_trace((annotations or {}).get("trace")),
+            "disagg.remote_prefill_wait", "disagg",
+        )
         transfer_id = uuid.uuid4().hex
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[transfer_id] = fut
@@ -215,6 +230,7 @@ class DisaggDecodeWorker(AsyncEngine):
             self._pending.pop(transfer_id, None)
             logger.warning("prefill enqueue failed; degrading to local prefill")
             self._degrade()
+            wspan.set(degraded="enqueue_failed").finish()
             return
         # The transfer wait never outlives the request's deadline: leave a
         # margin so local prefill still has budget to run after fallback.
@@ -226,6 +242,7 @@ class DisaggDecodeWorker(AsyncEngine):
             covered = await asyncio.wait_for(fut, timeout)
             self.remote_prefills += 1
             self.transfer_ms.append((time.perf_counter() - t0) * 1e3)
+            wspan.set(tokens_covered=covered)
             logger.info("remote prefill covered %d tokens", covered)
         except asyncio.TimeoutError:
             # Fall back to local prefill; a late transfer still lands as a
@@ -234,6 +251,14 @@ class DisaggDecodeWorker(AsyncEngine):
             self._covered.pop(transfer_id, None)  # orphaned chunk counts
             logger.warning("remote prefill timed out; prefilling locally")
             self._degrade()
+            wspan.set(degraded="timeout")
+        except BaseException as e:
+            # Cancellation / future failed with an unexpected error: record
+            # the wait span rather than leaking it unrecorded.
+            wspan.set(error=type(e).__name__)
+            raise
+        finally:
+            wspan.finish()
 
 
 class PrefillWorkerLoop:
@@ -365,6 +390,11 @@ class PrefillWorkerLoop:
         # seals under the same salted hash chain (addressable transfer).
         annotations = dict(item.get("annotations") or {})
         salt = annotations.get("kv_salt")
+        # Tracing: annotations.trace rides into the engine request below
+        # (its prefill spans join the originating request's trace); this
+        # side additionally records the block transfer back to the decode
+        # worker.
+        tc = parse_trace(annotations.get("trace"))
         pre = PreprocessedRequest(token_ids=list(tokens), annotations=annotations)
         pre.stop_conditions.max_tokens = 1
         pre.stop_conditions.ignore_eos = True
@@ -377,9 +407,13 @@ class PrefillWorkerLoop:
 
         worker = self.direct.get(reply["address"])
         if worker is not None:
-            covered = await worker.transfer_direct(
-                item["transfer_id"], tokens, self.engine, salt=salt
-            )
+            with trace_span(
+                tc, "disagg.prefill_transfer", "disagg-prefill",
+                attrs={"direct": True},
+            ):
+                covered = await worker.transfer_direct(
+                    item["transfer_id"], tokens, self.engine, salt=salt
+                )
             if covered == 0:
                 raise RuntimeError("direct transfer moved no blocks")
             self.direct_transfers += 1
@@ -389,6 +423,10 @@ class PrefillWorkerLoop:
         dest = reply["address"]
         total_blocks = len(tokens) // self.engine.cfg.block_size
         start = 0
+        tspan = trace_span(
+            tc, "disagg.prefill_transfer", "disagg-prefill",
+            attrs={"dest": dest},
+        )
         while True:
             chunk = self.chunk_for(dest)
             payload = await self.engine.export_prompt_blocks(
@@ -410,6 +448,11 @@ class PrefillWorkerLoop:
                             "payload": {"n_blocks": 0},
                             "last": True,
                             **({"salt": salt} if salt else {}),
+                            **(
+                                {"trace": tc.to_dict()}
+                                if tc is not None
+                                else {}
+                            ),
                         }
                     )
                 )
@@ -427,6 +470,7 @@ class PrefillWorkerLoop:
                         "payload": payload,
                         "last": last,
                         **({"salt": salt} if salt else {}),
+                        **({"trace": tc.to_dict()} if tc is not None else {}),
                     }
                 )
             )
@@ -437,6 +481,7 @@ class PrefillWorkerLoop:
             )
             if last:
                 break
+        tspan.set(blocks=start).finish()
 
     def _client_for(self, address: str, path: str) -> Client:
         key = f"{address}/{path}"

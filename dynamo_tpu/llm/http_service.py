@@ -47,8 +47,29 @@ from .qos import (
     resolve_tenant,
 )
 from .tenancy.lora import AdapterCapacityError
+from .trace_service import EdgeRequestTrace
 
 logger = logging.getLogger(__name__)
+
+
+class _TracedGuard:
+    """Metrics InflightGuard wrapper that mirrors token/finish callbacks to
+    the request's EdgeRequestTrace — one wrapper covers every status path
+    in the handlers without touching them individually."""
+
+    __slots__ = ("_guard", "_ert")
+
+    def __init__(self, guard, ert: EdgeRequestTrace):
+        self._guard = guard
+        self._ert = ert
+
+    def on_token(self, *args, **kwargs) -> None:
+        self._ert.on_first_token()
+        self._guard.on_token(*args, **kwargs)
+
+    def finish(self, status) -> None:
+        self._guard.finish(status)
+        self._ert.finish(str(status))
 
 
 class ModelManager:
@@ -103,6 +124,8 @@ class HttpService:
         default_deadline_s: Optional[float] = None,
         qos: Optional[QosController] = None,
         kv_usage_fn=None,
+        tracing=None,
+        trace_aggregator=None,
     ):
         self.host = host
         self.port = port
@@ -129,6 +152,13 @@ class HttpService:
         # Per-request wall-clock budget (None = unbounded, the previous
         # behaviour); exhaustion maps to 504 below.
         self.default_deadline_s = default_deadline_s
+        # Distributed request tracing (runtime/tracing.py): ``tracing`` is
+        # a TraceSampler (None = edge never samples, zero cost);
+        # ``trace_aggregator`` serves assembled traces at /traces (wired by
+        # the CLI — a hub subscription for routed fleets, a direct exporter
+        # sink when the engine is colocated).
+        self.tracing = tracing
+        self.trace_aggregator = trace_aggregator
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self._chat_completions)
         self.app.router.add_post("/v1/completions", self._completions)
@@ -136,6 +166,8 @@ class HttpService:
         self.app.router.add_get("/metrics", self._metrics)
         self.app.router.add_get("/health", self._health)
         self.app.router.add_get("/live", self._health)
+        self.app.router.add_get("/traces", self._traces_recent)
+        self.app.router.add_get("/traces/{trace_id}", self._trace_get)
         self._runner: Optional[web.AppRunner] = None
 
     # -- lifecycle ----------------------------------------------------------
@@ -239,9 +271,12 @@ class HttpService:
             tenancy_metrics,
         )
 
+        from ..runtime.tracing import tracing_metrics
+
         body = (
             self.metrics.render()
             + resilience_metrics.render(self._metrics_prefix).encode()
+            + tracing_metrics.render(self._metrics_prefix).encode()
             + planner_metrics.render(self._metrics_prefix).encode()
             + spec_metrics.render(self._metrics_prefix).encode()
             + migration_metrics.render(self._metrics_prefix).encode()
@@ -253,6 +288,26 @@ class HttpService:
             + kv_integrity_metrics.render(self._metrics_prefix).encode()
         )
         return web.Response(body=body, content_type="text/plain")
+
+    async def _traces_recent(self, request: web.Request) -> web.Response:
+        """``/traces?recent=N``: the aggregator's most recent assemblies."""
+        if self.trace_aggregator is None:
+            return _error_response(404, "tracing aggregator not configured")
+        try:
+            n = int(request.query.get("recent", 20))
+        except (TypeError, ValueError):
+            n = 20
+        return web.json_response({"traces": self.trace_aggregator.recent(n)})
+
+    async def _trace_get(self, request: web.Request) -> web.Response:
+        """``/traces/{id}``: one assembled trace + its per-hop rollup."""
+        if self.trace_aggregator is None:
+            return _error_response(404, "tracing aggregator not configured")
+        tid = request.match_info["trace_id"]
+        trace = self.trace_aggregator.get(tid)
+        if trace is None:
+            return _error_response(404, f"trace {tid!r} not assembled here")
+        return web.json_response(trace)
 
     async def _list_models(self, request: web.Request) -> web.Response:
         now = int(time.time())
@@ -300,6 +355,12 @@ class HttpService:
         # split the rejected series from the success path's raw labels.
         model_label = bounded_label(model)
 
+        # Tracing (runtime/tracing.py): the sampling decision is made once
+        # here — forced (x-trace / nvext.trace) beats the head rate — and
+        # the handle shadows the request even when unsampled so tail-keep
+        # can promote an error/SLO-violating request's edge spans later.
+        ert = EdgeRequestTrace(self.tracing, request.headers, body)
+
         # QoS (llm/qos.py): resolve tenant + priority, charge the tenant's
         # quota, apply the brownout rung — all BEFORE a slot is consumed.
         priority = resolve_priority(request.headers, body)
@@ -319,6 +380,7 @@ class HttpService:
                 self.metrics.requests_total.labels(
                     model_label, endpoint, "stream", Status.REJECTED
                 ).inc()
+                ert.finish(Status.REJECTED, model=model, endpoint=endpoint)
                 return _error_response(
                     503,
                     "server in brownout (interactive overflow)",
@@ -337,6 +399,7 @@ class HttpService:
                 self.metrics.requests_total.labels(
                     model_label, endpoint, "stream", Status.REJECTED
                 ).inc()
+                ert.finish(Status.REJECTED, model=model, endpoint=endpoint)
                 return _error_response(
                     e.status, e.message, retry_after_s=e.retry_after_s
                 )
@@ -345,6 +408,10 @@ class HttpService:
                 qos_metrics.capped_requests_total += 1
             if rung >= RUNG_SPEC_STANDDOWN:
                 qos_metrics.spec_standdowns_total += 1
+            if rung and ert.active:
+                # Brownout rewrites are invisible in the response body —
+                # record WHICH rung shaped this request on its trace.
+                ert.event("brownout_rewrite", rung=rung)
             body = self.qos.shape(body)
             if tenant != model:
                 # Thread the RESOLVED identity to the scheduler's WFQ
@@ -373,6 +440,7 @@ class HttpService:
         # Admission control guards everything that costs engine work; cheap
         # 400/404s above never consume a slot.  Batch-class requests only
         # queue in their reserved fraction (resilience.AdmissionController).
+        ert.admission_started()
         try:
             await self.admission.acquire(priority)
         except AdmissionRejected as e:
@@ -383,6 +451,7 @@ class HttpService:
             self.metrics.requests_total.labels(
                 model_label, endpoint, "stream", Status.REJECTED
             ).inc()
+            ert.finish(Status.REJECTED, model=model, endpoint=endpoint)
             # The drain-rate estimate says when a slot frees; a deepening
             # brownout says the estimate is optimistic — back clients off
             # harder the further down the ladder the edge already is.
@@ -390,10 +459,23 @@ class HttpService:
             if self.qos is not None and self.qos.rung:
                 retry *= 1 + self.qos.rung
             return _error_response(e.status, e.message, retry_after_s=retry)
+        except BaseException:
+            # Handler cancelled (client gone) or failed while QUEUED: the
+            # admission wait it died in is exactly the datum the trace
+            # exists to capture — record before propagating.
+            ert.finish(Status.ERROR, model=model, endpoint=endpoint)
+            raise
+        ert.admission_done()
         try:
-            return await self._admitted_openai(request, body, engine, model, endpoint)
+            return await self._admitted_openai(
+                request, body, engine, model, endpoint, ert
+            )
         finally:
             self.admission.release()
+            # Belt for paths no guard.finish covered (handler cancellation,
+            # unexpected escapes): finish is idempotent, so completed
+            # requests — already closed by _TracedGuard — are untouched.
+            ert.finish(Status.ERROR, model=model, endpoint=endpoint)
 
     async def _admitted_openai(
         self,
@@ -402,9 +484,17 @@ class HttpService:
         engine: AsyncEngine,
         model: str,
         endpoint: str,
+        ert: EdgeRequestTrace,
     ) -> web.StreamResponse:
         stream_mode = bool(body.get("stream", False))
         guard = self.metrics.guard(model, endpoint, "stream" if stream_mode else "unary")
+        # The caller made the ONE sampling decision for this request; a
+        # second EdgeRequestTrace here would mint a new trace id and
+        # double-count the sampler metrics.
+        ert.model, ert.endpoint = model, endpoint
+        # Every guard.finish path (success, error, client drop) also closes
+        # the edge trace — one wrapper instead of N call sites.
+        guard = _TracedGuard(guard, ert)
         # Request-id correlation (reference: context id propagated in
         # headers): a caller-supplied x-request-id becomes the PREFIX of the
         # engine context id (logs, recorder streams, KV events), uniquified
@@ -424,6 +514,11 @@ class HttpService:
         deadline_s = _requested_deadline(request, body, self.default_deadline_s)
         if deadline_s is not None:
             ctx.ctx.deadline = Deadline.after(deadline_s)
+        if ert.tc is not None:
+            # Downstream propagation: the preprocessor stamps this onto
+            # ``annotations.trace``; the service transport ships it in the
+            # request header — one trace from edge to decode chunk.
+            ctx.ctx.trace = ert.tc
         try:
             stream = await engine.generate(ctx)
         except ModelNotFoundError as e:
@@ -520,20 +615,28 @@ class HttpService:
             logger.exception("stream failed")
             return _error_response(500, str(e), rid=ctx.id)
         guard.finish(Status.SUCCESS)
-        return web.json_response(full, headers={"x-request-id": ctx.id})
+        headers = {"x-request-id": ctx.id}
+        trace = getattr(ctx.ctx, "trace", None)
+        if trace is not None:
+            headers["x-trace-id"] = trace.trace_id
+        return web.json_response(full, headers=headers)
 
     async def _stream_response(
         self, request: web.Request, stream, ctx: Context, guard
     ) -> web.StreamResponse:
-        resp = web.StreamResponse(
-            status=200,
-            headers={
-                "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache",
-                "Connection": "keep-alive",
-                "x-request-id": ctx.id,
-            },
-        )
+        headers = {
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+            "x-request-id": ctx.id,
+        }
+        trace = getattr(ctx.ctx, "trace", None)
+        if trace is not None:
+            # The trace id is the lookup key for /traces/{id}; loadgen's
+            # --trace-report reads it off this header.  Omitted when
+            # untraced — the response byte stream itself never changes.
+            headers["x-trace-id"] = trace.trace_id
+        resp = web.StreamResponse(status=200, headers=headers)
         await resp.prepare(request)
         deadline = getattr(ctx.ctx, "deadline", None)
         status = Status.SUCCESS

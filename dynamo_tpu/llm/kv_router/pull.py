@@ -51,23 +51,33 @@ KV_PREFETCH_TOPIC = "kv_prefetch"
 def make_kv_export_handler(engine):
     """Build the service handler a worker registers at ``kv_export`` so
     peers can pull its sealed prefix blocks."""
+    from ...runtime.tracing import parse_trace, span as trace_span
 
     async def kv_export_handler(request: Context) -> AsyncIterator[Dict]:
         d = request.data
         tokens = list(d["token_ids"])
         salt = d.get("salt")
-        # export_prompt_blocks reads HBM only, but the router hints raw
-        # tier-tagged depth — a donor whose blocks were DEMOTED must
-        # restore them first or the pull's primary scenario (tiered
-        # donors) silently exports nothing.
-        if getattr(engine, "host_kv", None) is not None:
-            await engine.restore_prefix(tokens, salt)
-        payload = await engine.export_prompt_blocks(
-            tokens,
-            start_block=int(d.get("start_block", 0)),
-            max_blocks=int(d.get("max_blocks", 0)),
-            salt=salt,
-        )
+        # Donor-side span: the export request ships the puller's trace
+        # (``d["trace"]``, omit-when-absent — or the service-transport
+        # header via request.ctx), so the donor's restore+gather cost shows
+        # up inside the pulling request's timeline.
+        tc = parse_trace(d.get("trace")) or getattr(request.ctx, "trace", None)
+        with trace_span(tc, "kv.export", "kv_donor") as espan:
+            # export_prompt_blocks reads HBM only, but the router hints raw
+            # tier-tagged depth — a donor whose blocks were DEMOTED must
+            # restore them first or the pull's primary scenario (tiered
+            # donors) silently exports nothing.
+            if getattr(engine, "host_kv", None) is not None:
+                await engine.restore_prefix(tokens, salt)
+            payload = await engine.export_prompt_blocks(
+                tokens,
+                start_block=int(d.get("start_block", 0)),
+                max_blocks=int(d.get("max_blocks", 0)),
+                salt=salt,
+            )
+            espan.set(
+                blocks=int(payload["n_blocks"]) if payload else 0
+            )
         yield {"payload": payload}
 
     return kv_export_handler
@@ -98,7 +108,11 @@ class PrefixPuller:
         )
 
     async def pull(
-        self, token_ids: List[int], salt: Optional[str], hint: Dict[str, Any]
+        self,
+        token_ids: List[int],
+        salt: Optional[str],
+        hint: Dict[str, Any],
+        trace=None,
     ) -> int:
         """Pull the delta blocks the hinted peer holds beyond every local
         tier.  Returns tokens covered; 0 on any failure or when the local
@@ -148,6 +162,10 @@ class PrefixPuller:
         }
         if salt:
             data["salt"] = salt
+        if trace is not None and trace.sampled:
+            # Omit-when-absent wire propagation (runtime/tracing.py): the
+            # donor's kv_export handler records its span under this trace.
+            data["trace"] = trace.to_dict()
         try:
             payload = await asyncio.wait_for(
                 self.exporter(peer, data), self.timeout_s

@@ -69,6 +69,11 @@ class SequenceSnapshot:
     # (every delivered token was mask-admissible, so the walk cannot
     # fail on an honest snapshot).
     grammar: Optional[Dict[str, Any]] = None
+    # Distributed-tracing context (runtime/tracing.py TraceContext wire
+    # dict): a migrated stream must stay ONE trace, so the target resumes
+    # recording spans under the SAME trace_id the source served.  Omitted
+    # for untraced sequences (the overwhelmingly common case).
+    trace: Optional[Dict[str, Any]] = None
     version: int = SNAPSHOT_VERSION
 
     @property
@@ -105,6 +110,8 @@ class SequenceSnapshot:
             out["priority"] = self.priority
         if self.grammar is not None:
             out["grammar"] = self.grammar
+        if self.trace is not None:
+            out["trace"] = self.trace
         return out
 
     @classmethod
@@ -123,6 +130,7 @@ class SequenceSnapshot:
             tenant=d.get("tenant"),
             priority=d.get("priority"),
             grammar=d.get("grammar"),
+            trace=d.get("trace"),
             version=int(d.get("version", SNAPSHOT_VERSION)),
         )
 
@@ -168,6 +176,10 @@ class SequenceSnapshot:
                 **({"kv_salt": self.kv_salt} if self.kv_salt else {}),
                 # QoS fairness flow (llm/qos.py; omitted when default).
                 **({"tenant": self.tenant} if self.tenant else {}),
+                # Tracing continuity (runtime/tracing.py): the target's
+                # engine parses annotations.trace, so the resumed stream's
+                # spans join the original trace.
+                **({"trace": dict(self.trace)} if self.trace else {}),
             },
             **({"grammar": dict(self.grammar)} if self.grammar else {}),
             **({"priority": self.priority} if self.priority else {}),

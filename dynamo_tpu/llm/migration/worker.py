@@ -36,6 +36,7 @@ from typing import Any, AsyncIterator, Dict, List, Optional
 
 from ...runtime.client import Client
 from ...runtime.engine import AsyncEngine, Context, ResponseStream
+from ...runtime.tracing import parse_trace, span as trace_span
 from ..metrics import migration_metrics as metrics
 from .snapshot import SequenceSnapshot
 
@@ -134,15 +135,29 @@ class MigratableWorker(AsyncEngine):
                 return {"ok": False, "error": "prompt exceeds KV pool"}
             covered = 0
             payload = data.get("payload")
-            if payload is not None:
-                covered = await self.engine.inject_blocks(tokens, payload, salt)
-                if covered == 0 and int(payload.get("n_blocks", 0)) > 0:
-                    return {"ok": False, "error": "final-delta import rejected"}
-            metrics.migrated_in_total += 1
+            # Target-side span under the stream's trace (data["trace"],
+            # omit-when-absent): the commit validation + final-delta seal
+            # is the target's half of the cutover pause.
+            with trace_span(
+                parse_trace(data.get("trace")), "migrate.in_commit",
+                "migration",
+            ) as mspan:
+                if payload is not None:
+                    covered = await self.engine.inject_blocks(
+                        tokens, payload, salt
+                    )
+                    if covered == 0 and int(payload.get("n_blocks", 0)) > 0:
+                        return {
+                            "ok": False,
+                            "error": "final-delta import rejected",
+                        }
+                metrics.migrated_in_total += 1
+                prefix_hit = self.engine.estimate_prefix_hit(tokens, salt)
+                mspan.set(prefix_hit=prefix_hit)
             return {
                 "ok": True,
                 "tokens_covered": covered,
-                "prefix_hit": self.engine.estimate_prefix_hit(tokens, salt),
+                "prefix_hit": prefix_hit,
             }
         return {"ok": False, "error": f"unknown migrate_in kind {kind!r}"}
 
@@ -181,6 +196,15 @@ class MigratableWorker(AsyncEngine):
         bs = engine.cfg.block_size
         metrics.started_total += 1
         cursor = 0  # complete blocks already pushed
+        # Tracing (runtime/tracing.py): migration spans record under the
+        # SEQUENCE's trace — the same one the client stream carries — so a
+        # migrated request's timeline shows copy/freeze/cutover inline.
+        seq0 = engine.find_sequence(request_id)
+        tc = seq0.trace.ctx if seq0 is not None and seq0.trace else None
+        cspan = trace_span(
+            tc, "migrate.copy", "migration",
+            attrs={"target_worker": target.get("worker_id")},
+        )
         # -- phase 1: copy while decoding --------------------------------
         salt = None
         for _ in range(self.max_copy_rounds):
@@ -188,6 +212,7 @@ class MigratableWorker(AsyncEngine):
             seq = engine.find_sequence(request_id)
             if tokens is None or seq is None or seq.finished:
                 metrics.aborted_total += 1
+                cspan.set(aborted=True).finish()
                 return False  # finished/cancelled under us: nothing to move
             # Tenant sequences (llm/tenancy) seal KV under a salted hash
             # chain: export with the same salt and ship it with every
@@ -195,7 +220,9 @@ class MigratableWorker(AsyncEngine):
             # request will look blocks up with.
             salt = seq.kv_salt
             try:
-                shipped = await self._push_blocks(target, tokens, cursor, salt)
+                shipped = await self._push_blocks(
+                    target, tokens, cursor, salt, trace=tc
+                )
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -204,6 +231,7 @@ class MigratableWorker(AsyncEngine):
                     "(source keeps the sequence)", request_id, exc_info=True,
                 )
                 metrics.aborted_total += 1
+                cspan.set(aborted=True).finish()
                 return False
             cursor += shipped
             remaining = len(tokens) // bs - cursor
@@ -215,18 +243,24 @@ class MigratableWorker(AsyncEngine):
                 # ordinary prefix miss.
                 break
             await asyncio.sleep(0)  # let decode advance between rounds
+        cspan.set(blocks=cursor).finish()
         # -- phase 2: freeze + final delta + commit ----------------------
+        fspan = trace_span(tc, "migrate.cutover", "migration")
         seq = await engine.freeze_sequence(request_id, timeout=self.freeze_timeout)
         if seq is None:
             metrics.aborted_total += 1
+            fspan.set(aborted=True).finish()
             return False
+        fspan.event("frozen")
         pause_t0 = time.perf_counter()
         try:
             snap = engine.snapshot_sequence(request_id)
             if snap is None:
                 raise RuntimeError("sequence vanished after freeze")
             tokens = snap.token_ids
-            cursor += await self._push_blocks(target, tokens, cursor, salt)
+            cursor += await self._push_blocks(
+                target, tokens, cursor, salt, trace=tc
+            )
             # The commit carries only what the target validates against:
             # the decode state itself rides the cutover marker (the client
             # re-dispatches snap.to_resume_request()), so shipping the
@@ -240,6 +274,9 @@ class MigratableWorker(AsyncEngine):
                     "block_size": bs,
                     "payload": None,
                     **({"salt": salt} if salt else {}),
+                    # Omit-when-absent (like salt): the target records its
+                    # migrate-in span under the stream's trace.
+                    **({"trace": tc.to_dict()} if tc is not None else {}),
                 },
             )
             if not resp.get("ok"):
@@ -257,6 +294,7 @@ class MigratableWorker(AsyncEngine):
             )
             engine.unfreeze_sequence(request_id)
             metrics.rolled_back_total += 1
+            fspan.set(rolled_back=True).finish()
             return False
         # -- cutover ------------------------------------------------------
         item = {
@@ -271,8 +309,10 @@ class MigratableWorker(AsyncEngine):
             },
         }
         engine.finish_migrated(request_id, item)
-        metrics.cutover_pause_ms.observe((time.perf_counter() - pause_t0) * 1e3)
+        pause_ms = (time.perf_counter() - pause_t0) * 1e3
+        metrics.cutover_pause_ms.observe(pause_ms)
         metrics.completed_total += 1
+        fspan.set(pause_ms=round(pause_ms, 3), blocks=cursor).finish()
         logger.info(
             "migration %s: cut over to worker %s (%d tokens, %d blocks)",
             request_id, target.get("worker_id"), len(tokens), cursor,
@@ -286,6 +326,7 @@ class MigratableWorker(AsyncEngine):
         tokens: List[int],
         cursor: int,
         salt: Optional[str] = None,
+        trace=None,
     ) -> int:
         """Export sealed blocks from ``cursor`` and push them; returns the
         number of complete blocks shipped.  Raises on a target refusal.
@@ -313,6 +354,7 @@ class MigratableWorker(AsyncEngine):
                     "block_size": bs,
                     "payload": payload,
                     **({"salt": salt} if salt else {}),
+                    **({"trace": trace.to_dict()} if trace is not None else {}),
                 },
             )
             if not resp.get("ok"):

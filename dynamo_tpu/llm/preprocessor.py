@@ -236,7 +236,19 @@ class OpenAIPreprocessor(Operator):
         raw = request.data
         chat = "messages" in raw if isinstance(raw, dict) else True
         oai = self._parse(raw)
-        pre = self.preprocess(oai, grammar=await self._compile_grammar_async(oai))
+        from .trace_service import preprocess_span
+
+        with preprocess_span(request.ctx):
+            pre = self.preprocess(
+                oai, grammar=await self._compile_grammar_async(oai)
+            )
+        trace = getattr(request.ctx, "trace", None)
+        if trace is not None and trace.sampled:
+            # Wire propagation (runtime/tracing.py): the trace rides
+            # ``annotations.trace`` on the PreprocessedRequest — the same
+            # omit-when-absent idiom as adapter/kv_salt/tenant, so
+            # pre-tracing consumers never see the key.
+            pre.annotations["trace"] = trace.to_dict()
         model = pre.model or self.model_name
         n = int(raw.get("n") or 1) if isinstance(raw, dict) else 1
         # Only user-REQUESTED debug annotations (nvext.annotations) echo as

@@ -37,6 +37,7 @@ from .resilience import (
     RetryPolicy,
     metrics,
 )
+from .tracing import span as trace_span
 from .transports.service import RemoteEngine, RemoteEngineError
 
 
@@ -343,6 +344,29 @@ class Client(AsyncEngine):
         other instances.  ``state`` ({"attempt", "tried"}) is shared with the
         first-token failover wrapper so the TOTAL attempt budget is bounded
         across both phases."""
+        # Route span (runtime/tracing.py): pick + connect, with every retry
+        # / failover / breaker-open recorded as span events — the routed
+        # client is the one vantage point that sees them all.  NOOP (zero
+        # cost) for untraced requests.
+        rspan = trace_span(
+            getattr(request.ctx, "trace", None), "client.route", "client"
+        )
+        try:
+            return await self._acquire_routed(
+                request, worker_id, mode, state, deadline, rspan
+            )
+        finally:
+            rspan.finish()
+
+    async def _acquire_routed(
+        self,
+        request: Context,
+        worker_id: Optional[int],
+        mode: RouterMode,
+        state: Dict[str, Any],
+        deadline: Optional[Deadline],
+        rspan,
+    ) -> Tuple[int, str, ResponseStream]:
         policy = self.retry_policy
         while True:
             if deadline is not None and deadline.expired:
@@ -361,6 +385,7 @@ class Client(AsyncEngine):
                 # pauses traffic, it doesn't kill it).
                 state["attempt"] += 1
                 metrics.retries_total += 1
+                rspan.event("no_instances", attempt=state["attempt"])
                 if state["attempt"] >= policy.max_attempts:
                     metrics.retries_exhausted_total += 1
                     raise
@@ -406,6 +431,9 @@ class Client(AsyncEngine):
                     breaker.release_probe()
                     raise
                 breaker.record_failure()
+                rspan.event(
+                    "retry", worker=wid, breaker=str(breaker.state.value),
+                )
                 self._evict(wid)
                 if worker_id is not None:
                     # Direct routing (the KV router chose): no failover
@@ -438,6 +466,7 @@ class Client(AsyncEngine):
                     state["tried"] = set()
                 continue
             breaker.record_success()
+            rspan.set(worker=wid, address=address)
             return wid, address, stream
 
     async def generate(
@@ -578,12 +607,16 @@ class _StreamGuard:
                 self._record_failure()
                 if not await self._budget_ok(e, "died before first token"):
                     raise
-                self._wid, self._address, self._stream = (
-                    await self._client._acquire(
-                        self._request, None, self._mode, self._state,
-                        self._deadline,
+                with trace_span(
+                    self._trace(), "client.failover", "client",
+                    attrs={"from_worker": self._wid},
+                ):
+                    self._wid, self._address, self._stream = (
+                        await self._client._acquire(
+                            self._request, None, self._mode, self._state,
+                            self._deadline,
+                        )
                     )
-                )
                 self._reset_latency_anchor()
                 continue
             if isinstance(item, dict) and "resolved_seed" in item:
@@ -612,6 +645,11 @@ class _StreamGuard:
             return item
 
     # -- recovery helpers ---------------------------------------------------
+
+    def _trace(self):
+        """The stream's active TraceContext (None = untraced — every span
+        call below is then the shared no-op)."""
+        return getattr(self._request.ctx, "trace", None)
 
     def _reset_latency_anchor(self) -> None:
         """Re-anchor the per-worker latency observations after any
@@ -704,9 +742,15 @@ class _StreamGuard:
         self._record_failure()
         if not await self._budget_ok(exc, "died mid-stream"):
             return False
-        self._wid, self._address, self._stream = await self._client._acquire(
-            request, None, self._mode, self._state, self._deadline
-        )
+        with trace_span(
+            self._trace(), "client.resume", "client",
+            attrs={"from_worker": self._wid, "error": type(exc).__name__},
+        ):
+            self._wid, self._address, self._stream = (
+                await self._client._acquire(
+                    request, None, self._mode, self._state, self._deadline
+                )
+            )
         self._request = request
         self._reset_latency_anchor()
         metrics.stream_resumes_total += 1
@@ -716,6 +760,28 @@ class _StreamGuard:
         """Cutover marker: re-dispatch the resume request to the migration
         target and continue the stream there.  A dead target is survivable
         — the resume request is deterministic, so any instance will do."""
+        # Splice span: the cutover's client-visible cost (source stream
+        # release + target re-dispatch).  The resume request carries the
+        # trace in its annotations (migration snapshot), so the target's
+        # engine spans join the SAME trace — one migrated stream, one
+        # timeline.
+        wid = mig.get("worker_id")
+        sspan = trace_span(
+            self._trace(), "client.splice", "client",
+            attrs={"target_worker": wid},
+        )
+        try:
+            await self._splice_inner(mig, sspan)
+        except BaseException as e:
+            # The raise paths (deadline exhausted, non-retryable target
+            # error) are exactly the failed cutovers whose cost matters —
+            # record the span instead of leaking it (finish is idempotent).
+            sspan.set(error=type(e).__name__)
+            raise
+        finally:
+            sspan.finish()
+
+    async def _splice_inner(self, mig: Dict[str, Any], sspan) -> None:
         req_data = mig.get("request") or {}
         request = Context(req_data, self._request.ctx)
         client = self._client
@@ -779,6 +845,7 @@ class _StreamGuard:
                 "request %s: migration target %s unreachable (%s); "
                 "resuming on any instance", self._request.id, wid, e,
             )
+            sspan.event("target_unreachable", error=type(e).__name__)
             self._wid, self._address, stream = await client._acquire(
                 request, None, self._mode, self._state, self._deadline
             )

@@ -112,6 +112,11 @@ class RuntimeConfig:
     # tenant_weights, default_weight, batch_every).  Nested env works:
     # ``DYN_QOS__RATE=20``, ``DYN_QOS__BROWNOUT__QUEUE_HIGH=32``.
     qos: Dict[str, Any] = field(default_factory=dict)
+    # Distributed request tracing (runtime/tracing.py TracingConfig keys:
+    # enabled, sample, ring, export_interval_s, ttl_s, tail_keep,
+    # tail_slo_ttft_ms).  Nested env works: ``DYN_TRACING__SAMPLE=0.1``,
+    # ``DYN_TRACING__TAIL_SLO_TTFT_MS=1500``.
+    tracing: Dict[str, Any] = field(default_factory=dict)
     extra: Dict[str, Any] = field(default_factory=dict)  # unrecognized keys
 
     @classmethod

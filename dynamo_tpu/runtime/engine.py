@@ -35,9 +35,12 @@ class AsyncEngineContext:
     Child contexts are linked so cancelling a parent cascades.
     """
 
-    __slots__ = ("_id", "_stopped", "_killed", "_children", "_stop_event", "deadline")
+    __slots__ = (
+        "_id", "_stopped", "_killed", "_children", "_stop_event", "deadline",
+        "trace",
+    )
 
-    def __init__(self, id: Optional[str] = None, deadline=None):
+    def __init__(self, id: Optional[str] = None, deadline=None, trace=None):
         self._id = id if id is not None else uuid.uuid4().hex
         self._stopped = False
         self._killed = False
@@ -47,6 +50,11 @@ class AsyncEngineContext:
         # budget, decremented across hops (serialized on the wire by the
         # service plane, enforced by Client retries and the HTTP edge).
         self.deadline = deadline
+        # Optional tracing.TraceContext: the request's span-plane identity,
+        # set by the HTTP edge (sampling decision) or the service transport
+        # (``trace`` request-header key) and read by every instrumented hop
+        # (runtime/tracing.py).  None = untraced — the zero-cost path.
+        self.trace = trace
 
     @property
     def id(self) -> str:

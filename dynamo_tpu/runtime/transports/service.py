@@ -172,6 +172,15 @@ class ServiceServer:
             budget = header.get("deadline_s")
             if budget is not None:
                 ctx.deadline = Deadline.after(float(budget))
+            # Trace propagation (runtime/tracing.py): the caller ships its
+            # TraceContext in the request header (omit-when-absent, like
+            # deadline_s) so non-PreprocessedRequest payloads — KV exports,
+            # control calls — join the request's trace too.
+            tr = header.get("trace")
+            if tr is not None:
+                from ..tracing import parse_trace
+
+                ctx.trace = parse_trace(tr)
             streams[sid] = (ctx, asyncio.current_task())
             try:
                 if faults.enabled:
@@ -433,6 +442,11 @@ class RemoteEngine(AsyncEngine):
         if deadline is not None:
             # Ship the REMAINING budget; the server restarts its own clock.
             header["deadline_s"] = max(deadline.remaining(), 0.0)
+        trace = getattr(request.ctx, "trace", None)
+        if trace is not None and trace.sampled:
+            # Omitted when absent: untraced requests (and pre-tracing
+            # consumers) keep the established header shape.
+            header["trace"] = trace.to_dict()
         sid, queue = await conn.open_stream(header, request.data)
         try:
             first = await queue.get()

@@ -393,6 +393,42 @@ def test_dyn006_callee_without_param_clean():
     assert lint(src, "DYN006") == []
 
 
+def test_dyn006_trace_dropped_on_request_scoped_call():
+    # The ISSUE 15 extension: the call forwards ctx (request-scoped), the
+    # callee accepts `trace`, the caller holds one — dropping it detaches
+    # the downstream hop from the request's timeline.
+    src = (
+        "async def push(data, ctx, trace=None):\n"
+        "    return data\n"
+        "async def f(data, ctx, trace):\n"
+        "    await push(data, ctx=ctx)\n"
+    )
+    assert rules_of(lint(src, "DYN006")) == ["DYN006"]
+
+
+def test_dyn006_trace_forwarded_clean():
+    src = (
+        "async def push(data, ctx, trace=None):\n"
+        "    return data\n"
+        "async def f(data, ctx, trace):\n"
+        "    await push(data, ctx=ctx, trace=trace)\n"
+    )
+    assert lint(src, "DYN006") == []
+
+
+def test_dyn006_trace_without_request_scope_clean():
+    # A call that forwards NEITHER ctx nor deadline is not provably
+    # request-scoped — holding a trace alone must not flag it (helpers
+    # that batch/aggregate across requests take trace-less paths).
+    src = (
+        "async def push(data, trace=None):\n"
+        "    return data\n"
+        "async def f(data, ctx, trace):\n"
+        "    await push(data)\n"
+    )
+    assert lint(src, "DYN006") == []
+
+
 # ---------------------------------------------------------------- DYN007
 
 

@@ -152,6 +152,35 @@ def _consume(stream, items):
     return asyncio.create_task(run())
 
 
+class _Pace:
+    """Deterministic decode throttle via the engine's injectable pace hook
+    (engine.pace_hook — awaited before every device-op await).  The two
+    fleet acceptance tests below race wall clocks (drain vs sequence
+    completion; fault-arm vs stream end) and used to lose on slow
+    containers when decode outran the copy loop / the arm.  Engaging the
+    pace makes decode provably slower than the raced path — the KV
+    copy/export plane runs under the device lock, NOT through
+    ``_await_device``, so it is unthrottled — and ``release()`` restores
+    full speed once the race is decided.  Token streams are seed-keyed, so
+    pacing never changes bytes."""
+
+    def __init__(self, *engines, delay_s=0.05):
+        self._delay = delay_s
+        self._engines = engines
+        self._on = True
+        for e in engines:
+            e.pace_hook = self._hook
+
+    async def _hook(self):
+        if self._on:
+            await asyncio.sleep(self._delay)
+
+    def release(self):
+        self._on = False
+        for e in self._engines:
+            e.pace_hook = None
+
+
 # ---------------------------------------------------------------- snapshot
 
 
@@ -231,7 +260,13 @@ async def test_migrate_once_and_twice_exact_stream():
         task = _consume(stream, items)
         await _wait_for(lambda: len(_tokens(items)) >= 5)
         before = len(_tokens(items))
+        # Deterministic race: throttle the source's decode so the copy
+        # loop provably completes before the sequence can finish (decode
+        # outruns the copy loop on slow containers otherwise — the
+        # migration then aborts on a finished sequence).
+        pace = _Pace(a.engine)
         assert await a.mig.migrate_out(rid, b.target)
+        pace.release()
         await task
         assert _tokens(items) == control
         assert items[-1]["finish_reason"] is not None
@@ -252,13 +287,17 @@ async def test_migrate_once_and_twice_exact_stream():
         items2 = []
         task2 = _consume(stream2, items2)
         await _wait_for(lambda: len(_tokens(items2)) >= 4)
+        pace = _Pace(a.engine)
         assert await a.mig.migrate_out(ctx2.id, b.target)
+        pace.release()
         # Wait until B owns the resumed sequence and has advanced it.
         await _wait_for(
             lambda: (s := b.engine.find_sequence(ctx2.id)) is not None
             and s.num_output_tokens >= len(_tokens(items2)) + 2
         )
+        pace = _Pace(b.engine)
         assert await b.mig.migrate_out(ctx2.id, c.target)
+        pace.release()
         await task2
         assert _tokens(items2) == control2
         assert b.engine.find_sequence(ctx2.id) is None
@@ -301,6 +340,11 @@ async def test_commit_failure_rolls_back_source_authoritative():
             lambda: (s := src.find_sequence(ctx.id)) is not None
             and s.num_output_tokens >= 3
         )
+        # Deterministic race: both migrate attempts must land on a LIVE
+        # sequence (a 64-token budget can otherwise finish before the
+        # second attempt on a slow container, turning the asserted
+        # rollback/abort codes into plain finished-sequence aborts).
+        pace = _Pace(src)
         ok = await src_mig.migrate_out(
             ctx.id,
             {"worker_id": 9, "address": "tiny", "import_path": "-",
@@ -309,7 +353,7 @@ async def test_commit_failure_rolls_back_source_authoritative():
         assert not ok
         assert migration_metrics.rolled_back_total == 1
         seq = src.find_sequence(ctx.id)
-        assert seq is None or not seq.frozen  # unfrozen (or already done)
+        assert seq is not None and not seq.frozen  # unfrozen, still live
 
         ok = await src_mig.migrate_out(
             ctx.id,
@@ -319,6 +363,7 @@ async def test_commit_failure_rolls_back_source_authoritative():
         assert not ok
         assert migration_metrics.aborted_total == 1  # never froze for this
         assert migration_metrics.rolled_back_total == 1
+        pace.release()
 
         items = await task
         assert _tokens(items) == control  # stream never noticed either try
@@ -331,8 +376,9 @@ async def test_commit_failure_rolls_back_source_authoritative():
 # ----------------------- drain in O(transfer), driven remotely
 
 
-@pytest.mark.slow  # wall-clock race vs a control run: ci.sh's migration
-# step runs it (no `slow` filter there); tier-1 keeps the cheap gates.
+@pytest.mark.slow  # heavy 2-worker fleet: ci.sh's migration step runs it
+# (no `slow` filter there); tier-1 keeps the cheap gates.  The drain-vs-
+# control race itself is DETERMINISTIC via the injectable pace hook.
 async def test_remote_drain_via_migrate_is_transfer_bound():
     """Planner scale-down/flip acceptance: draining a worker via its
     REMOTE migrate_out control endpoint (llm.migration.request_migrate_out
@@ -363,6 +409,13 @@ async def test_remote_drain_via_migrate_is_transfer_bound():
         task = _consume(stream, items)
         await _wait_for(lambda: len(_tokens(items)) >= 5)
 
+        # Deterministic race: throttle the SOURCE engine's decode so the
+        # copy loop (unthrottled — it runs under the device lock, not
+        # through the paced ``_await_device``) provably outpaces both the
+        # migrating sequence and the control.  Without this, a slow
+        # container could decode 320 tokens before 16 copy rounds landed
+        # and the drain aborted on a finished sequence.
+        pace = _Pace(a.engine)
         # Control clock starts at the drain decision: the same seeded
         # sequence, decoded from scratch to completion on the SOURCE engine
         # (seeded streams are engine-agnostic; running it there keeps the
@@ -381,6 +434,9 @@ async def test_remote_drain_via_migrate_is_transfer_bound():
             "drain-via-migrate was not faster than sequence completion"
         )
         assert ctx.id not in a.engine.live_request_ids()
+        # Race decided: restore full speed so the control (and the spliced
+        # stream's tail on the target) finish promptly.
+        pace.release()
 
         await task
         control = _tokens(await control_task)
@@ -436,7 +492,8 @@ async def test_pick_migration_target_filters_and_orders():
 
 @pytest.mark.chaos
 @pytest.mark.slow  # two full crash/resume rounds: ci.sh's migration step
-# runs it (no `slow` filter there); tier-1 keeps the cheap gates.
+# runs it (no `slow` filter there); tier-1 keeps the cheap gates.  The
+# arm-vs-stream-end race is DETERMINISTIC via the injectable pace hook.
 async def test_drop_mid_stream_crash_recovery():
     """Chaos acceptance on one two-worker fleet: a decode worker killed
     mid-stream (the ``drop_mid_stream`` fault point — same mechanism
@@ -466,6 +523,13 @@ async def test_drop_mid_stream_crash_recovery():
         req = _req(list(range(61, 78)), max_tokens=64, seed=909)
         control = await _control_tokens_on(b.engine, req)
         before_resumes = res_metrics.stream_resumes_total
+        # Deterministic fault window: throttle BOTH engines' decode so the
+        # arm below provably lands while the 64-token stream is still
+        # running (unpaced, a fast container could finish the whole stream
+        # between the >= 5 check and the arm — the fault then never fired
+        # and the resume count assertion raced).  Pacing is byte-invisible:
+        # streams key on (seed, output index).
+        pace = _Pace(a.engine, b.engine)
         stream = await client.generate(Context(dict(req)))
         items = []
         task = _consume(stream, items)
@@ -473,6 +537,7 @@ async def test_drop_mid_stream_crash_recovery():
         # Kill the serving worker mid-stream: its next item send hard-aborts
         # the transport, exactly like DYN_FAULTS=drop_mid_stream#1.
         faults.arm("drop_mid_stream", match="gen", count=1)
+        pace.release()  # fault armed: the race is decided
         await task
         assert _tokens(items) == control
         assert items[-1]["finish_reason"] is not None
@@ -480,6 +545,7 @@ async def test_drop_mid_stream_crash_recovery():
 
         # --- unseeded: refuses to resume, surfaces the crash --------------
         req = _req(list(range(61, 78)), max_tokens=64, seed=None)
+        pace = _Pace(a.engine, b.engine)
         stream = await client.generate(Context(dict(req)))
         items = []
         with pytest.raises(Exception):
@@ -489,7 +555,9 @@ async def test_drop_mid_stream_crash_recovery():
                 got += len(it.get("token_ids", []))
                 if got >= 3:
                     faults.arm("drop_mid_stream", match="gen", count=1)
+                    pace.release()
         assert items  # tokens streamed before the crash surfaced
+        pace.release()  # crash may surface before the arm branch ran
         await client.close()
     finally:
         faults.reset()

@@ -111,6 +111,12 @@ print(f"churn smoke ok: kernel={r['decode_kernel']} "
       f"retired={r['continuous_retired']} host_gap={g}")
 PYEOF
 
+echo "== tracing suite (span plane: propagation across disagg/pull/"
+echo "   migration, sampling, aggregator, byte-identity + zero-compile"
+echo "   overhead contract, /traces endpoints) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py -q -m tracing \
+  -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== chaos ladder L0-L2 + L5 respawn + L6 overload + L7 corruption"
 echo "   storm (seeded goodput smoke; bars: 0 dropped, byte-identity incl."
 echo "   unseeded streams, respawn on L5, non-flooding tenants >= 0.9x"

@@ -166,6 +166,14 @@ WIRE_FIELD_EXEMPT = {
 OMIT_WHEN_ABSENT_CLASSES = {
     "PreprocessedRequest",
     "SequenceSnapshot",
+    # Distributed tracing (runtime/tracing.py): ``sampled`` ships only when
+    # False — pre-tracing consumers (and the common sampled case) keep the
+    # minimal {trace_id, span_id} wire shape.  The trace context itself
+    # rides omit-when-absent keys on carriers that already adopted the
+    # idiom: annotations.trace, the service-transport header, disagg queue
+    # items / kv_import chunks, kv_export pull requests, migration
+    # blocks/commit payloads and SequenceSnapshot.trace.
+    "TraceContext",
 }
 
 # (class, field): Optional fields that MAY ship unconditionally despite
@@ -252,6 +260,10 @@ SNAPSHOT_COVERED = {
     "grammar": "grammar",
     "tenant": "tenant",
     "priority": "priority",
+    # Tracing continuity: only the CONTEXT travels (trace_id/span_id wire
+    # dict) — timing anchors are source-local; the target opens fresh
+    # spans under the same trace_id (docs/tracing.md).
+    "trace": "trace",
 }
 
 # Fields that deliberately do NOT travel, with the reason recorded:

@@ -110,6 +110,11 @@ JIT_HOST_TAILS = {"item", "tolist"}
 
 # DYN006 — request-scoped values that must thread through the call graph.
 FORWARD_PARAMS = ("ctx", "deadline")
+# ... and the distributed-tracing context (runtime/tracing.py): a call that
+# forwards ctx/deadline (i.e. is request-scoped) to a trace-accepting
+# callee while holding a trace context must forward THAT too, or the
+# downstream hop silently falls out of the request's timeline.
+TRACE_PARAM = "trace"
 
 _BROAD_NAMES = {"Exception", "BaseException"}
 
@@ -425,8 +430,17 @@ class FileChecker:
 
         params = set(_param_names(fn))
         carried = [p for p in FORWARD_PARAMS if p in params]
-        if not carried:
+        holds_trace = TRACE_PARAM in params
+        if not carried and not holds_trace:
             return
+
+        def _passes(sub: ast.Call, p: str) -> bool:
+            if any(n == p for a in sub.args for n in iter_names(a)):
+                return True
+            return any(
+                n == p for kw in sub.keywords for n in iter_names(kw.value)
+            )
+
         for sub in _walk_same_func(fn):
             if not isinstance(sub, ast.Call):
                 continue
@@ -436,13 +450,7 @@ class FileChecker:
             for p in carried:
                 if not self.index.every_def_accepts(tail, p):
                     continue
-                passed = any(n == p for a in sub.args for n in iter_names(a))
-                passed = passed or any(
-                    n == p
-                    for kw in sub.keywords
-                    for n in iter_names(kw.value)
-                )
-                if not passed:
+                if not _passes(sub, p):
                     self._emit(
                         "DYN006",
                         sub,
@@ -450,3 +458,22 @@ class FileChecker:
                         f"`{tail}()` (which accepts `{p}`) without forwarding "
                         "it — deadlines/cancellation stop propagating here",
                     )
+            if (
+                holds_trace
+                and any(_passes(sub, p) for p in FORWARD_PARAMS)
+                and self.index.every_def_accepts(tail, TRACE_PARAM)
+                and not _passes(sub, TRACE_PARAM)
+            ):
+                # Trace-propagation gap (runtime/tracing.py): the call is
+                # request-scoped (it forwards ctx/deadline) and the callee
+                # takes a trace context, but this hop drops the one in
+                # scope — the downstream spans silently detach from the
+                # request's timeline.
+                self._emit(
+                    "DYN006",
+                    sub,
+                    f"`{self._symbol()}` holds a `trace` context and "
+                    f"forwards ctx/deadline to `{tail}()` (which accepts "
+                    "`trace`) without forwarding the trace — downstream "
+                    "spans drop out of the request's timeline",
+                )
