@@ -207,6 +207,12 @@ def ladder_rungs() -> List[Dict[str, Any]]:
         FaultEvent("kv_corrupt", at=0.10, until=0.80, match="host"),
         FaultEvent("kv_corrupt", at=0.10, until=0.80, match="wire"),
     ]
+    # L8: kill one hub shard's PRIMARY mid-burst; its warm standby promotes
+    # onto the same address at ``until`` (runtime/transports/hub.HubStandby).
+    # The fleet runs a 2-shard map, so the sibling shard keeps serving its
+    # keys throughout and the routed clients ride their local routing cache
+    # through the failover window (docs/hub.md).
+    shard_kill = FaultEvent("hub_shard_kill", at=0.40, until=0.52)
     return [
         {"level": 0, "name": "L0-baseline", "events": []},
         {"level": 1, "name": "L1-worker-crash", "events": [crash1]},
@@ -222,6 +228,8 @@ def ladder_rungs() -> List[Dict[str, Any]]:
          "events": [flood]},
         {"level": 7, "name": "L7-kv-corruption-storm",
          "events": corrupt, "corrupt": True},
+        {"level": 8, "name": "L8-hub-shard-kill",
+         "events": [shard_kill], "shards": 2},
     ]
 
 
@@ -252,12 +260,19 @@ class ChaosFleet:
     + planner signal plane + health watchdog."""
 
     def __init__(self, engines: List[Any], persist_path: str,
-                 watchdog: bool = True):
+                 watchdog: bool = True, shards: int = 1):
         self.engines = engines
         self.persist_path = persist_path
         self.enable_watchdog = watchdog
+        self.shards = shards
         self.hub = None
         self.hub_port: Optional[int] = None
+        # Shard mode (L8): every hub primary, plus one warm standby on the
+        # shard that owns the discovery namespace ("instances/...").
+        self.hubs: List[Any] = []
+        self.standby = None
+        self.standby_shard: Optional[int] = None
+        self.shard_failovers = 0
         self.workers: List[_Worker] = []
         self.client = None
         self.client_rt = None
@@ -273,13 +288,39 @@ class ChaosFleet:
     def instance_prefix(self) -> str:
         return f"instances/{NAMESPACE}/{COMPONENT}/gen/"
 
+    @property
+    def hub_address(self) -> str:
+        """Connect spec: one address, or the comma-joined shard map."""
+        if self.shards > 1:
+            return ",".join(h.address for h in self.hubs)
+        return self.hub.address
+
     async def start(self) -> "ChaosFleet":
         from dynamo_tpu.runtime import HubServer
 
-        self.hub = await HubServer(
-            persist_path=self.persist_path, persist_interval_s=0.2
-        ).start()
-        self.hub_port = self.hub.port
+        if self.shards > 1:
+            from dynamo_tpu.runtime import HubStandby, ShardMap
+
+            for i in range(self.shards):
+                self.hubs.append(
+                    await HubServer(
+                        persist_path=f"{self.persist_path}.s{i}",
+                        persist_interval_s=0.2,
+                    ).start()
+                )
+            # The standby shadows (and the rung kills) the shard that owns
+            # the discovery namespace — the worst-case victim: watches,
+            # registrations and leases for instance routing all live there.
+            smap = ShardMap([h.address for h in self.hubs])
+            self.standby_shard = smap.shard_of_token("instances")
+            self.standby = await HubStandby(
+                self.hubs[self.standby_shard].address
+            ).start()
+        else:
+            self.hub = await HubServer(
+                persist_path=self.persist_path, persist_interval_s=0.2
+            ).start()
+            self.hub_port = self.hub.port
         for engine in self.engines:
             self.workers.append(await self._spawn_worker(engine))
         await self._start_client_plane()
@@ -295,7 +336,7 @@ class ChaosFleet:
         from dynamo_tpu.runtime import DistributedRuntime
 
         rt = await DistributedRuntime.connect(
-            self.hub.address, lease_ttl=1.5
+            self.hub_address, lease_ttl=1.5
         )
         mig = MigratableWorker(engine, chunk_blocks=4)
         component = rt.namespace(NAMESPACE).component(COMPONENT)
@@ -414,7 +455,7 @@ class ChaosFleet:
         from dynamo_tpu.runtime.health import HealthConfig, HealthWatchdog
 
         self.client_rt = await DistributedRuntime.connect(
-            self.hub.address, lease_ttl=1.5
+            self.hub_address, lease_ttl=1.5
         )
         self.client = Client(
             self.client_rt.hub,
@@ -470,6 +511,28 @@ class ChaosFleet:
             persist_interval_s=0.2,
         ).start()
 
+    # -- shard failover (L8: kill one primary, promote its warm standby) ----
+
+    async def kill_shard_primary(self) -> None:
+        assert self.standby_shard is not None and self.hubs
+        await self.hubs[self.standby_shard].close()
+
+    async def promote_standby(self) -> None:
+        """Standby takes over the dead primary's address; clients observe
+        exactly a hub restart on that one shard — reconnect, watch resync,
+        lease re-grant — while the sibling shard never blips."""
+        from dynamo_tpu.runtime.transports.shard import shard_metrics
+
+        assert self.standby is not None and self.standby_shard is not None
+        addr = self.hubs[self.standby_shard].address
+        self.hubs[self.standby_shard] = await self.standby.promote(
+            persist_path=f"{self.persist_path}.s{self.standby_shard}",
+            persist_interval_s=0.2,
+        )
+        self.standby = None
+        self.shard_failovers += 1
+        shard_metrics.note_failover(addr)
+
     # -- teardown ----------------------------------------------------------
 
     async def close(self) -> None:
@@ -495,6 +558,15 @@ class ChaosFleet:
                     await worker.runtime.close()
                 except Exception:  # noqa: BLE001 — crashed mid-rung
                     pass
+        if self.standby is not None:
+            await self.standby.close()
+            self.standby = None
+        for hub in self.hubs:
+            try:
+                await hub.close()
+            except Exception:  # noqa: BLE001 — a killed primary mid-rung
+                pass
+        self.hubs = []
         if self.hub is not None:
             await self.hub.close()
         # Engines outlive the fleet (shared across rungs): wait for any
@@ -645,6 +717,19 @@ async def _drive_fault(
         await asyncio.sleep(max(((ev.until or ev.at) - ev.at) * duration, 0.1))
         await fleet.restart_hub()
         logger.warning("[fault] hub restarted")
+        return
+    if ev.kind == "hub_shard_kill":
+        # The REAL shard failover (not an armed flavour): SIGKILL one
+        # shard's primary, hold the window, then promote its warm standby
+        # onto the same address (lease floor intact).
+        logger.warning("[fault] shard %s primary kill (promote in %.1fs)",
+                       fleet.standby_shard,
+                       ((ev.until or ev.at) - ev.at) * duration)
+        await fleet.kill_shard_primary()
+        await asyncio.sleep(max(((ev.until or ev.at) - ev.at) * duration, 0.1))
+        await fleet.promote_standby()
+        logger.warning("[fault] standby promoted on shard %s",
+                       fleet.standby_shard)
         return
     match = ev.match or "*"
     if match == "*" and ev.worker is not None and ev.worker < len(fleet.workers):
@@ -905,7 +990,8 @@ async def run_rung(
         "ejections": health_metrics.ejections_total,
     }
     fleet = await ChaosFleet(
-        engines, persist_path, watchdog=watchdog
+        engines, persist_path, watchdog=watchdog,
+        shards=rung.get("shards", 1),
     ).start()
     if rung.get("supervise"):
         await fleet.start_supervisor()
@@ -1065,7 +1151,9 @@ async def run_rung(
             "ejections": delta("ejections", health_metrics.ejections_total),
             "respawns": fleet.respawned,
             "rebalanced": fleet.rebalanced,
+            "shard_failovers": fleet.shard_failovers,
         },
+        "shards": fleet.shards,
         "deterministic": {
             "outcomes": [
                 [o.i, o.status, o.tokens, o.token_hash] for o in outcomes
@@ -1188,6 +1276,21 @@ def check_report(
                 f"L2 goodput {rungs[2]['goodput']:.3f} is "
                 f"{ratio:.2f}x L0 ({l0['goodput']:.3f}); bar is {min_ratio}"
             )
+    if 8 in rungs:
+        # Shard-failover rung: the standby must actually have promoted
+        # (a rung that never failed over proves nothing), and goodput
+        # through the failover window holds the same bar as L2's restart.
+        if not rungs[8]["resilience"].get("shard_failovers"):
+            problems.append(
+                "L8: no shard failover occurred (standby never promoted)"
+            )
+        if l0["goodput"] > 0:
+            ratio = rungs[8]["goodput"] / l0["goodput"]
+            if ratio < min_ratio:
+                problems.append(
+                    f"L8 goodput {rungs[8]['goodput']:.3f} is "
+                    f"{ratio:.2f}x L0 ({l0['goodput']:.3f}); bar is {min_ratio}"
+                )
     return problems
 
 

@@ -161,6 +161,7 @@ async def _run_http_frontend(args) -> None:
         qos=qos_ctl,
         tracing=sampler,
         trace_aggregator=aggregator,
+        hub=runtime.hub,
     )
     mode = RouterMode(getattr(args, "router", "round_robin"))
     watcher = await ModelWatcher(runtime, service.models, router_mode=mode).start()
@@ -760,7 +761,7 @@ class WorkerRoles:
 
 async def _run_model_cmd(args) -> None:
     """llmctl equivalent (reference: launch/llmctl/src/main.rs:26-124)."""
-    from .llm.discovery import MODEL_PREFIX
+    from .llm.discovery import MODEL_PREFIX, model_prefix
 
     runtime = await DistributedRuntime.connect(args.hub)
     try:
@@ -784,7 +785,7 @@ async def _run_model_cmd(args) -> None:
             if not kvs:
                 print("(no models registered)")
         elif args.verb == "remove":
-            kvs = await runtime.hub.kv_get_prefix(f"{MODEL_PREFIX}{args.name}/")
+            kvs = await runtime.hub.kv_get_prefix(model_prefix(args.name))
             for key in kvs:
                 await runtime.hub.kv_delete(key)
             print(f"removed {len(kvs)} registration(s) for {args.name}")

@@ -25,11 +25,17 @@ from typing import Any, Dict, Optional
 from aiohttp import web
 
 from ..labels import safe_key_component
+from ..runtime.transports.shard import hub_key
 from .renderer import render
 
 logger = logging.getLogger(__name__)
 
 PREFIX = "deployments/"
+
+
+def deployment_key(name: str) -> str:
+    """CR record key for one deployment name (shard-map routed: DYN401)."""
+    return hub_key("deployments", name)
 
 
 def _as_cr(name: str, body: Dict[str, Any]) -> Dict[str, Any]:
@@ -123,13 +129,13 @@ class ApiStore:
             return web.json_response(
                 {"error": f"invalid spec: {e}"}, status=400
             )
-        existed = await self.hub.kv_get(PREFIX + name) is not None
-        await self.hub.kv_put(PREFIX + name, cr)
+        existed = await self.hub.kv_get(deployment_key(name)) is not None
+        await self.hub.kv_put(deployment_key(name), cr)
         if self.reconciler is not None:
             try:
                 status = await self.reconciler.reconcile(cr)
                 cr = dict(cr, status=status)
-                await self.hub.kv_put(PREFIX + name, cr)
+                await self.hub.kv_put(deployment_key(name), cr)
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -141,17 +147,17 @@ class ApiStore:
         return web.json_response({"items": list(items.values())})
 
     async def _get(self, request: web.Request) -> web.Response:
-        cr = await self.hub.kv_get(PREFIX + request.match_info["name"])
+        cr = await self.hub.kv_get(deployment_key(request.match_info["name"]))
         if cr is None:
             return web.json_response({"error": "not found"}, status=404)
         return web.json_response(cr)
 
     async def _delete(self, request: web.Request) -> web.Response:
         name = request.match_info["name"]
-        cr = await self.hub.kv_get(PREFIX + name)
+        cr = await self.hub.kv_get(deployment_key(name))
         if cr is None:
             return web.json_response({"error": "not found"}, status=404)
-        await self.hub.kv_delete(PREFIX + name)
+        await self.hub.kv_delete(deployment_key(name))
         if self.reconciler is not None:
             try:
                 await self.reconciler.teardown(name)
@@ -162,7 +168,7 @@ class ApiStore:
         return web.json_response({"deleted": name})
 
     async def _manifests(self, request: web.Request) -> web.Response:
-        cr = await self.hub.kv_get(PREFIX + request.match_info["name"])
+        cr = await self.hub.kv_get(deployment_key(request.match_info["name"]))
         if cr is None:
             return web.json_response({"error": "not found"}, status=404)
         return web.json_response({"manifests": render(cr)})

@@ -11,11 +11,18 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
+from ...runtime.transports.shard import hub_key
+
+
+def prefill_queue_name(model: str) -> str:
+    """Per-model prefill queue name (shard-map routed: DYN401)."""
+    return hub_key("prefill", model)
+
 
 class PrefillQueue:
     def __init__(self, hub, model: str):
         self.hub = hub
-        self.queue_name = f"prefill/{model}"
+        self.queue_name = prefill_queue_name(model)
 
     async def enqueue(self, request: Dict[str, Any]) -> None:
         await self.hub.q_push(self.queue_name, request)

@@ -20,7 +20,14 @@ from typing import Optional
 
 logger = logging.getLogger(__name__)
 
+from ...runtime.transports.shard import hub_key
+
 CONFIG_PREFIX = "disagg_router/"
+
+
+def disagg_config_key(model: str) -> str:
+    """Live-threshold config key for one model (shard-map routed: DYN401)."""
+    return hub_key("disagg_router", model)
 
 
 @dataclass
@@ -65,7 +72,7 @@ class DisaggregatedRouter:
     # ---------------------------------------------------------- live config
     @property
     def config_key(self) -> str:
-        return f"{CONFIG_PREFIX}{self.model}"
+        return disagg_config_key(self.model)
 
     async def watch_config(self, hub) -> "DisaggregatedRouter":
         """Start live-updating thresholds from the hub KV."""
@@ -131,4 +138,4 @@ class DisaggregatedRouter:
 
 async def publish_config(hub, model: str, config: DisaggConfig) -> None:
     """Operator-side: push new thresholds (hot-reloads every watcher)."""
-    await hub.kv_put(f"{CONFIG_PREFIX}{model}", config.to_dict())
+    await hub.kv_put(disagg_config_key(model), config.to_dict())

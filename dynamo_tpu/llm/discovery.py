@@ -21,6 +21,7 @@ from typing import Any, Dict, Optional
 from ..runtime.client import RouterMode
 from ..runtime.component import DistributedRuntime, parse_endpoint_path
 from ..runtime.pipeline import build_pipeline
+from ..runtime.transports.shard import hub_key, hub_prefix
 from .backend import Backend
 from .http_service import ModelManager
 from .preprocessor import OpenAIPreprocessor
@@ -29,6 +30,16 @@ from .tokenizer import BaseTokenizer, ByteTokenizer, HFTokenizer
 logger = logging.getLogger(__name__)
 
 MODEL_PREFIX = "models/"
+
+
+def model_key(name: str, worker_id: int) -> str:
+    """Per-worker model registration key (shard-map routed: DYN401)."""
+    return hub_key("models", name, worker_id)
+
+
+def model_prefix(name: str) -> str:
+    """Query prefix for one model's registrations across workers."""
+    return hub_prefix("models", name)
 
 
 def make_tokenizer(spec: Dict[str, Any]) -> BaseTokenizer:
@@ -98,7 +109,7 @@ async def register_model(
     frontend's ModelWatcher builds its pipeline with an adapter-stamping
     preprocessor, so requests naming this model route to the base engine
     with tenant identity (adapter id + KV salt) attached."""
-    key = f"{MODEL_PREFIX}{name}/{runtime.worker_id}"
+    key = model_key(name, runtime.worker_id)
     entry = {
         "name": name,
         "endpoint": endpoint_path,

@@ -126,6 +126,7 @@ class HttpService:
         kv_usage_fn=None,
         tracing=None,
         trace_aggregator=None,
+        hub=None,
     ):
         self.host = host
         self.port = port
@@ -159,6 +160,11 @@ class HttpService:
         # sink when the engine is colocated).
         self.tracing = tracing
         self.trace_aggregator = trace_aggregator
+        # Control-plane client (HubClient or ShardedHubClient): /health
+        # reports per-shard connectivity so a one-shard outage is visible
+        # at the edge before it pages as anything else.  None = the edge
+        # runs hub-less (tests, colocated engines) — zero change.
+        self.hub = hub
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self._chat_completions)
         self.app.router.add_post("/v1/completions", self._completions)
@@ -253,6 +259,20 @@ class HttpService:
         body = {"status": "ok", "models": self.models.model_names()}
         if self.qos is not None and self.qos.ladder is not None:
             body["brownout"] = self.qos.ladder.state()
+        if self.hub is not None:
+            # Sharded client → per-shard connectivity; plain HubClient →
+            # one synthetic shard so the schema is the same either way.
+            shard_health = getattr(self.hub, "shard_health", None)
+            if shard_health is not None:
+                shards = shard_health()
+            else:
+                shards = [{
+                    "shard": getattr(self.hub, "address", ""),
+                    "connected": bool(getattr(self.hub, "connected", False)),
+                }]
+            body["hub_shards"] = shards
+            if not all(s["connected"] for s in shards):
+                body["status"] = "degraded"
         return web.json_response(body)
 
     async def _metrics(self, request: web.Request) -> web.Response:
@@ -272,6 +292,7 @@ class HttpService:
         )
 
         from ..runtime.tracing import tracing_metrics
+        from ..runtime.transports.shard import shard_metrics
 
         body = (
             self.metrics.render()
@@ -286,6 +307,7 @@ class HttpService:
             + engine_dispatch_metrics.render(self._metrics_prefix).encode()
             + kv_tier_metrics.render(self._metrics_prefix).encode()
             + kv_integrity_metrics.render(self._metrics_prefix).encode()
+            + shard_metrics.render(self._metrics_prefix).encode()
         )
         return web.Response(body=body, content_type="text/plain")
 

@@ -26,7 +26,7 @@ from ..llm.kv_router.publisher import (
 from ..llm.kv_router.scheduler import KV_HIT_RATE_SUBJECT
 from ..labels import escape_label
 from ..planner.signals import StalenessTracker, classify_instance
-from ..runtime.component import INSTANCE_PREFIX
+from ..runtime.component import INSTANCE_PREFIX, instance_prefix
 
 logger = logging.getLogger(__name__)
 
@@ -71,7 +71,7 @@ class MetricsAggregatorService:
         # the TTL only covers workers that die without ever registering.
         ns = self.component.namespace.name
         self._watcher = await self.component.runtime.hub.watch_prefix(
-            f"{INSTANCE_PREFIX}/{ns}/"
+            instance_prefix(ns)
         )
         self._tasks.append(loop.create_task(self._consume_instances(self._watcher)))
         app = web.Application()

@@ -15,7 +15,14 @@ import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
+from ..runtime.transports.shard import hub_key
+
 MDC_PREFIX = "mdc/"
+
+
+def mdc_key(name: str) -> str:
+    """Deployment-card key for one model name (shard-map routed: DYN401)."""
+    return hub_key("mdc", name)
 
 
 @dataclass
@@ -87,7 +94,7 @@ class ModelDeploymentCard:
 
     # ------------------------------------------------------------- publishing
     def key(self) -> str:
-        return f"{MDC_PREFIX}{self.name}"
+        return mdc_key(self.name)
 
     async def publish(self, runtime) -> None:
         """Register under the worker's primary lease (auto-refresh + removal
@@ -96,7 +103,7 @@ class ModelDeploymentCard:
 
     @classmethod
     async def load(cls, runtime, name: str) -> Optional["ModelDeploymentCard"]:
-        data = await runtime.hub.kv_get(f"{MDC_PREFIX}{name}")
+        data = await runtime.hub.kv_get(mdc_key(name))
         return cls.from_dict(data) if data else None
 
     @classmethod

@@ -28,13 +28,24 @@ import logging
 from typing import Any, Awaitable, Callable, Dict, List, Optional
 
 from ..deploy.controller import GROUP
+from ..runtime.transports.shard import hub_key, hub_prefix
 from .policy import DECODE, PREFILL, Decision
 
 logger = logging.getLogger(__name__)
 
-ROLE_PREFIX = "planner/roles/"
-TARGET_PREFIX = "planner/targets/"
+ROLE_PREFIX = hub_prefix("planner", "roles")
+TARGET_PREFIX = hub_prefix("planner", "targets")
 CR_KIND = "DynamoTpuDeployment"
+
+
+def target_key(pool: str) -> str:
+    """Pool replica-target key (shard-map routed: DYN401)."""
+    return hub_key("planner", "targets", pool)
+
+
+def role_key(worker_id: int) -> str:
+    """Per-worker role-flip key (shard-map routed: DYN401)."""
+    return hub_key("planner", "roles", worker_id)
 
 
 class Actuator:
@@ -155,7 +166,7 @@ class LocalActuator(Actuator):
         for action in decision.actions:
             if action.kind in ("scale_prefill", "scale_decode"):
                 await self.hub.kv_put(
-                    f"{TARGET_PREFIX}{action.pool}",
+                    target_key(action.pool),
                     {
                         "replicas": action.target,
                         "tick": decision.tick,
@@ -170,7 +181,7 @@ class LocalActuator(Actuator):
                 )
             elif action.kind == "flip_role":
                 await self.hub.kv_put(
-                    f"{ROLE_PREFIX}{action.worker_id}",
+                    role_key(action.worker_id),
                     {
                         "role": action.pool,
                         "tick": decision.tick,
@@ -211,7 +222,7 @@ class RoleFlipWatcher:
 
     @property
     def key(self) -> str:
-        return f"{ROLE_PREFIX}{self.worker_id}"
+        return role_key(self.worker_id)
 
     async def start(self) -> "RoleFlipWatcher":
         self._watcher = await self.hub.watch_prefix(self.key)

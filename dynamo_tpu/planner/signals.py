@@ -25,7 +25,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 from ..llm.kv_router.protocols import ForwardPassMetrics
 from ..llm.kv_router.publisher import KV_METRICS_TOPIC, unpack_message
 from ..llm.kv_router.scheduler import KV_HIT_RATE_SUBJECT
-from ..runtime.component import INSTANCE_PREFIX
+from ..runtime.component import INSTANCE_PREFIX, instance_prefix
 from ..runtime.health import QUARANTINE_PREFIX, worker_latency
 
 logger = logging.getLogger(__name__)
@@ -265,7 +265,7 @@ class SignalCollector:
         self._subs = [m_sub, h_sub, e_sub]
         ns = self.component.namespace.name
         hub = self.component.runtime.hub
-        self._watcher = await hub.watch_prefix(f"{INSTANCE_PREFIX}/{ns}/")
+        self._watcher = await hub.watch_prefix(instance_prefix(ns))
         self._q_watcher = await hub.watch_prefix(QUARANTINE_PREFIX)
         self._tasks = [
             loop.create_task(self._consume_metrics(m_sub)),
@@ -402,7 +402,7 @@ class SignalCollector:
         ns = self.component.namespace.name
         await self._watch_consume(
             "_watcher",
-            f"{INSTANCE_PREFIX}/{ns}/",
+            instance_prefix(ns),
             self._apply_instance_event,
             self._resync_instances,
         )
@@ -496,9 +496,12 @@ class SignalCollector:
             )
         queue_depth = 0
         if self.model is not None:
+            from ..llm.disagg.prefill_queue import (  # lazy: llm imports planner
+                prefill_queue_name,
+            )
             try:
                 queue_depth = await self.component.runtime.hub.q_len(
-                    f"prefill/{self.model}"
+                    prefill_queue_name(self.model)
                 )
             except asyncio.CancelledError:
                 raise

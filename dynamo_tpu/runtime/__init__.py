@@ -42,8 +42,18 @@ from .transports.hub import (
     HubClient,
     HubServer,
     HubSessionLost,
+    HubStandby,
     InprocHub,
     WatchEvent,
+)
+from .transports.shard import (
+    CrossShardError,
+    ShardedHubClient,
+    ShardMap,
+    hub_key,
+    hub_prefix,
+    hub_subject,
+    shard_metrics,
 )
 from .transports.service import RemoteEngine, RemoteEngineError, ServiceServer
 
@@ -74,8 +84,16 @@ __all__ = [
     "parse_endpoint_path",
     "HubClient",
     "HubServer",
+    "HubStandby",
     "InprocHub",
     "WatchEvent",
+    "CrossShardError",
+    "ShardedHubClient",
+    "ShardMap",
+    "hub_key",
+    "hub_prefix",
+    "hub_subject",
+    "shard_metrics",
     "RemoteEngine",
     "RemoteEngineError",
     "ServiceServer",

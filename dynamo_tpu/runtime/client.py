@@ -39,6 +39,7 @@ from .resilience import (
 )
 from .tracing import span as trace_span
 from .transports.service import RemoteEngine, RemoteEngineError
+from .transports.shard import shard_metrics
 
 
 class RouterMode(enum.Enum):
@@ -123,6 +124,12 @@ class Client(AsyncEngine):
         self._watch_task: Optional[asyncio.Task] = None
         self._ready = asyncio.Event()
         self._static_engine: Optional[RemoteEngine] = None
+        # Degraded-mode routing cache: the instance table above IS the
+        # cache — picks never block on hub RTT.  While the watch is down
+        # (hub/shard outage, failover window) the cache serves stale with
+        # the staleness bound surfaced on /metrics; a successful resync
+        # clears it.
+        self._stale_since: Optional[float] = None
 
     @classmethod
     def static(cls, address: str, path: str) -> "Client":
@@ -195,6 +202,9 @@ class Client(AsyncEngine):
                     "instance watch for %r died; re-establishing",
                     self.instance_prefix,
                 )
+                if self._stale_since is None:
+                    self._stale_since = time.monotonic()
+                    shard_metrics.note_cache_stale(id(self), self._stale_since)
             while True:
                 try:
                     await asyncio.sleep(backoff)
@@ -240,6 +250,8 @@ class Client(AsyncEngine):
             self._engines.pop(wid, None)
         self._instances = fresh
         self._prune_breakers()
+        self._stale_since = None
+        shard_metrics.note_cache_fresh(id(self))
         if fresh:
             self._ready.set()
         else:
@@ -251,6 +263,7 @@ class Client(AsyncEngine):
             self._watch_task = None
         if self._watcher is not None:
             await self._watcher.aclose()
+        shard_metrics.note_cache_fresh(id(self))
 
     # -- instance access ----------------------------------------------------
 
@@ -284,6 +297,14 @@ class Client(AsyncEngine):
             self._breakers[address] = metrics.register_breaker(breaker)
         return breaker
 
+    def _note_pick(self) -> None:
+        """Account a pick served from the local routing cache (every pick
+        is — admission never blocks on hub RTT); stale hits ride through a
+        hub/shard failover window on the last synced view."""
+        shard_metrics.routing_cache_hits_total += 1
+        if self._stale_since is not None:
+            shard_metrics.routing_cache_stale_hits_total += 1
+
     def _pick(
         self,
         worker_id: Optional[int],
@@ -302,6 +323,7 @@ class Client(AsyncEngine):
                     f"instance {worker_id} not found",
                     prefix=self.instance_prefix,
                 )
+            self._note_pick()
             return worker_id, info
         ids = sorted(self._instances.keys())
         candidates = [i for i in ids if i not in exclude] or ids
@@ -320,6 +342,7 @@ class Client(AsyncEngine):
             # ROUND_ROBIN (and KV fallback when no overlap decision was made)
             self._rr_index += 1
             wid = candidates[self._rr_index % len(candidates)]
+        self._note_pick()
         return wid, self._instances[wid]
 
     def _engine_for(self, worker_id: int, info: Dict[str, Any]) -> RemoteEngine:

@@ -23,13 +23,25 @@ logger = logging.getLogger(__name__)
 from .client import Client, RouterMode
 from .engine import AsyncEngine, engine_from_generator
 from .transports.hub import HubClient, InprocHub
+from .transports.shard import ShardedHubClient, hub_key, hub_prefix, hub_subject
 from .transports.service import ServiceServer
 
 INSTANCE_PREFIX = "instances"
 
 
 def instance_key(ns: str, comp: str, ep: str, worker_id: int) -> str:
-    return f"{INSTANCE_PREFIX}/{ns}/{comp}/{ep}/{worker_id}"
+    return hub_key(INSTANCE_PREFIX, ns, comp, ep, worker_id)
+
+
+def instance_prefix(ns: str, comp: Optional[str] = None,
+                    ep: Optional[str] = None) -> str:
+    """Watch/query prefix under the discovery namespace, at any depth."""
+    segments = [INSTANCE_PREFIX, ns]
+    if comp is not None:
+        segments.append(comp)
+        if ep is not None:
+            segments.append(ep)
+    return hub_prefix(*segments)
 
 
 def endpoint_path(ns: str, comp: str, ep: str) -> str:
@@ -87,7 +99,17 @@ class DistributedRuntime:
         host: str = "127.0.0.1",
         lease_ttl: Optional[float] = None,
     ) -> "DistributedRuntime":
-        hub = await HubClient(address).connect()
+        """Connect to the hub control plane.
+
+        ``address`` is one ``host:port`` (a plain ``HubClient`` — byte-
+        compatible with every pre-sharding deployment) or a comma-separated
+        shard map ``host:port,host:port,...`` (a ``ShardedHubClient``
+        routing each key/subject to its owner shard).
+        """
+        if "," in address:
+            hub = await ShardedHubClient(address).connect()
+        else:
+            hub = await HubClient(address).connect()
         return await cls(hub, host=host, lease_ttl=lease_ttl)._init()
 
     async def _init(self) -> "DistributedRuntime":
@@ -195,7 +217,7 @@ class Namespace:
 
     # Event plane scoped to the namespace (reference traits/events.rs:30-79)
     def subject(self, topic: str) -> str:
-        return f"{self.name}.{topic}"
+        return hub_subject(self.name, topic)
 
     async def publish(self, topic: str, payload: Any) -> None:
         await self.runtime.hub.publish(self.subject(topic), payload)
@@ -221,7 +243,7 @@ class Component:
         return self
 
     def subject(self, topic: str) -> str:
-        return f"{self.namespace.name}.{self.name}.{topic}"
+        return hub_subject(self.namespace.name, self.name, topic)
 
     async def publish(self, topic: str, payload: Any) -> None:
         await self.runtime.hub.publish(self.subject(topic), payload)
@@ -250,9 +272,8 @@ class Endpoint:
 
     @property
     def instance_prefix(self) -> str:
-        return (
-            f"{INSTANCE_PREFIX}/{self.component.namespace.name}/"
-            f"{self.component.name}/{self.name}/"
+        return instance_prefix(
+            self.component.namespace.name, self.component.name, self.name
         )
 
     async def serve_endpoint(

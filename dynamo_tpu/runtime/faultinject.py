@@ -39,6 +39,11 @@ point              hooked in                                  simulates
                    flip, ``wire`` = ``inject_blocks``         checksum plane
                    post-parse flip (covers pull, migration    must detect it
                    push, disagg import)                       before scatter
+``hub_shard_kill`` ``benchmarks/goodput.py`` ChaosFleet       one hub shard's
+                   (kills the victim shard's PRIMARY, holds   primary dies
+                   the window, then promotes its warm         mid-burst; the
+                   ``HubStandby`` onto the same address)      standby takes
+                                                              over the shard
 =================  =========================================  ==============
 
 ``tenant_flood`` is a *traffic* fault, not a transport one: the armed level
@@ -52,6 +57,16 @@ integrity plane (engine/integrity.py) — detection before any scatter,
 descendant drop + negative cache, byte-identical recompute fallback.
 Arm per plane (``kv_corrupt:disk``, ``kv_corrupt:host``,
 ``kv_corrupt:wire``) or ``kv_corrupt`` for all three.
+
+``hub_shard_kill`` is a *topology* fault, not an armed one: like the
+chaos ladder's real ``hub_outage`` kill, the L8 rung actually closes the
+victim shard's primary HubServer and later promotes its replication-fed
+standby (transports/hub.HubStandby) onto the same address — the system
+under test is the sharded control plane (transports/shard.py): per-shard
+park/replay, lease-floor preservation across the handoff, and the routed
+clients' degraded-mode routing cache.  Armed per-shard *outage* (drop
+connections without failover) is already expressible as
+``hub_outage:<shard address>``.
 
 Arming: programmatic (``faults.arm("connect_error", match=addr, count=2)``)
 or env-driven for subprocess workers — ``DYN_FAULTS`` is a comma-separated

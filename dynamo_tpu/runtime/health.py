@@ -48,6 +48,13 @@ logger = logging.getLogger(__name__)
 # quarantine decision must survive both the worker and the watchdog).
 QUARANTINE_PREFIX = "health/quarantine/"
 
+
+def quarantine_key(worker_id: int) -> str:
+    """Quarantine marker key for one worker (shard-map routed: DYN401)."""
+    from .transports.shard import hub_key  # lazy: shard imports hub only
+
+    return hub_key("health", "quarantine", worker_id)
+
 # Service-plane path every ServiceServer answers without registration.
 HEALTH_ENDPOINT = "__health__"
 
@@ -577,7 +584,7 @@ class HealthWatchdog:
         )
         try:
             await self.hub.kv_put(
-                f"{QUARANTINE_PREFIX}{rec.worker_id}",
+                quarantine_key(rec.worker_id),
                 {"state": "quarantined", "reason": rec.reason,
                  "address": rec.address},
             )
@@ -644,7 +651,7 @@ class HealthWatchdog:
                 logger.warning("eject: delete %s failed", key, exc_info=True)
         try:
             await self.hub.kv_put(
-                f"{QUARANTINE_PREFIX}{rec.worker_id}",
+                quarantine_key(rec.worker_id),
                 {"state": "ejected", "reason": rec.reason,
                  "address": rec.address},
             )
@@ -655,7 +662,7 @@ class HealthWatchdog:
 
     async def _clear_marker(self, worker_id: int) -> None:
         try:
-            await self.hub.kv_delete(f"{QUARANTINE_PREFIX}{worker_id}")
+            await self.hub.kv_delete(quarantine_key(worker_id))
         except asyncio.CancelledError:
             raise
         except Exception:  # noqa: BLE001

@@ -129,6 +129,10 @@ class HubState:
         # ack token → (queue name, item) for in-flight redelivery
         self._inflight: Dict[str, Tuple[str, Any]] = {}
         self._expiry_task: Optional[asyncio.Task] = None
+        # Replication taps: called (synchronously, on the owning loop) with
+        # one op-log entry per durable-state mutation — exactly the deltas
+        # a warm standby needs to keep a live copy of ``snapshot()``.
+        self._repl_taps: List[Callable[[Dict[str, Any]], None]] = []
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -155,18 +159,48 @@ class HubState:
             for lease in expired:
                 await self.lease_revoke(lease.id)
 
+    # -- replication ---------------------------------------------------------
+
+    def add_replication_tap(self, tap: Callable[[Dict[str, Any]], None]) -> None:
+        self._repl_taps.append(tap)
+
+    def remove_replication_tap(self, tap: Callable[[Dict[str, Any]], None]) -> None:
+        try:
+            self._repl_taps.remove(tap)
+        except ValueError:
+            pass
+
+    def _replicate(self, entry: Dict[str, Any]) -> None:
+        for notify in self._repl_taps:
+            notify(entry)
+
     # -- KV -----------------------------------------------------------------
 
     async def kv_put(self, key: str, value: Any, lease_id: Optional[int] = None) -> None:
         self._revision += 1
         self._kv[key] = value
+        # Rebinding a key to a different lease (or to none) must detach it
+        # from the previous lease, or that lease's later expiry would
+        # delete a key it no longer owns (the composite-lease re-grant
+        # path rebinds every registration onto a fresh lease).
+        old_lease = self._kv_lease.get(key)
+        if old_lease is not None and old_lease != lease_id:
+            if old_lease in self._leases:
+                self._leases[old_lease].keys.discard(key)
         if lease_id is not None:
             if lease_id not in self._leases:
                 raise KeyError(f"unknown lease {lease_id}")
             self._kv_lease[key] = lease_id
             self._leases[lease_id].keys.add(key)
+            if self._repl_taps:
+                # Lease-bound keys are NOT durable (snapshot() skips them:
+                # live workers re-register); a key that was durable and is
+                # now leased leaves the standby's durable view.
+                self._replicate({"op": "kv_delete", "key": key})
         else:
             self._kv_lease.pop(key, None)
+            if self._repl_taps:
+                self._replicate({"op": "kv_put", "key": key, "value": value})
         self._notify(WatchEvent("put", key, value))
 
     async def kv_get(self, key: str) -> Any:
@@ -182,6 +216,8 @@ class HubState:
         lease_id = self._kv_lease.pop(key, None)
         if lease_id is not None and lease_id in self._leases:
             self._leases[lease_id].keys.discard(key)
+        if self._repl_taps and lease_id is None:
+            self._replicate({"op": "kv_delete", "key": key})
         self._notify(WatchEvent("delete", key))
         return True
 
@@ -219,6 +255,10 @@ class HubState:
         lid = self._next_lease_id
         self._next_lease_id += 1
         self._leases[lid] = _Lease(lid, ttl, time.monotonic() + ttl)
+        if self._repl_taps:
+            # The standby tracks the id floor so a promoted shard never
+            # re-issues an id a pre-failover client still keeps alive.
+            self._replicate({"op": "lease_floor", "floor": self._next_lease_id})
         return lid
 
     async def lease_keepalive(self, lease_id: int) -> bool:
@@ -233,7 +273,10 @@ class HubState:
         if lease is None:
             return
         for key in list(lease.keys):
-            await self.kv_delete(key)
+            # Only delete keys STILL bound to this lease — a key rebound
+            # to a fresh lease since must survive the old one's expiry.
+            if self._kv_lease.get(key) == lease_id:
+                await self.kv_delete(key)
 
     # -- pub/sub ------------------------------------------------------------
 
@@ -265,17 +308,28 @@ class HubState:
             if not fut.done():
                 token = uuid.uuid4().hex
                 self._inflight[token] = (queue, item)
+                if self._repl_taps:
+                    self._replicate({
+                        "op": "q_add", "queue": queue, "item": item,
+                        "where": "inflight",
+                    })
                 fut.set_result(_QueueItem(item, token))
                 return
         self._queues.setdefault(queue, deque()).append(
             _QueueItem(item, uuid.uuid4().hex)
         )
+        if self._repl_taps:
+            self._replicate({
+                "op": "q_add", "queue": queue, "item": item, "where": "queue",
+            })
 
     async def q_pop(self, queue: str) -> _QueueItem:
         dq = self._queues.setdefault(queue, deque())
         if dq:
             qi = dq.popleft()
             self._inflight[qi.ack_token] = (queue, qi.item)
+            if self._repl_taps:
+                self._replicate({"op": "q_take", "queue": queue, "item": qi.item})
             return qi
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._q_waiters.setdefault(queue, deque()).append(fut)
@@ -292,7 +346,13 @@ class HubState:
             raise
 
     async def q_ack(self, token: str) -> bool:
-        return self._inflight.pop(token, None) is not None
+        entry = self._inflight.pop(token, None)
+        if entry is None:
+            return False
+        if self._repl_taps:
+            queue, item = entry
+            self._replicate({"op": "q_settle", "queue": queue, "item": item})
+        return True
 
     async def q_nack(self, token: str) -> bool:
         """Requeue an in-flight item (redelivery; consumer died/declined)."""
@@ -300,6 +360,8 @@ class HubState:
         if entry is None:
             return False
         queue, item = entry
+        if self._repl_taps:
+            self._replicate({"op": "q_settle", "queue": queue, "item": item})
         await self.q_push(queue, item)
         return True
 
@@ -613,6 +675,8 @@ class HubServer:
         session_subs: Dict[str, asyncio.Task] = {}
         session_unacked: Set[str] = set()
         session_pop_tasks: Set[asyncio.Task] = set()
+        session_repl_taps: List[Callable[[Dict[str, Any]], None]] = []
+        session_repl_tasks: Set[asyncio.Task] = set()
         write_lock = asyncio.Lock()
 
         async def send(obj: Any) -> None:
@@ -636,6 +700,11 @@ class HubServer:
             qi = await self.state.q_pop(queue)
             session_unacked.add(qi.ack_token)
             await send({"rid": rid, "ok": True, "item": qi.item, "token": qi.ack_token})
+
+        async def pump_oplog(q: asyncio.Queue):
+            while True:
+                entry = await q.get()
+                await send({"push": "oplog", "entry": entry})
 
         try:
             while True:
@@ -735,6 +804,21 @@ class HubServer:
                         await send({"rid": rid, "ok": await st.q_nack(msg["token"])})
                     elif op == "q_len":
                         await send({"rid": rid, "ok": True, "len": await st.q_len(msg["queue"])})
+                    elif op == "replica_attach":
+                        # Warm-standby replication: hand over a consistent
+                        # snapshot, then stream every durable mutation as
+                        # an op-log push.  Snapshot + tap registration are
+                        # one synchronous step on the loop, so no delta
+                        # can fall between them.
+                        oq: asyncio.Queue = asyncio.Queue()
+                        tap = oq.put_nowait
+                        snap = self.state.snapshot()
+                        self.state.add_replication_tap(tap)
+                        session_repl_taps.append(tap)
+                        await send({"rid": rid, "ok": True, "snapshot": snap})
+                        ot = asyncio.create_task(pump_oplog(oq))
+                        session_repl_tasks.add(ot)
+                        ot.add_done_callback(session_repl_tasks.discard)
                     elif op == "ping":
                         await send({"rid": rid, "ok": True})
                     else:
@@ -746,7 +830,11 @@ class HubServer:
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
         finally:
+            for tap in session_repl_taps:
+                self.state.remove_replication_tap(tap)
             for task in list(session_watches.values()) + list(session_subs.values()):
+                task.cancel()
+            for task in list(session_repl_tasks):
                 task.cancel()
             for task in session_pop_tasks:
                 task.cancel()
@@ -758,6 +846,165 @@ class HubServer:
                 await self.state.q_nack(token)
             writer.close()
             self._conn_tasks.discard(conn_task)
+
+
+# --------------------------------------------------------------------------
+# Warm standby (shard replication)
+# --------------------------------------------------------------------------
+
+
+class HubStandby:
+    """Warm standby for one hub shard.
+
+    Attaches to the primary's replication stream (``replica_attach``:
+    snapshot handover, then one op-log push per durable mutation) and
+    maintains a live copy of the primary's ``snapshot()`` — durable KV,
+    queued + in-flight work, and the lease-id floor.  On primary death,
+    ``promote()`` starts a fresh ``HubServer`` (by default on the dead
+    primary's address) restored from that copy: clients observe exactly a
+    hub restart — reconnect, re-arm watches with resync, leases re-grant —
+    and the preserved floor guarantees the promoted shard never re-issues
+    a lease id a pre-failover client still keeps alive.
+    """
+
+    def __init__(self, primary_address: str):
+        self.primary_address = primary_address
+        self._kv: Dict[str, Any] = {}
+        self._queues: Dict[str, List[Any]] = {}
+        self._inflight: List[List[Any]] = []  # [queue, item] pairs
+        self._lease_floor = 1
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._task: Optional[asyncio.Task] = None
+        # Set when the replication stream dies (primary gone) or on close.
+        self.primary_lost = asyncio.Event()
+        self.ops_applied = 0
+
+    async def start(self) -> "HubStandby":
+        host, port = self.primary_address.rsplit(":", 1)
+        self._reader, self._writer = await asyncio.open_connection(
+            host, int(port)
+        )
+        self._writer.write(
+            json.dumps({"rid": 1, "op": "replica_attach"}).encode() + b"\n"
+        )
+        await self._writer.drain()
+        line = await self._reader.readline()
+        if not line:
+            raise ConnectionError(
+                f"hub {self.primary_address} closed during replica_attach"
+            )
+        resp = json.loads(line)
+        if not resp.get("ok"):
+            raise RuntimeError(f"replica_attach refused: {resp!r}")
+        self._load_snapshot(resp.get("snapshot") or {})
+        self._task = asyncio.get_running_loop().create_task(self._run())
+        return self
+
+    def _load_snapshot(self, snap: Dict[str, Any]) -> None:
+        self._kv = dict(snap.get("kv") or {})
+        self._queues = {
+            name: list(items)
+            for name, items in (snap.get("queues") or {}).items()
+        }
+        self._inflight = [list(e) for e in (snap.get("inflight") or ())]
+        try:
+            self._lease_floor = int(snap.get("lease_floor", 1))
+        except (TypeError, ValueError):
+            self._lease_floor = 1
+
+    async def _run(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                line = await self._reader.readline()
+                if not line:
+                    break
+                msg = json.loads(line)
+                if msg.get("push") == "oplog":
+                    self._apply(msg.get("entry") or {})
+        except asyncio.CancelledError:
+            raise
+        except (ConnectionResetError, OSError, json.JSONDecodeError):
+            pass
+        finally:
+            self.primary_lost.set()
+
+    def _apply(self, entry: Dict[str, Any]) -> None:
+        op = entry.get("op")
+        if op == "kv_put":
+            self._kv[entry["key"]] = entry.get("value")
+        elif op == "kv_delete":
+            self._kv.pop(entry["key"], None)
+        elif op == "lease_floor":
+            try:
+                self._lease_floor = max(self._lease_floor, int(entry["floor"]))
+            except (KeyError, TypeError, ValueError):
+                pass
+        elif op == "q_add":
+            if entry.get("where") == "inflight":
+                self._inflight.append([entry["queue"], entry.get("item")])
+            else:
+                self._queues.setdefault(entry["queue"], []).append(
+                    entry.get("item")
+                )
+        elif op == "q_take":
+            items = self._queues.get(entry["queue"])
+            item = entry.get("item")
+            if items and item in items:
+                items.remove(item)
+            self._inflight.append([entry["queue"], item])
+        elif op == "q_settle":
+            pair = [entry["queue"], entry.get("item")]
+            if pair in self._inflight:
+                self._inflight.remove(pair)
+        self.ops_applied += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The shadow state in ``HubState.snapshot()`` schema."""
+        return {
+            "kv": dict(self._kv),
+            "queues": {
+                name: list(items)
+                for name, items in self._queues.items()
+                if items
+            },
+            "inflight": [list(e) for e in self._inflight],
+            "lease_floor": self._lease_floor,
+        }
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+    async def promote(
+        self,
+        host: Optional[str] = None,
+        port: Optional[int] = None,
+        persist_path: Optional[str] = None,
+        persist_interval_s: float = 2.0,
+    ) -> "HubServer":
+        """Take over the shard: start a HubServer restored from the shadow
+        state — on the dead primary's address unless told otherwise."""
+        await self.close()
+        p_host, p_port = self.primary_address.rsplit(":", 1)
+        server = HubServer(
+            host=host or p_host,
+            port=int(port if port is not None else p_port),
+            persist_path=persist_path,
+            persist_interval_s=persist_interval_s,
+        )
+        server.state.restore(self.snapshot())
+        await server.start()
+        return server
 
 
 # --------------------------------------------------------------------------
@@ -775,6 +1022,20 @@ class _SubSession:
         self.sid = sid
         self.pattern = pattern
         self.queue = queue
+
+
+class _ParkedEntry:
+    """One request parked on a down hub connection, with the bookkeeping
+    the park-buffer cap needs to shed oldest-idempotent-first."""
+
+    __slots__ = ("op", "size", "idempotent", "fut")
+
+    def __init__(self, op: str, size: int, idempotent: bool,
+                 fut: asyncio.Future):
+        self.op = op
+        self.size = size
+        self.idempotent = idempotent
+        self.fut = fut
 
 
 class HubClient:
@@ -802,6 +1063,13 @@ class HubClient:
     """
 
     RECONNECT_BACKOFF_INITIAL = 0.05
+    # Park-buffer caps: a long outage must pause the fleet, not grow client
+    # memory without bound.  When either cap is hit, the OLDEST IDEMPOTENT
+    # parked request is shed with a ConnectionError (idempotent callers
+    # already own retry paths; queue verbs are shed only as a last resort)
+    # and counted on /metrics (hub_shard_parked_shed_total).
+    PARK_MAX_REQUESTS = 512
+    PARK_MAX_BYTES = 4 << 20
 
     def __init__(
         self,
@@ -838,6 +1106,14 @@ class HubClient:
         self._connected = asyncio.Event()
         self._connected_at = 0.0
         self._closed = False
+        # Bounded park buffer: park id → entry (insertion-ordered).
+        self._parked: Dict[int, _ParkedEntry] = {}
+        self._park_ids = itertools.count(1)
+        self._park_bytes = 0
+
+    @property
+    def connected(self) -> bool:
+        return self._connected.is_set()
 
     async def connect(self) -> "HubClient":
         host, port = self.address.rsplit(":", 1)
@@ -966,6 +1242,8 @@ class HubClient:
             self._connected.set()
             self._connected_at = time.monotonic()
             metrics.hub_reconnects_total += 1
+            from .shard import shard_metrics
+            shard_metrics.note_reconnect(self.address)
             logger.info("hub connection to %s re-established", self.address)
             # Re-arm subscriptions onto their existing local queues: the
             # pub/sub plane is lossy by contract, so consumers keep their
@@ -1003,11 +1281,77 @@ class HubClient:
         "watch_cancel", "subscribe", "unsubscribe", "publish",
     })
 
+    def _shed_parked(self, incoming_size: int) -> None:
+        """Enforce the park-buffer caps before parking another request:
+        shed the oldest idempotent parked entry (then oldest of any kind)
+        until the incoming one fits."""
+        from .shard import shard_metrics
+
+        while self._parked and (
+            len(self._parked) + 1 > self.PARK_MAX_REQUESTS
+            or self._park_bytes + incoming_size > self.PARK_MAX_BYTES
+        ):
+            victim_id = None
+            for pid, entry in self._parked.items():
+                if entry.idempotent:
+                    victim_id = pid
+                    break
+            if victim_id is None:
+                victim_id = next(iter(self._parked))
+            entry = self._parked.pop(victim_id)
+            self._park_bytes -= entry.size
+            if not entry.fut.done():
+                entry.fut.set_exception(ConnectionError(
+                    f"parked {entry.op} shed: hub {self.address} park "
+                    f"buffer over cap ({self.PARK_MAX_REQUESTS} requests / "
+                    f"{self.PARK_MAX_BYTES} bytes)"
+                ))
+            shard_metrics.note_shed(self.address)
+
+    async def _park(self, op: str, size: int, budget: float) -> None:
+        """Park one request until the reconnect loop restores the
+        connection.  Raises ConnectionError if the park-buffer cap sheds
+        this entry, TimeoutError when the budget runs out first."""
+        from .shard import shard_metrics
+
+        self._shed_parked(size)
+        pid = next(self._park_ids)
+        entry = _ParkedEntry(
+            op=op,
+            size=size,
+            idempotent=op in self._IDEMPOTENT_OPS,
+            fut=asyncio.get_running_loop().create_future(),
+        )
+        self._parked[pid] = entry
+        self._park_bytes += size
+        shard_metrics.note_parked(self.address)
+        wait_task = asyncio.ensure_future(self._connected.wait())
+        try:
+            done, _ = await asyncio.wait(
+                {wait_task, entry.fut},
+                timeout=budget,
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            if entry.fut in done:
+                entry.fut.result()  # raises the shed ConnectionError
+            if not done:
+                raise asyncio.TimeoutError
+        finally:
+            wait_task.cancel()
+            if not entry.fut.done():
+                entry.fut.cancel()
+            if self._parked.pop(pid, None) is not None:
+                self._park_bytes -= entry.size
+
     async def _request(self, op: str, **kw) -> Dict[str, Any]:
+        from .shard import shard_metrics
+
         retryable = self.reconnect and op in self._IDEMPOTENT_OPS
         deadline = time.monotonic() + self.request_grace_s
         last_exc: Optional[BaseException] = None
         first = True
+        park_size = -1  # serialized lazily, only if this request parks
+        replaying = False
         while first or (retryable and time.monotonic() < deadline):
             first = False
             if self._closed:
@@ -1020,14 +1364,20 @@ class HubClient:
                     # parking would just sleep out the grace for nothing.
                     raise ConnectionError("hub connection lost")
                 # Hub down, reconnect in progress: park the caller so a hub
-                # restart pauses traffic instead of failing it.
+                # restart pauses traffic instead of failing it — within the
+                # bounded park buffer.
                 budget = deadline - time.monotonic()
                 if budget <= 0:
                     break
+                if park_size < 0:
+                    park_size = len(json.dumps({"op": op, **kw}, default=str))
                 try:
-                    await asyncio.wait_for(self._connected.wait(), budget)
+                    await self._park(op, park_size, budget)
                 except asyncio.TimeoutError:
                     break
+            if replaying:
+                shard_metrics.note_replayed(self.address)
+                replaying = False
             rid = next(self._rids)
             fut: asyncio.Future = asyncio.get_running_loop().create_future()
             self._pending[rid] = fut
@@ -1046,6 +1396,7 @@ class HubClient:
                 self._pending.pop(rid, None)
                 last_exc = e
                 if retryable:
+                    replaying = True
                     await asyncio.sleep(random.uniform(0.02, 0.1))
                 continue
             if not msg.get("ok") and op not in (

@@ -1,0 +1,331 @@
+"""Sharded-hub tests (ISSUE 16): shard-map routing invariants, per-shard
+park/replay while a sibling shard is down, warm-standby promotion with the
+lease floor intact, park-buffer shed caps, composite leases, and the edge
+surfaces (/health shard table, /metrics hub_shard block).
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.transports.hub import (
+    HubClient,
+    HubServer,
+    HubSessionLost,
+    HubStandby,
+)
+from dynamo_tpu.runtime.transports.shard import (
+    CrossShardError,
+    ShardedHubClient,
+    ShardMap,
+    hub_key,
+    hub_prefix,
+    hub_subject,
+)
+
+# -- routing invariants (pure, no IO) ----------------------------------------
+
+
+def test_same_token_same_shard():
+    """Everything built from one routing token lands on one shard: keys,
+    prefixes and subjects — the invariant that keeps a prefix watch whole."""
+    smap = ShardMap(["a:1", "b:2", "c:3"])
+    for token in ("instances", "models", "prefill", "health", "planner"):
+        shard = smap.shard_of_token(token)
+        assert smap.shard_for_key(hub_key(token, "x")) == shard
+        assert smap.shard_for_key(hub_key(token, "x", "y", 7)) == shard
+        assert smap.shard_for_prefix(hub_prefix(token)) == shard
+        assert smap.shard_for_prefix(hub_prefix(token, "x")) == shard
+        assert smap.shard_for_subject(hub_subject(token, "t")) == shard
+
+
+def test_single_shard_is_wire_compatible():
+    """A one-address spec accepts every key/prefix/pattern (shard 0), so
+    today's single-hub deployments keep working unchanged."""
+    smap = ShardMap.parse("a:1")
+    assert len(smap) == 1
+    assert smap.shard_for_key("anything/at/all") == 0
+    assert smap.shard_for_prefix("inst") == 0  # un-pinned prefix: fine at n=1
+    assert smap.shard_for_subject("*.kv_events") == 0
+
+
+def test_cross_shard_prefixes_rejected_loudly():
+    smap = ShardMap.parse("a:1,b:2")
+    with pytest.raises(CrossShardError):
+        smap.shard_for_prefix("inst")  # no '/': routing token not pinned
+    with pytest.raises(CrossShardError):
+        smap.shard_for_subject("*.kv_events")
+    with pytest.raises(CrossShardError):
+        smap.shard_for_subject(">")
+
+
+def test_builders_reject_empty_routing_token():
+    with pytest.raises(ValueError):
+        hub_key("")
+    with pytest.raises(ValueError):
+        hub_key()
+    with pytest.raises(ValueError):
+        hub_subject("")
+
+
+def test_spec_roundtrip_is_stable():
+    """Routing is part of the map identity: the spec string round-trips and
+    every process parsing it routes identically."""
+    smap = ShardMap.parse("a:1, b:2 ,c:3")
+    assert smap.spec == "a:1,b:2,c:3"
+    again = ShardMap.parse(smap.spec)
+    for token in ("instances", "w", "prefill", "mdc"):
+        assert again.shard_of_token(token) == smap.shard_of_token(token)
+
+
+# -- live 2-shard fixtures ----------------------------------------------------
+
+
+async def shard_pair():
+    hubs = [await HubServer().start() for _ in range(2)]
+    smap = ShardMap([h.address for h in hubs])
+    client = await ShardedHubClient(smap.spec).connect()
+    return hubs, smap, client
+
+
+def keys_per_shard(smap: ShardMap) -> dict:
+    """One key owned by each shard (crc32 routing is deterministic)."""
+    keys: dict = {}
+    i = 0
+    while len(keys) < len(smap):
+        k = hub_key(f"t{i}", "x")
+        keys.setdefault(smap.shard_for_key(k), k)
+        i += 1
+    return keys
+
+
+async def close_all(client, hubs, standby=None):
+    await client.close()
+    if standby is not None:
+        await standby.close()
+    for h in hubs:
+        try:
+            await h.close()
+        except Exception:  # noqa: BLE001 — already-dead primary
+            pass
+
+
+@pytest.mark.asyncio
+async def test_shard_outage_parks_only_its_own_keys():
+    """One dead shard parks exactly the traffic it owns; the sibling never
+    blips; promotion replays the parked put (the L8 contract in miniature)."""
+    hubs, smap, client = await shard_pair()
+    standby = await HubStandby(hubs[0].address).start()
+    try:
+        keys = keys_per_shard(smap)
+        await client.kv_put(keys[0], "a")
+        await client.kv_put(keys[1], "b")
+        await hubs[0].close()
+        put = asyncio.ensure_future(client.kv_put(keys[0], "a2"))
+        await asyncio.sleep(0.25)
+        assert not put.done()  # parked on the dead shard
+        # Sibling-owned traffic flows through the outage.
+        assert await client.kv_get(keys[1]) == "b"
+        await client.kv_put(keys[1], "b2")
+        assert await client.kv_get(keys[1]) == "b2"
+        hubs[0] = await standby.promote()
+        standby = None
+        await asyncio.wait_for(put, 10)
+        assert await client.kv_get(keys[0]) == "a2"
+    finally:
+        await close_all(client, hubs, standby)
+
+
+@pytest.mark.asyncio
+async def test_standby_promotion_preserves_lease_floor():
+    """The promoted shard may never re-issue a lease id a dead primary
+    already handed out — the floor replicates even though leases don't."""
+    primary = await HubServer().start()
+    standby = await HubStandby(primary.address).start()
+    client = await HubClient(primary.address).connect()
+    promoted = None
+    try:
+        for _ in range(3):
+            await client.lease_grant(ttl=30.0)
+        floor = primary.state._next_lease_id
+        await client.kv_put("durable/x", 1)
+        await client.close()
+        await primary.close()
+        promoted = await standby.promote()
+        standby = None
+        assert promoted.state._next_lease_id >= floor
+        c2 = await HubClient(promoted.address).connect()
+        try:
+            assert await c2.kv_get("durable/x") == 1
+            lease = await c2.lease_grant(ttl=30.0)
+            assert lease >= floor  # no collision with pre-failover grants
+        finally:
+            await c2.close()
+    finally:
+        if standby is not None:
+            await standby.close()
+        for server in (promoted, primary):
+            if server is not None:
+                try:
+                    await server.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+
+@pytest.mark.asyncio
+async def test_watch_rearm_after_shard_failover():
+    """Watches cannot resume transparently across a failover (deltas were
+    missed): the live watcher raises HubSessionLost and a fresh watch gets
+    the promoted shard's snapshot — same recovery path as a hub restart."""
+    hubs, smap, client = await shard_pair()
+    standby = await HubStandby(hubs[0].address).start()
+    try:
+        keys = keys_per_shard(smap)
+        prefix = hub_prefix(keys[0].split("/", 1)[0])
+        await client.kv_put(keys[0], 1)
+        watcher = await client.watch_prefix(prefix)
+        ev = await asyncio.wait_for(watcher.__anext__(), 2)
+        assert (ev.type, ev.key, ev.value) == ("put", keys[0], 1)
+        await hubs[0].close()
+        hubs[0] = await standby.promote()
+        standby = None
+        with pytest.raises(HubSessionLost):
+            await asyncio.wait_for(watcher.__anext__(), 5)
+        watcher2 = await client.watch_prefix(prefix)
+        ev = await asyncio.wait_for(watcher2.__anext__(), 5)
+        assert (ev.type, ev.key, ev.value) == ("put", keys[0], 1)
+    finally:
+        await close_all(client, hubs, standby)
+
+
+@pytest.mark.asyncio
+async def test_composite_lease_spans_shards():
+    """One local lease id binds keys on every shard; revoke clears both."""
+    hubs, smap, client = await shard_pair()
+    try:
+        keys = keys_per_shard(smap)
+        lease = await client.lease_grant(ttl=5.0)
+        await client.kv_put(keys[0], "x", lease_id=lease)
+        await client.kv_put(keys[1], "y", lease_id=lease)
+        assert await client.lease_keepalive(lease) is True
+        await client.lease_revoke(lease)
+        assert await client.kv_get(keys[0]) is None
+        assert await client.kv_get(keys[1]) is None
+        assert await client.lease_keepalive(lease) is False
+    finally:
+        await close_all(client, hubs)
+
+
+@pytest.mark.asyncio
+async def test_client_rejects_cross_shard_watch_and_subscribe():
+    hubs, smap, client = await shard_pair()
+    try:
+        with pytest.raises(CrossShardError):
+            await client.watch_prefix("inst")
+        with pytest.raises(CrossShardError):
+            await client.subscribe("*.kv_events")
+    finally:
+        await close_all(client, hubs)
+
+
+@pytest.mark.asyncio
+async def test_queue_tokens_route_back_to_owner_shard():
+    """Ack tokens are shard-wrapped so ack/nack find the owning shard."""
+    hubs, smap, client = await shard_pair()
+    try:
+        q = hub_key("prefill", "m")
+        await client.q_push(q, {"r": 1})
+        item, token = await client.q_pop(q)
+        assert item == {"r": 1}
+        assert ":" in token
+        assert await client.q_ack(token) is True
+        assert await client.q_len(q) == 0
+    finally:
+        await close_all(client, hubs)
+
+
+@pytest.mark.asyncio
+async def test_park_buffer_sheds_oldest_idempotent():
+    """Past the park cap the OLDEST idempotent parked request is shed with
+    ConnectionError — a long outage pauses the fleet, it never grows client
+    memory without bound."""
+    server = await HubServer().start()
+    client = await HubClient(server.address).connect()
+    client.PARK_MAX_REQUESTS = 2
+    puts: list = []
+    try:
+        await client.kv_put("p/seed", 0)
+        await server.close()
+        await asyncio.sleep(0.1)  # let the client observe the loss
+        puts = [
+            asyncio.ensure_future(client.kv_put(f"p/{i}", i))
+            for i in range(4)
+        ]
+        await asyncio.sleep(0.3)
+        done = [p for p in puts if p.done()]
+        assert done == puts[:2]  # oldest-first shed; newest two still parked
+        for p in done:
+            with pytest.raises(ConnectionError):
+                p.result()
+    finally:
+        await client.close()
+        await asyncio.gather(*puts, return_exceptions=True)
+        try:
+            await server.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+@pytest.mark.asyncio
+async def test_shard_health_reports_per_shard():
+    hubs, smap, client = await shard_pair()
+    try:
+        health = client.shard_health()
+        assert [s["connected"] for s in health] == [True, True]
+        assert [s["shard"] for s in health] == [h.address for h in hubs]
+        await hubs[0].close()
+        await asyncio.sleep(0.15)
+        health = client.shard_health()
+        assert health[0]["connected"] is False
+        assert health[1]["connected"] is True
+    finally:
+        await close_all(client, hubs)
+
+
+@pytest.mark.asyncio
+async def test_edge_health_and_metrics_surface_shards():
+    """/health carries the per-shard table (degraded on a down shard) and
+    /metrics carries the dynamo_tpu_hub_shard_* block."""
+    from aiohttp import ClientSession
+
+    from dynamo_tpu.llm import HttpService
+
+    hubs, smap, client = await shard_pair()
+    service = HttpService(host="127.0.0.1", port=0, hub=client)
+    await service.start()
+    try:
+        base = f"http://127.0.0.1:{service.port}"
+        async with ClientSession() as http:
+            async with http.get(f"{base}/health") as r:
+                body = await r.json()
+                assert body["status"] == "ok"
+                assert [s["connected"] for s in body["hub_shards"]] == [
+                    True, True,
+                ]
+            await hubs[0].close()
+            await asyncio.sleep(0.15)
+            async with http.get(f"{base}/health") as r:
+                body = await r.json()
+                assert body["status"] == "degraded"
+                assert [s["connected"] for s in body["hub_shards"]] == [
+                    False, True,
+                ]
+            async with http.get(f"{base}/metrics") as r:
+                text = await r.text()
+                assert "dynamo_tpu_hub_shard_connects_total" in text
+                assert "dynamo_tpu_hub_shard_failovers_total" in text
+                assert ("dynamo_tpu_hub_shard_routing_cache_staleness_seconds"
+                        in text)
+    finally:
+        await service.close()
+        await close_all(client, hubs)

@@ -124,9 +124,42 @@ HUB_KEY_SINK_TAILS = {
     "watch_prefix",
     "q_push",
     "q_pop",
+    "q_len",
     "queue_push",
     "publish",
     "subscribe",
+}
+
+# Hub key/subject BUILDERS (DYN401): the sanctioned constructors every hub
+# key/subject must route through so the shard map (runtime/transports/
+# shard.py) can own routing — an ad-hoc f-string/concatenation at a hub
+# sink bypasses the routing contract (and the staleness/park accounting
+# keyed on it) and is a finding.  Each entry names a helper that builds
+# its keys via hub_key/hub_prefix/hub_subject (or IS one of them).
+HUB_KEY_BUILDER_TAILS = {
+    # canonical builders (runtime/transports/shard.py)
+    "hub_key",
+    "hub_prefix",
+    "hub_subject",
+    # discovery plane (runtime/component.py)
+    "instance_key",
+    "instance_prefix",
+    "endpoint_path",
+    "subject",  # Namespace.subject / Component.subject
+    # health plane (runtime/health.py)
+    "quarantine_key",
+    # model discovery / cards (llm/discovery.py, llm/model_card.py)
+    "model_key",
+    "model_prefix",
+    "mdc_key",
+    # deployments (deploy/api_store.py)
+    "deployment_key",
+    # planner actuation (planner/actuate.py)
+    "target_key",
+    "role_key",
+    # disaggregated serving (llm/disagg/)
+    "disagg_config_key",
+    "prefill_queue_name",
 }
 
 # Calls that are *safe enough* in a label position for DYN204 even though

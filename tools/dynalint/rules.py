@@ -20,6 +20,7 @@ from .core import (
     dotted_name,
     iter_names,
 )
+from .registry import HUB_KEY_BUILDER_TAILS, HUB_KEY_SINK_TAILS
 
 # DYN001-007 run in the per-file FileChecker below; DYN1xx/2xx/3xx are the
 # 2.0 corpus passes (rules_race / rules_taint / rules_schema) built on the
@@ -45,6 +46,7 @@ ALL_RULES = (
     "DYN304",
     "DYN305",
     "DYN306",
+    "DYN401",
 )
 
 RULE_TITLES = {
@@ -67,6 +69,7 @@ RULE_TITLES = {
     "DYN304": "SequenceState field not threaded through SequenceSnapshot",
     "DYN305": "setdefault on a nullable wire key (null skips the rewrite)",
     "DYN306": "pytree treedef stability: frozen prefix / trailing defaults",
+    "DYN401": "ad-hoc hub key construction bypasses shard routing",
 }
 
 # DYN001 — calls that park the whole event loop.  Dotted names only: a bare
@@ -107,6 +110,10 @@ JIT_HOST_DOTTED = {
     "time.perf_counter",
 }
 JIT_HOST_TAILS = {"item", "tolist"}
+
+# DYN401 — keyword names that carry a hub key/subject at a sink call when
+# it is not the first positional argument.
+HUB_KEY_ARG_KWARGS = ("key", "prefix", "subject", "queue", "pattern")
 
 # DYN006 — request-scoped values that must thread through the call graph.
 FORWARD_PARAMS = ("ctx", "deadline")
@@ -303,6 +310,40 @@ class FileChecker:
             )
         if self._jit_depth > 0:
             self._check_call_dyn007(call, dotted, tail)
+        if tail in HUB_KEY_SINK_TAILS:
+            self._check_call_dyn401(call, tail)
+
+    def _check_call_dyn401(self, call: ast.Call, tail: str) -> None:
+        """Hub key/subject arguments must route through a sanctioned builder
+        (registry.HUB_KEY_BUILDER_TAILS) so the shard map owns routing: an
+        f-string or ``+``-concatenation at the sink hard-codes a layout the
+        shard hash never sees, and an unregistered helper call hides one."""
+        arg: Optional[ast.AST] = call.args[0] if call.args else None
+        if arg is None:
+            for kw in call.keywords:
+                if kw.arg in HUB_KEY_ARG_KWARGS:
+                    arg = kw.value
+                    break
+        if arg is None:
+            return
+        offender = None
+        if isinstance(arg, (ast.JoinedStr, ast.BinOp)):
+            offender = (
+                "f-string" if isinstance(arg, ast.JoinedStr) else "concatenation"
+            )
+        elif isinstance(arg, ast.Call):
+            _, arg_tail = call_target(arg)
+            if arg_tail not in HUB_KEY_BUILDER_TAILS:
+                offender = f"unregistered helper `{arg_tail}()`"
+        if offender:
+            self._emit(
+                "DYN401",
+                call,
+                f"ad-hoc hub key at `{tail}()` ({offender}) bypasses the "
+                "shard map — build the key via hub_key/hub_prefix/"
+                "hub_subject (or a helper registered in "
+                "HUB_KEY_BUILDER_TAILS)",
+            )
 
     def _check_call_dyn007(
         self, call: ast.Call, dotted: Optional[str], tail: Optional[str]

@@ -296,6 +296,92 @@ async def sweep_chaos() -> list:
     return rows
 
 
+async def sweep_shards() -> list:
+    """``hub_shard_kill`` against a real 2-shard hub (ISSUE 16 / ladder L8):
+    a warm ``HubStandby`` follows the shard that owns the ``instances``
+    routing token (the same victim the L8 rung picks), the victim's primary
+    is actually closed mid-put, and the standby promotes onto the same
+    address.  Bars: the owner-shard put parks and lands, the sibling shard
+    never blips, the lease floor carries across the handoff, and the
+    composite lease breaks (the owner must re-grant, like a hub restart)."""
+    from dynamo_tpu.runtime import HubStandby, ShardMap, hub_key
+    from dynamo_tpu.runtime.transports.shard import ShardedHubClient
+
+    rows = []
+    hubs = [await HubServer().start() for _ in range(2)]
+    smap = ShardMap([h.address for h in hubs])
+    victim = smap.shard_of_token("instances")
+    sibling = 1 - victim
+    standby = await HubStandby(hubs[victim].address).start()
+    client = await ShardedHubClient(smap.spec).connect()
+    try:
+        # One key owned by each shard (crc32 routing is stable per spec).
+        keys: dict = {}
+        i = 0
+        while len(keys) < 2:
+            k = hub_key(f"sweep{i}", "x")
+            keys.setdefault(smap.shard_for_key(k), k)
+            i += 1
+        await client.kv_put(keys[victim], "before")
+        await client.kv_put(keys[sibling], "before")
+        lease = await client.lease_grant(ttl=30.0)
+        floor_before = hubs[victim].state._next_lease_id
+
+        await hubs[victim].close()  # the shard's primary really dies
+        put = asyncio.ensure_future(client.kv_put(keys[victim], "after"))
+        await asyncio.sleep(0.3)
+        parked = not put.done()
+        # The sibling shard owns its keys outright: reads mid-outage.
+        sibling_ok = (await client.kv_get(keys[sibling])) == "before"
+
+        hubs[victim] = await standby.promote()
+        standby = None
+        try:
+            await asyncio.wait_for(put, 10.0)
+            landed = (await client.kv_get(keys[victim])) == "after"
+        except Exception:  # noqa: BLE001 — observation, not assertion
+            put.cancel()
+            landed = False
+        floor_after = hubs[victim].state._next_lease_id
+        # Leases are deliberately NOT replicated (only the floor is): the
+        # promoted shard must report the composite lease dead so the owner
+        # re-grants, and must never re-issue an id below the floor.
+        broken = not await client.lease_keepalive(lease)
+        observed = (
+            ("owner-shard kv_put parked through the kill" if parked
+             else "UNEXPECTED: kv_put completed against a dead shard")
+            + ("; sibling shard served reads mid-outage" if sibling_ok
+               else "; UNEXPECTED: sibling shard blipped")
+            + ("; put landed after standby promotion" if landed
+               else "; UNEXPECTED: put did not land after failover")
+            + (f"; lease floor carried ({floor_before}->{floor_after})"
+               if floor_after >= floor_before else
+               f"; UNEXPECTED: lease floor regressed "
+               f"({floor_before}->{floor_after})")
+            + ("; composite lease broken (owner re-grants)" if broken
+               else "; UNEXPECTED: composite lease outlived the shard's "
+                    "lease state")
+        )
+        rows.append({
+            "fault": "hub_shard_kill",
+            "injected_at": "one hub shard's primary (real HubServer close + "
+                           "HubStandby promotion onto the same address; the "
+                           "ChaosFleet L8 flavour)",
+            "observed": observed,
+            "status": "paused on the dead shard, then 200",
+        })
+    finally:
+        await client.close()
+        if standby is not None:
+            await standby.close()
+        for hub in hubs:
+            try:
+                await hub.close()
+            except Exception:  # noqa: BLE001 — already-dead primary
+                pass
+    return rows
+
+
 async def sweep_engine() -> list:
     """kv_pressure against a real (tiny) engine: admission stalls while the
     pool is squeezed and drains after.  Costs one XLA compile; opt-in."""
@@ -550,8 +636,8 @@ async def main() -> int:
                     help="include the kv_pressure sweep (builds a real engine)")
     args = ap.parse_args()
 
-    rows = (await sweep_runtime() + await sweep_chaos() + await sweep_http()
-            + await sweep_integrity())
+    rows = (await sweep_runtime() + await sweep_chaos() + await sweep_shards()
+            + await sweep_http() + await sweep_integrity())
     if args.engine:
         rows += await sweep_engine()
     md = to_markdown(rows)
