@@ -128,6 +128,12 @@ class DiskKvStore:
     def _path(self, seq_hash: int) -> str:
         return os.path.join(self.directory, f"{seq_hash:016x}.kvblk")
 
+    def _tmp_path(self, final: str) -> str:
+        """Staging path for the atomic write protocol: bytes land in
+        ``<final>.tmp`` and are ``os.replace``d into place on success or
+        ``os.remove``d on failure (dynalint DYN501 tracks this pair)."""
+        return final + ".tmp"
+
     # Reads are deliberately LOCK-FREE: the main lock is held across file
     # I/O by executor threads, and the EVENT LOOP calls contains()/
     # block_nbytes() on hot paths (kv_manager.tier_lookup at eviction,
@@ -213,7 +219,7 @@ class DiskKvStore:
                 except OSError:
                     pass
             path = self._path(seq_hash)
-            tmp = path + ".tmp"
+            tmp = self._tmp_path(path)
             try:
                 with open(tmp, "wb") as f:
                     f.write(blob)

@@ -62,7 +62,10 @@ class DefaultWorkerSelector:
     """The reference cost function (scheduler.rs:236-340)."""
 
     def __init__(self, rng: Optional[random.Random] = None):
-        self._rng = rng or random.Random()
+        # Seeded by default: tie-breaks must replay identically run-to-run
+        # (router decisions feed the sim/replay planes); callers that want
+        # spread pass their own generator.
+        self._rng = rng or random.Random(0)
 
     def select(self, request: SchedulingRequest) -> Optional[WorkerId]:
         if not request.workers:

@@ -258,13 +258,17 @@ class AdapterRegistry:
     """
 
     def __init__(self, max_resident: int, max_rank: int, apply_fn: ApplyFn,
-                 promote_timeout_s: float = 30.0):
+                 promote_timeout_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
         if max_resident < 1:
             raise ValueError("lora max_adapters must be >= 1")
         self.max_resident = max_resident
         self.max_rank = max_rank
         self._apply = apply_fn
         self.promote_timeout_s = promote_timeout_s
+        # Injectable clock: promotion deadlines must be testable without
+        # real waiting and identical under sim/replay.
+        self._clock = clock
         self._adapters: Dict[str, LoraAdapter] = {}
         self._slot_of: Dict[str, int] = {}  # resident name → slot
         self._owner: List[Optional[str]] = [None] * max_resident
@@ -345,7 +349,7 @@ class AdapterRegistry:
         promotion timeout."""
         if name not in self._adapters:
             raise KeyError(name)
-        deadline = time.monotonic() + self.promote_timeout_s
+        deadline = self._clock() + self.promote_timeout_s
         while True:
             # Serialize claims so two concurrent acquires cannot race one
             # slot; the H2D promotion happens inside the claim.
@@ -376,7 +380,7 @@ class AdapterRegistry:
                     tenancy_metrics.adapter_promotions += 1
                     return slot
                 self._freed.clear()
-            timeout = deadline - time.monotonic()
+            timeout = deadline - self._clock()
             if timeout <= 0:
                 raise AdapterCapacityError(
                     f"all {self.max_resident} adapter slots are pinned by "

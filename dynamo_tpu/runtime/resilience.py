@@ -99,11 +99,15 @@ class RetryPolicy:
     max_attempts: int = 3
     base_delay_s: float = 0.05
     max_delay_s: float = 2.0
+    # Injectable jitter source: seeded chaos-ladder / sim runs pass a
+    # random.Random(seed) so backoff schedules replay exactly; production
+    # keeps full-jitter from the process RNG.
+    rng: Optional[random.Random] = None
 
     def backoff(self, attempt: int) -> float:
         """Sleep before retry ``attempt`` (1-based): rand(0, min(cap, base·2ⁿ))."""
         cap = min(self.max_delay_s, self.base_delay_s * (2 ** max(attempt - 1, 0)))
-        return random.uniform(0.0, cap)
+        return (self.rng or random).uniform(0.0, cap)
 
     @classmethod
     def from_config(cls, cfg: Optional[Mapping[str, Any]]) -> "RetryPolicy":

@@ -596,6 +596,8 @@ FAMILY_RULES = {
     "1": {"DYN101", "DYN102"},
     "2": {"DYN201", "DYN202", "DYN203", "DYN204"},
     "3": {"DYN301", "DYN302", "DYN303", "DYN304", "DYN305", "DYN306"},
+    "5": {"DYN501", "DYN502", "DYN503", "DYN504"},
+    "6": {"DYN601", "DYN602", "DYN603", "DYN604"},
 }
 
 
@@ -613,8 +615,8 @@ def _fixture_cases():
 
 
 def test_fixture_corpus():
-    """Every offending/clean/suppressed fixture — including the five
-    historical-bug fixtures minimized from CHANGES.md PR 6/7/8 review
+    """Every offending/clean/suppressed fixture — including the
+    historical-bug fixtures minimized from CHANGES.md PR 4-11 review
     findings — behaves exactly as its header declares."""
     names = set()
     for name, src, expect, rules in _fixture_cases():
@@ -626,16 +628,17 @@ def test_fixture_corpus():
             f"  {f.rule} {f.line}: {f.message}" for f in found
         )
     # every new family ships offending+clean+suppressed AND >=1 historical
-    for fam in ("1", "2", "3"):
+    for fam in ("1", "2", "3", "5", "6"):
         assert any(n.startswith(f"dyn{fam}") and "offending" in n for n in names)
         assert any(n.startswith(f"dyn{fam}") and "clean" in n for n in names)
         assert any(n.startswith(f"dyn{fam}") and "suppressed" in n for n in names)
     hist = {n for n in names if n.startswith("hist_")}
-    assert len(hist) >= 3
+    assert len(hist) >= 6
     hist_rules = {
         expect for n, _s, expect, _r in _fixture_cases() if n.startswith("hist_")
     }
-    assert {r[3] for r in hist_rules} == {"1", "2", "3"}  # one per family
+    # at least one historical fixture per shipped family
+    assert {r[3] for r in hist_rules} == {"1", "2", "3", "5", "6"}
 
 
 # ---------------------------------------------------------------- DYN101
@@ -796,6 +799,10 @@ def test_timings_out_param():
     timings = {}
     analyze_sources([("x.py", "def f():\n    pass\n")], timings=timings)
     assert "total" in timings and "DYN001-007" in timings
+    # per-family wall-clock entries for the corpus passes (--json surfaces
+    # these so a slow family is attributable)
+    for fam in ("DYN1xx", "DYN2xx", "DYN3xx", "DYN5xx", "DYN6xx"):
+        assert fam in timings
     assert timings["total"] >= 0
 
 
@@ -895,14 +902,14 @@ def test_cli_changed_only_against_head(tmp_path):
 
 
 def test_gate_new_families_have_empty_baseline():
-    """ISSUE 9 discipline: every DYN1xx/2xx/3xx true positive was fixed
-    in-PR; the committed baseline must hold ZERO entries for the new
-    families (and stay within the global 10-entry debt cap)."""
+    """ISSUE 9/17 discipline: every DYN1xx/2xx/3xx/5xx/6xx true positive
+    was fixed in-PR; the committed baseline must hold ZERO entries for
+    these families (and stay within the global 10-entry debt cap)."""
     baseline = load_baseline(DEFAULT_BASELINE)
     new_family = [
         e
         for e in baseline.values()
-        if e.get("rule", "").startswith(("DYN1", "DYN2", "DYN3"))
+        if e.get("rule", "").startswith(("DYN1", "DYN2", "DYN3", "DYN5", "DYN6"))
     ]
     assert new_family == []
 
@@ -915,3 +922,460 @@ def test_fixture_dir_not_in_gate_scope():
 
     files = collect_files(["dynamo_tpu"], REPO_ROOT)
     assert files and not any("fixtures" in f.parts for f in files)
+
+
+# ======================================================================
+# dynalint 3.0 — DYN5xx resource lifetime, DYN6xx compile stability
+# ======================================================================
+
+
+# ---------------------------------------------------------------- DYN501
+
+
+def test_dyn501_exception_edge_covered_by_handler():
+    # A handler that frees + reraises covers the risky span: the nominal
+    # release stays on the fall-through path (the transfer.py fix shape).
+    src = (
+        "class Pool:\n"
+        "    async def stage(self, n):\n"
+        "        bids = self.kv.allocate_sequence(n)\n"
+        "        try:\n"
+        "            await self.wire.push_all(bids)\n"
+        "        except BaseException:\n"
+        "            self.kv.free_sequence(bids)\n"
+        "            raise\n"
+        "        self.kv.free_sequence(bids)\n"
+    )
+    assert lint(src, "DYN501") == []
+
+
+def test_dyn501_handler_only_release_flags_nominal_leak():
+    src = (
+        "class Pool:\n"
+        "    async def stage(self, n):\n"
+        "        bids = self.kv.allocate_sequence(n)\n"
+        "        try:\n"
+        "            await self.wire.push_all(bids)\n"
+        "        except Exception:\n"
+        "            self.kv.free_sequence(bids)\n"
+        "            raise\n"
+    )
+    found = lint(src, "DYN501")
+    assert rules_of(found) == ["DYN501"]
+    assert "exception path" in found[0].message
+
+
+def test_dyn501_never_released():
+    # `track` is neither a release, a custody sink, nor a constructor:
+    # the handle is borrowed and the function keeps the obligation.
+    src = (
+        "class Pool:\n"
+        "    def grab(self, n):\n"
+        "        bid = self.kv.allocate_block(n)\n"
+        "        self.track(bid)\n"
+    )
+    found = lint(src, "DYN501")
+    assert rules_of(found) == ["DYN501"]
+    assert "never reaches" in found[0].message
+
+
+def test_dyn501_dropped_result():
+    src = (
+        "class Pool:\n"
+        "    def grab(self, n):\n"
+        "        self.kv.allocate_block(n)\n"
+    )
+    found = lint(src, "DYN501")
+    assert rules_of(found) == ["DYN501"]
+    assert "discarded" in found[0].message
+
+
+def test_dyn501_transfer_seal_stands_down():
+    src = (
+        "class Sealer:\n"
+        "    def seal(self, n):\n"
+        "        bid = self.kv.allocate_block(n)\n"
+        "        self.kv.seal_block(bid)\n"
+    )
+    assert lint(src, "DYN501") == []
+
+
+def test_dyn501_transfer_wire_send_stands_down():
+    # hub leases minted FOR remote clients: shipping the id over the wire
+    # hands the renew/revoke obligation to the client (registered transfer).
+    src = (
+        "class Hub:\n"
+        "    async def grant(self, conn):\n"
+        "        lid = self.store.lease_grant(ttl=30)\n"
+        "        await conn.send({'lease': lid})\n"
+    )
+    assert lint(src, "DYN501") == []
+
+
+def test_dyn501_constructor_custody_stands_down():
+    # the _RemoteStreamIter idiom: the wrapper object owns the handle and
+    # releases it in its own aclose().
+    src = (
+        "class Svc:\n"
+        "    def open(self, worker):\n"
+        "        sid = self.mux.open_stream(worker)\n"
+        "        return _StreamIter(self.mux, sid)\n"
+    )
+    assert lint(src, "DYN501") == []
+
+
+def test_dyn501_risky_before_constructor_handoff_still_flags():
+    src = (
+        "class Svc:\n"
+        "    async def open(self, worker):\n"
+        "        sid = self.mux.open_stream(worker)\n"
+        "        await self.mux.handshake(sid)\n"
+        "        return _StreamIter(self.mux, sid)\n"
+    )
+    found = lint(src, "DYN501")
+    assert rules_of(found) == ["DYN501"]
+    assert "exception here" in found[0].message
+
+
+def test_dyn501_custody_sink_append_stands_down():
+    src = (
+        "class Svc:\n"
+        "    def open_all(self, workers):\n"
+        "        out = []\n"
+        "        for w in workers:\n"
+        "            sid = self.mux.open_stream(w)\n"
+        "            out.append(sid)\n"
+        "        return out\n"
+    )
+    assert lint(src, "DYN501") == []
+
+
+def test_dyn501_guarded_none_return_is_not_early_return():
+    src = (
+        "class Pool:\n"
+        "    async def reserve(self, n):\n"
+        "        bids = self.kv.allocate_sequence(n)\n"
+        "        if bids is None:\n"
+        "            return None\n"
+        "        self.kv.free_sequence(bids)\n"
+        "        return True\n"
+    )
+    assert lint(src, "DYN501") == []
+
+
+def test_dyn501_unguarded_early_return_leaks():
+    src = (
+        "class Pool:\n"
+        "    async def reserve(self, n, fast):\n"
+        "        bids = self.kv.allocate_sequence(n)\n"
+        "        if fast:\n"
+        "            return None\n"
+        "        self.kv.free_sequence(bids)\n"
+    )
+    found = lint(src, "DYN501")
+    assert rules_of(found) == ["DYN501"]
+    assert "early return" in found[0].message
+
+
+def test_dyn501_handleless_admission_leak_and_fix():
+    leaky = (
+        "class Svc:\n"
+        "    async def handle(self, req):\n"
+        "        await self.admission.acquire(req.tenant)\n"
+        "        await self.engine.run(req)\n"
+        "        self.admission.release(req.tenant)\n"
+    )
+    assert rules_of(lint(leaky, "DYN501")) == ["DYN501"]
+    fixed = (
+        "class Svc:\n"
+        "    async def handle(self, req):\n"
+        "        await self.admission.acquire(req.tenant)\n"
+        "        try:\n"
+        "            await self.engine.run(req)\n"
+        "        finally:\n"
+        "            self.admission.release(req.tenant)\n"
+    )
+    assert lint(fixed, "DYN501") == []
+
+
+def test_dyn501_handleless_cross_function_out_of_scope():
+    # acquire here, release in another function: like DYN102, receiver
+    # pairing is only checked within one function.
+    src = (
+        "class Svc:\n"
+        "    async def begin(self, req):\n"
+        "        await self.admission.acquire(req.tenant)\n"
+    )
+    assert lint(src, "DYN501") == []
+
+
+def test_dyn501_lock_acquire_not_a_resource():
+    # `self._lock.acquire()` must not match the admission/adapter specs:
+    # the receiver filter keeps lock discipline with DYN102.
+    src = (
+        "class Svc:\n"
+        "    async def handle(self, req):\n"
+        "        await self._lock.acquire()\n"
+        "        self._lock.release()\n"
+    )
+    assert lint(src, "DYN501") == []
+
+
+# --------------------------------------------------------- DYN502/DYN503
+
+
+def test_dyn502_closure_inherits_use_site_lock():
+    # the mirror/offload idiom: dispatch lives in a closure, the lock is
+    # taken at the to_thread use site — lock status flows into the body.
+    src = (
+        "import asyncio\n"
+        "class Engine:\n"
+        "    async def mirror(self, batch):\n"
+        "        def run_u():\n"
+        "            return self._step_fn(batch)\n"
+        "        async with self._device_lock:\n"
+        "            return await asyncio.to_thread(run_u)\n"
+    )
+    assert lint(src, "DYN502") == []
+
+
+def test_dyn502_closure_with_unlocked_use_site_flags():
+    src = (
+        "import asyncio\n"
+        "class Engine:\n"
+        "    async def mirror(self, batch):\n"
+        "        def run_u():\n"
+        "            return self._step_fn(batch)\n"
+        "        return await asyncio.to_thread(run_u)\n"
+    )
+    assert rules_of(lint(src, "DYN502")) == ["DYN502"]
+
+
+def test_dyn502_lock_required_contract_both_ends():
+    # _offload_store's contract is "caller holds the lock": its body
+    # checks as locked, and an unlocked reference to it is the finding.
+    src = (
+        "import asyncio\n"
+        "class Offloader:\n"
+        "    def _offload_store(self, blk):\n"
+        "        return self._gather_fn(blk)\n"
+        "    async def flush(self, blk):\n"
+        "        return await asyncio.to_thread(self._offload_store, blk)\n"
+    )
+    found = lint(src, "DYN502")
+    assert rules_of(found) == ["DYN502"]
+    assert found[0].symbol.endswith("flush")
+    locked = (
+        "import asyncio\n"
+        "class Offloader:\n"
+        "    def _offload_store(self, blk):\n"
+        "        return self._gather_fn(blk)\n"
+        "    async def flush(self, blk):\n"
+        "        async with self._device_lock:\n"
+        "            return await asyncio.to_thread(self._offload_store, blk)\n"
+    )
+    assert lint(locked, "DYN502") == []
+
+
+def test_dyn502_warmup_exempt():
+    src = (
+        "class Engine:\n"
+        "    def warmup(self, batch):\n"
+        "        return self._step_fn(batch)\n"
+    )
+    assert lint(src, "DYN502") == []
+
+
+def test_dyn503_io_under_contract_lock():
+    # the body of a lock-required function runs under the caller's lock,
+    # so blocking I/O inside it is the PR 11 lock-split class too.
+    src = (
+        "import os\n"
+        "class Offloader:\n"
+        "    def _offload_store(self, blk, fd):\n"
+        "        os.fsync(fd)\n"
+    )
+    assert rules_of(lint(src, "DYN503")) == ["DYN503"]
+
+
+# ---------------------------------------------------------------- DYN601
+
+
+def test_dyn601_ndarray_arg_not_flagged():
+    # asarray over an existing array carries its dtype: only literal
+    # payloads are ambiguous.
+    src = (
+        "def ragged_attention(x):\n"
+        "    return jnp.asarray(x)\n"
+    )
+    assert lint(src, "DYN601") == []
+
+
+def test_dyn601_literal_payload_flagged():
+    src = (
+        "def ragged_attention(x):\n"
+        "    return x + jnp.array([1, 2, 3])\n"
+    )
+    assert rules_of(lint(src, "DYN601")) == ["DYN601"]
+
+
+def test_dyn601_positional_dtype_accepted():
+    src = (
+        "def ragged_attention(x):\n"
+        "    return x + jnp.zeros((4,), jnp.float32)\n"
+    )
+    assert lint(src, "DYN601") == []
+
+
+def test_dyn601_cold_function_out_of_scope():
+    src = (
+        "def report_helper(x):\n"
+        "    return jnp.zeros((4,))\n"
+    )
+    assert lint(src, "DYN601") == []
+
+
+# ---------------------------------------------------------------- DYN602
+
+
+def test_dyn602_bucket_helper_stands_down():
+    src = (
+        "class Engine:\n"
+        "    async def step(self, batch, toks):\n"
+        "        async with self._device_lock:\n"
+        "            return self._step_fn(batch, pad_bucket(len(toks)))\n"
+    )
+    assert lint(src, "DYN602") == []
+
+
+def test_dyn602_raw_len_in_dispatch_args():
+    src = (
+        "class Engine:\n"
+        "    async def step(self, batch, toks):\n"
+        "        async with self._device_lock:\n"
+        "            return self._step_fn(batch, len(toks))\n"
+    )
+    assert rules_of(lint(src, "DYN602")) == ["DYN602"]
+
+
+# ---------------------------------------------------------------- DYN603
+
+
+def test_dyn603_unseeded_rng_in_core():
+    src = (
+        "class WfqQueue:\n"
+        "    def tiebreak(self):\n"
+        "        return random.random()\n"
+    )
+    assert rules_of(lint(src, "DYN603")) == ["DYN603"]
+
+
+def test_dyn603_seeded_ctor_clean_unseeded_ctor_flagged():
+    seeded = (
+        "class WfqQueue:\n"
+        "    def __init__(self, seed):\n"
+        "        self._rng = random.Random(seed)\n"
+        "        self._gen = np.random.default_rng(seed)\n"
+    )
+    assert lint(seeded, "DYN603") == []
+    unseeded = (
+        "class WfqQueue:\n"
+        "    def __init__(self):\n"
+        "        self._rng = random.Random()\n"
+    )
+    assert rules_of(lint(unseeded, "DYN603")) == ["DYN603"]
+
+
+def test_dyn603_clock_reference_is_the_idiom():
+    # referencing time.monotonic as an injectable default is sanctioned;
+    # only CALLS are raw.
+    src = (
+        "import time\n"
+        "class DecisionEngine:\n"
+        "    def __init__(self, clock=time.monotonic):\n"
+        "        self._clock = clock\n"
+        "    def decide(self):\n"
+        "        return self._clock()\n"
+    )
+    assert lint(src, "DYN603") == []
+
+
+def test_dyn603_unregistered_class_out_of_scope():
+    src = (
+        "class ReportFormatter:\n"
+        "    def stamp(self):\n"
+        "        return time.time()\n"
+    )
+    assert lint(src, "DYN603") == []
+
+
+# ------------------------------------------------- DYN504/DYN604 staleness
+
+
+def test_dyn504_staleness_fires_against_real_prefix_corpus():
+    # a dynamo_tpu/-prefixed corpus that defines none of the registered
+    # lifetime symbols: every entry is stale and anchored at the registry.
+    found = analyze_sources(
+        [("dynamo_tpu/fake.py", "def f():\n    return 1\n")],
+        rules={"DYN504"},
+    )
+    assert found and all(f.rule == "DYN504" for f in found)
+    assert all(f.path == "tools/dynalint/registry.py" for f in found)
+
+
+def test_dyn504_silent_on_synthetic_corpus():
+    found = analyze_sources(
+        [("pkg/fake.py", "def f():\n    return 1\n")], rules={"DYN504"}
+    )
+    assert found == []
+
+
+def test_dyn604_staleness_fires_against_real_prefix_corpus():
+    found = analyze_sources(
+        [("dynamo_tpu/fake.py", "def f():\n    return 1\n")],
+        rules={"DYN604"},
+    )
+    assert found and all(f.rule == "DYN604" for f in found)
+    assert all(f.path == "tools/dynalint/registry.py" for f in found)
+    # hot-path functions, deterministic-core classes AND module paths are
+    # all validated
+    symbols = " ".join(f.symbol for f in found)
+    assert "HOT_PATH_FUNCTIONS" in symbols
+    assert "DETERMINISTIC_CORE_CLASSES" in symbols
+    assert "DETERMINISTIC_CORE_PATHS" in symbols
+
+
+# ------------------------------------------- changed-only registry closure
+
+
+def test_changed_only_closure_pulls_lifetime_helper_modules():
+    """Any lifetime-active changed-only run re-checks the modules that
+    DEFINE registered acquire/release helpers — editing an unrelated file
+    must not let a latent leak near free_sequence ride along unseen."""
+    pool = (
+        "class Pool:\n"
+        "    def allocate_sequence(self, n):\n"
+        "        return list(range(n))\n"
+        "    def free_sequence(self, bids):\n"
+        "        pass\n"
+        "async def leaky(pool, wire, n):\n"
+        "    bids = pool.allocate_sequence(n)\n"
+        "    await wire.scatter(bids)\n"
+        "    pool.free_sequence(bids)\n"
+    )
+    other = "def unrelated():\n    return 1\n"
+    found = analyze_sources(
+        [("pool.py", pool), ("other.py", other)],
+        rules={"DYN501"},
+        changed_paths={"other.py"},
+    )
+    assert [f.rule for f in found] == ["DYN501"]
+    assert found[0].path == "pool.py"
+    # an explicit only_paths still intersects: the report can be narrowed
+    found = analyze_sources(
+        [("pool.py", pool), ("other.py", other)],
+        rules={"DYN501"},
+        changed_paths={"other.py"},
+        only_paths={"other.py"},
+    )
+    assert found == []

@@ -4,23 +4,28 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== dynalint 2.0 (async-safety, JAX invariants, async-race, taint,"
-echo "   wire-schema; artifact: /tmp/dynalint_report.json) =="
+echo "== dynalint 3.0 (async-safety, JAX invariants, async-race, taint,"
+echo "   wire-schema, resource lifetime, compile stability;"
+echo "   artifact: /tmp/dynalint_report.json) =="
 python -m tools.dynalint dynamo_tpu --json > /tmp/dynalint_report.json \
   || { cat /tmp/dynalint_report.json; exit 1; }
 python - <<'PYEOF'
 # Budget + debt-cap enforcement over the --json artifact: full-corpus
 # analysis must stay under the 60s CI budget (per-pass timings in the
 # artifact attribute any regression), the baseline must hold ZERO entries
-# for the 2.0 families (DYN1xx/2xx/3xx true positives are fixed, never
-# baselined), and total grandfathered debt stays under the ISSUE 2 cap.
+# for the 2.0/3.0 families (DYN1xx/2xx/3xx/5xx/6xx true positives are
+# fixed, never baselined — and the full run also re-validates the
+# lifetime/stability registries against the tree via DYN504/DYN604, so a
+# renamed helper goes stale loudly), and total grandfathered debt stays
+# under the ISSUE 2 cap.
 import json, sys
 r = json.load(open("/tmp/dynalint_report.json"))
 t = r["timings"]
 assert r["ok"], "dynalint reported new findings"
 assert t["total"] < 60, f"dynalint exceeded the 60s CI budget: {t['total']:.1f}s ({t})"
-fam = [e for e in r["baselined"] if e["rule"].startswith(("DYN1", "DYN2", "DYN3", "DYN4"))]
-assert not fam, f"2.0-family findings may not be baselined: {fam}"
+fam = [e for e in r["baselined"]
+       if e["rule"].startswith(("DYN1", "DYN2", "DYN3", "DYN4", "DYN5", "DYN6"))]
+assert not fam, f"2.0/3.0-family findings may not be baselined: {fam}"
 assert len(r["baselined"]) <= 10, f"baseline debt cap exceeded: {len(r['baselined'])}"
 per = ", ".join(f"{k}={v*1e3:.0f}ms" for k, v in sorted(t.items()))
 print(f"dynalint: clean in {t['total']:.2f}s ({per})")

@@ -23,8 +23,8 @@ from .rules import ALL_RULES
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="dynalint",
-        description="async-safety & JAX-invariant static analyzer for "
-        "dynamo_tpu (rules DYN001-DYN007; see docs/dynalint.md)",
+        description="async-safety, dataflow & lifetime static analyzer for "
+        "dynamo_tpu (rules DYN001-007, DYN1xx-6xx; see docs/dynalint.md)",
     )
     ap.add_argument(
         "paths",

@@ -295,8 +295,17 @@ def analyze_sources(
     race_active = {r for r in active if r.startswith("DYN1")}
     taint_active = {r for r in active if r.startswith("DYN2")}
     schema_active = {r for r in active if r.startswith("DYN3")}
+    lifetime_active = {r for r in active if r.startswith("DYN5")}
+    stability_active = {r for r in active if r.startswith("DYN6")}
+    corpus_active = (
+        race_active
+        or taint_active
+        or schema_active
+        or lifetime_active
+        or stability_active
+    )
     graph = None
-    if race_active or taint_active or schema_active or changed_paths is not None:
+    if corpus_active or changed_paths is not None:
         from .callgraph import CorpusGraph
 
         t0 = _time.perf_counter()
@@ -307,6 +316,22 @@ def analyze_sources(
         corpus_paths = {p for p, _s, _t in parsed}
         in_scope = changed_paths & corpus_paths
         closure = graph.dependents(in_scope) if in_scope else set()
+        if in_scope and lifetime_active:
+            # Lifetime checks are registry-anchored: any change pulls the
+            # modules DEFINING registered acquire/release/transfer helpers
+            # back into scope, so editing (say) free_sequence re-checks its
+            # callers' contract sites instead of trusting the last run.
+            from .registry import LIFETIME_RESOURCES
+
+            tails = set()
+            for spec in LIFETIME_RESOURCES.values():
+                tails |= (
+                    set(spec["acquire"])
+                    | set(spec["release"])
+                    | set(spec["transfer"])
+                ) - set(spec.get("external", ()))
+            for tail in tails:
+                closure |= graph.def_paths.get(tail, set())
         # An unparseable changed file is not in the graph but its DYN000
         # finding MUST survive the scope filter — a pre-commit run that
         # reports "clean" on a syntax error checks nothing.
@@ -323,10 +348,8 @@ def analyze_sources(
         findings.extend(checker.run(tree))
     timings["DYN001-007"] = _time.perf_counter() - t0
 
-    # ---- 2.0 corpus passes (dataflow over the whole tree) ----------------
-    if (race_active or taint_active or schema_active) and (
-        scope is None or scope
-    ):
+    # ---- 2.0/3.0 corpus passes (dataflow over the whole tree) ------------
+    if corpus_active and (scope is None or scope):
         lines_of = {path: source.splitlines() for path, source, _ in parsed}
 
         if race_active:
@@ -356,6 +379,22 @@ def analyze_sources(
             # filter below scopes what is shown.
             findings.extend(check_schema(graph, schema_active, lines_of))
             timings["DYN3xx"] = _time.perf_counter() - t0
+        if lifetime_active:
+            from .rules_lifetime import check_lifetime
+
+            t0 = _time.perf_counter()
+            findings.extend(
+                check_lifetime(graph, lifetime_active, lines_of, scope)
+            )
+            timings["DYN5xx"] = _time.perf_counter() - t0
+        if stability_active:
+            from .rules_stability import check_stability
+
+            t0 = _time.perf_counter()
+            findings.extend(
+                check_stability(graph, stability_active, lines_of, scope)
+            )
+            timings["DYN6xx"] = _time.perf_counter() - t0
 
     # ---- suppressions + scope filter, applied uniformly ------------------
     sup_by_path = {path: parse_suppressions(source) for path, source in sources}

@@ -1,0 +1,12 @@
+# dynalint-fixture: expect=none
+"""The sanctioned shape: every risky point between acquire and release is
+covered by a ``finally`` that frees the handle."""
+
+
+class Stager:
+    async def stage(self, seq, payload):
+        bids = self.pool.allocate_sequence(seq.num_blocks)
+        try:
+            await self.wire.scatter(bids, payload)
+        finally:
+            self.pool.free_sequence(bids)

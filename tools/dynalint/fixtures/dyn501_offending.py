@@ -1,0 +1,11 @@
+# dynalint-fixture: expect=DYN501
+"""Exception-edge leak: blocks are allocated, then an awaited wire call
+sits between acquire and release with no try/finally — a raise mid-wire
+leaves the handle held forever."""
+
+
+class Stager:
+    async def stage(self, seq, payload):
+        bids = self.pool.allocate_sequence(seq.num_blocks)
+        await self.wire.scatter(bids, payload)  # can raise: blocks leak
+        self.pool.free_sequence(bids)

@@ -1,0 +1,11 @@
+# dynalint-fixture: expect=none
+"""Suppressed: the reviewed claim is that this wire call cannot raise
+after the handshake completes, so the bare span is safe."""
+
+
+class Stager:
+    async def stage(self, seq, payload):
+        bids = self.pool.allocate_sequence(seq.num_blocks)
+        # post-handshake scatter is infallible per the wire contract
+        await self.wire.scatter(bids, payload)  # dynalint: disable=DYN501
+        self.pool.free_sequence(bids)

@@ -1,0 +1,11 @@
+# dynalint-fixture: expect=none
+"""The sanctioned shape: dispatch runs under ``_device_lock`` — including
+through the ``asyncio.to_thread`` indirection."""
+
+import asyncio
+
+
+class Engine:
+    async def step(self, batch):
+        async with self._device_lock:
+            return await asyncio.to_thread(self._step_fn, batch)

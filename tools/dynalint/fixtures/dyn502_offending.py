@@ -1,0 +1,8 @@
+# dynalint-fixture: expect=DYN502
+"""Device dispatch outside the device lock: a concurrent dispatch can
+reuse the donated buffers of this one mid-flight."""
+
+
+class Engine:
+    async def step(self, batch):
+        return self._step_fn(batch)  # no _device_lock held

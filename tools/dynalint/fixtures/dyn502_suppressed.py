@@ -1,0 +1,9 @@
+# dynalint-fixture: expect=none
+"""Suppressed: an offline benchmark entry point that runs before the
+serving loop exists — single task, no concurrent dispatch possible."""
+
+
+class Bench:
+    async def bench_once(self, batch):
+        # offline: the serving loop (and its peers) never started
+        return self._step_fn(batch)  # dynalint: disable=DYN502

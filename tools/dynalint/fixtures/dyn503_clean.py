@@ -1,0 +1,12 @@
+# dynalint-fixture: expect=none
+"""The sanctioned split: dispatch under the lock, disk I/O after it."""
+
+import os
+
+
+class Engine:
+    async def offload(self, batch, fd):
+        async with self._device_lock:
+            out = self._step_fn(batch)
+        os.fsync(fd)  # outside the lock: decode keeps dispatching
+        return out

@@ -1,0 +1,13 @@
+# dynalint-fixture: expect=DYN503
+"""Blocking host I/O under the device lock: every decode dispatch queues
+behind the disk write (the PR 11 lock-split class)."""
+
+import os
+
+
+class Engine:
+    async def offload(self, batch, fd):
+        async with self._device_lock:
+            out = self._step_fn(batch)
+            os.fsync(fd)  # disk latency serializes the decode plane
+        return out

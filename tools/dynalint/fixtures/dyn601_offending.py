@@ -1,0 +1,9 @@
+# dynalint-fixture: expect=DYN601
+"""Dtype-ambiguous constructor on a registered hot path: the result dtype
+follows jax's weak-type/x64 defaults, so the jit cache key (and kernel
+numerics) silently depend on process-global flags."""
+
+
+def ragged_decode_attention(q, kv_pages, lens):
+    mask_val = jnp.full((1, 1), -1e9)  # dtype depends on the x64 flag
+    return q, mask_val

@@ -1,0 +1,9 @@
+# dynalint-fixture: expect=none
+"""Suppressed: the weak-typed scalar is deliberate — it must promote to
+whatever dtype the cache arrays carry at the update site."""
+
+
+def write_kv_ragged(kv, new_kv, slots):
+    # weak type on purpose: promotes to kv's dtype at the scatter
+    pad = jnp.zeros((8,))  # dynalint: disable=DYN601
+    return kv, pad

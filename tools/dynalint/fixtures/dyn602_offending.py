@@ -1,0 +1,9 @@
+# dynalint-fixture: expect=DYN602
+"""Per-request ``len()`` fed straight into a traced dispatch: every new
+length keys a fresh executable — compile storms under real traffic."""
+
+
+class Engine:
+    async def step(self, batch, tokens):
+        async with self._device_lock:
+            return self._step_fn(batch, len(tokens))  # unbucketed length

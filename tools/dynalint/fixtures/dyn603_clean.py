@@ -1,0 +1,19 @@
+# dynalint-fixture: expect=none
+"""The sanctioned shape: the clock is injected (referencing
+``time.monotonic`` as a default is the idiom — only CALLS are raw), and
+RNG is seeded."""
+
+import random
+import time
+
+
+class BrownoutLadder:
+    def __init__(self, clock=time.monotonic, seed=0):
+        self._clock = clock
+        self._rng = random.Random(seed)
+
+    def maybe_step(self):
+        now = self._clock()
+        if now - self._last_step < self.dwell_s:
+            return self._rung
+        return self._rung + self._rng.choice((0, 1))

@@ -1,0 +1,12 @@
+# dynalint-fixture: expect=DYN603
+"""Raw wall clock inside a registered deterministic core: the brownout
+ladder's rung decisions become a function of real time, so sim/replay and
+tests can never reproduce a traffic incident exactly."""
+
+
+class BrownoutLadder:
+    def maybe_step(self):
+        now = time.monotonic()  # raw clock: replay diverges
+        if now - self._last_step < self.dwell_s:
+            return self._rung
+        return self._rung + 1

@@ -1,0 +1,9 @@
+# dynalint-fixture: expect=none
+"""Suppressed: an operator-facing report stamp that never feeds a
+decision — reviewed as harmless wall-clock use."""
+
+
+class DecisionEngine:
+    def snapshot_id(self):
+        # report watermark only; no decision reads it
+        return time.time_ns()  # dynalint: disable=DYN603

@@ -1,0 +1,14 @@
+# dynalint-fixture: expect=DYN501
+"""PR 9 review finding, minimized: the health prober opened a mux stream
+per probe and released it after the ping round-trip.  A dead worker made
+the ping raise, the release never ran, and the per-connection stream-id
+pool drained until every subsequent probe failed with "no free stream" —
+the prober marked healthy workers dead."""
+
+
+class HealthProbe:
+    async def probe_once(self, worker):
+        sid = self.mux.open_stream(worker.addr)
+        rtt = await self.mux.ping(sid, timeout=self.timeout_s)  # dead peer raises
+        self.mux.release(sid)
+        return rtt

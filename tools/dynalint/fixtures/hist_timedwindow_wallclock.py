@@ -1,0 +1,12 @@
+# dynalint-fixture: expect=DYN603
+"""PR 8 review finding, minimized: TimedWindow stamped samples with
+``time.time()``.  An NTP step made the rate window jump backwards, the
+brownout ladder reading it oscillated, and no test could reproduce the
+incident.  The fix injects ``clock=time.monotonic`` and lets the sim
+drive a fake clock."""
+
+
+class TimedWindow:
+    def observe(self, value):
+        self._samples.append((time.time(), value))  # NTP step skews the window
+        self._evict(time.time() - self.window_s)

@@ -1,0 +1,15 @@
+# dynalint-fixture: expect=DYN501
+"""PR 4/5 review finding, minimized: the KV transfer receive path
+allocated destination blocks, then awaited the chunked wire scatter.  A
+peer death mid-scatter raised out of the loop with the blocks still
+allocated — pinned forever, shrinking the pool until the worker starved.
+The fix wrapped the scatter span in ``except BaseException: free; raise``."""
+
+
+class KvReceiver:
+    async def inject_blocks(self, seq, chunks):
+        bids = self.pool.allocate_sequence(seq.num_blocks)
+        for payload in chunks:
+            await self.wire.scatter(bids, payload)  # dies with the peer
+        self.pool.free_sequence(bids)
+        return True

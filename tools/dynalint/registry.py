@@ -1,9 +1,9 @@
-"""dynalint 2.0 registries: taint sources/sinks/sanitizers, wire-schema
-classes and exemptions.
+"""dynalint registries: taint sources/sinks/sanitizers, wire-schema
+classes and exemptions, resource lifetimes, compile-stability scopes.
 
-The dataflow rules (DYN1xx/2xx/3xx) are only as good as their model of
-*this* codebase; that model lives here, in one reviewable place, instead of
-being scattered through rule logic.  Three registry groups:
+The dataflow rules (DYN1xx/2xx/3xx/5xx/6xx) are only as good as their
+model of *this* codebase; that model lives here, in one reviewable place,
+instead of being scattered through rule logic.  Registry groups:
 
 - **Taint** (DYN2xx): which expressions produce wire-controlled data
   (sources), which calls neutralize it (sanitizers), and which calls/format
@@ -15,6 +15,13 @@ being scattered through rule logic.  Three registry groups:
 - **Snapshot threading** (DYN304): the explicit SequenceState →
   SequenceSnapshot coverage map — every engine-consumed decode-state field
   either travels in the snapshot or is consciously exempted here.
+- **Resource lifetimes** (DYN5xx): the acquire/release/transfer model of
+  every handle-shaped resource (KV blocks, adapter slots, mux stream ids,
+  hub leases, row slots, tmp ``.kvblk`` files) plus the device-lock
+  dispatch/blocking-I/O discipline.
+- **Compile stability & determinism** (DYN6xx): which functions are jit
+  hot paths (dtype/shape discipline applies) and which classes/modules are
+  deterministic cores (injectable clocks + seeded RNG only).
 
 Every entry is a claim that someone thought about the case; deleting an
 entry re-surfaces the finding, so the registries are self-auditing: stale
@@ -319,3 +326,212 @@ SNAPSHOT_EXEMPT = {
     "adapter_released": "source-side release idempotency flag",
     "grammar_state": "re-derived by advancing through resumed output",
 }
+
+# ---------------------------------------------------------------------------
+# DYN5xx resource-lifetime model
+# ---------------------------------------------------------------------------
+
+# Each entry declares one resource class as the rule sees it:
+#
+# - ``acquire``: call tails that mint a handle (the call's result).
+# - ``release``: call tails that return the handle to its pool.
+# - ``transfer``: call tails that move OWNERSHIP somewhere else (sealing a
+#   block into the prefix cache, os.replace-ing a tmp file into place) —
+#   they satisfy the lifetime obligation exactly like a release.
+# - ``receivers``: when set, the acquire only matches on these receiver
+#   attribute names (``self.admission.acquire`` yes, ``self._lock.acquire``
+#   no) — generic tails need the hint, unambiguous tails don't.
+# - ``handleless``: the protocol pairs by RECEIVER, not by a returned
+#   handle (admission slots, adapter refcounts keyed by name).  Handleless
+#   resources are only checked when acquire and release appear in the SAME
+#   function — cross-function protocols stay out of scope, like DYN102.
+# - ``flag_dropped``: a bare-statement acquire whose result is discarded is
+#   itself a finding (the handle is unreleasable without it).
+#
+# ``external`` lists tails implemented OUTSIDE the corpus (os.*) which the
+# DYN504 staleness check must not demand a local definition for.
+LIFETIME_RESOURCES = {
+    "kv_blocks": dict(
+        acquire={"allocate_sequence", "acquire_prefix", "allocate_block",
+                 "_pin_prefix"},
+        release={"free_sequence"},
+        transfer={"seal_block"},
+        receivers=None,
+        handleless=False,
+        flag_dropped=True,
+    ),
+    "adapter_slot": dict(
+        acquire={"acquire"},
+        release={"release"},
+        transfer=set(),
+        receivers={"_lora_registry", "lora_registry", "adapters",
+                   "adapter_registry"},
+        handleless=True,
+        flag_dropped=False,
+    ),
+    "admission_slot": dict(
+        acquire={"acquire"},
+        release={"release"},
+        transfer=set(),
+        receivers={"admission", "_admission", "admission_controller"},
+        handleless=True,
+        flag_dropped=False,
+    ),
+    "mux_stream": dict(
+        acquire={"open_stream"},
+        release={"release"},
+        transfer=set(),
+        receivers=None,
+        handleless=False,
+        flag_dropped=True,
+    ),
+    "hub_lease": dict(
+        acquire={"lease_grant"},
+        release={"lease_revoke"},
+        # The hub serving loop mints leases FOR remote clients: shipping
+        # the id over the wire (``send``) hands the renew/revoke
+        # obligation to the client side.
+        transfer={"send"},
+        receivers=None,
+        handleless=False,
+        flag_dropped=True,
+    ),
+    "row_slot": dict(
+        acquire={"assign"},
+        release={"free", "retire"},
+        transfer=set(),
+        receivers={"slots", "_slots", "row_slots"},
+        handleless=False,
+        flag_dropped=False,
+    ),
+    "tmp_kvblk": dict(
+        acquire={"_tmp_path"},
+        release={"remove", "unlink"},
+        transfer={"replace", "rename"},
+        receivers=None,
+        handleless=False,
+        flag_dropped=True,
+        external={"remove", "unlink", "replace", "rename"},
+    ),
+}
+
+# Call tails whose handle may be passed WITHOUT transferring ownership —
+# pure builtins that cannot retain a reference.  (Used for alias
+# propagation: a value built from the handle through these stays an alias.)
+PURE_BUILTIN_TAILS = {
+    "len", "zip", "enumerate", "list", "tuple", "set", "frozenset",
+    "sorted", "reversed", "min", "max", "sum", "any", "all", "str",
+    "repr", "range", "print", "isinstance", "bool", "int", "float",
+    "iter", "next", "hash", "map", "filter",
+}
+
+# Custody sinks: passing a tracked handle to one of these MOVES ownership
+# out of the function (into a container that outlives the frame, or into
+# another task), so DYN501 stands down.  Every other call BORROWS the
+# handle — the scatter/ping/publish idioms pass block ids around freely
+# while the function keeps the release obligation; treating those as
+# escapes would blind the rule to exactly the historical leaks
+# (transfer.py scatter, the health-probe ping).
+CUSTODY_SINK_TAILS = {
+    "append", "appendleft", "add", "extend", "insert",
+    "put", "put_nowait", "push",
+    "create_task", "ensure_future",
+    "setdefault", "update",
+}
+
+# Device-lock discipline (DYN502/DYN503 — the PR 11 lock-split class).
+# Jitted dispatch entry points (``self.<tail>(...)`` or
+# ``asyncio.to_thread(self.<tail>, ...)``) must run under ``_device_lock``
+# so a concurrent dispatch can never interleave donated-buffer reuse;
+# blocking host I/O must NOT run under it, or every decode step queues
+# behind a disk write.
+DEVICE_DISPATCH_TAILS = {"_step_fn", "_multi_fn", "_inject_fn", "_gather_fn"}
+DEVICE_LOCK_NAME = "_device_lock"
+# Functions sanctioned to dispatch without the lock: startup-only warmup
+# compilation runs before the serving loop exists (single task, no
+# concurrent dispatch possible).
+DEVICE_LOCK_EXEMPT_FUNCS = {"warmup"}
+# Functions whose CONTRACT is "caller holds _device_lock" (sync bodies run
+# via asyncio.to_thread under the caller's lock).  Their bodies check as
+# locked; every reference to them OUTSIDE the lock is itself a DYN502
+# finding, so the contract is enforced at both ends.
+DEVICE_LOCK_REQUIRED_FUNCS = {"_offload_store", "_restore_inject"}
+
+# Blocking host I/O that must never run under the device lock.
+HOST_BLOCKING_DOTTED = {
+    "time.sleep",
+    "os.fsync",
+    "os.replace",
+    "os.remove",
+    "os.rename",
+    "os.unlink",
+    "shutil.copyfile",
+    "shutil.move",
+}
+HOST_BLOCKING_TAILS = {"write_bytes", "read_bytes", "write_text", "read_text"}
+HOST_BLOCKING_BARE = {"open"}
+
+# ---------------------------------------------------------------------------
+# DYN6xx compile-stability & determinism model
+# ---------------------------------------------------------------------------
+
+# Hot-path scope for DYN601: every function in these paths (prefix match)
+# plus these function names (the names make fixtures/tests expressible and
+# are validated for staleness by DYN604).
+HOT_PATH_PATHS = ("dynamo_tpu/ops/", "dynamo_tpu/engine/pipeline.py")
+HOT_PATH_FUNCTIONS = {
+    "ragged_decode_attention",
+    "ragged_attention",
+    "write_kv_ragged",
+}
+
+# Array constructors whose result dtype depends on jax's weak-type /
+# x64-flag defaults when no dtype is given.  Shape constructors are always
+# ambiguous without a dtype; array/asarray only when fed a Python literal
+# (an ndarray argument carries its own dtype).
+SHAPE_CONSTRUCTOR_TAILS = {"zeros", "ones", "empty", "full", "arange"}
+LITERAL_CONSTRUCTOR_TAILS = {"array", "asarray"}
+ARRAY_NAMESPACES = ("jnp", "jax.numpy")
+DTYPE_NAME_TAILS = {
+    "float64", "float32", "float16", "bfloat16",
+    "float8_e4m3fn", "float8_e5m2",
+    "int64", "int32", "int16", "int8",
+    "uint64", "uint32", "uint16", "uint8",
+    "bool_", "complex64",
+}
+
+# DYN602: jit-traced dispatch sites — a raw per-request ``len(...)`` in an
+# argument keys a fresh executable per length; route it through the
+# power-of-two padding idiom (``1 << (n - 1).bit_length()``) or a
+# registered bucket helper first.
+TRACED_DISPATCH_TAILS = DEVICE_DISPATCH_TAILS
+BUCKET_HELPER_TAILS = {"bit_length", "next_pow2", "pad_bucket", "round_up"}
+
+# DYN603: deterministic cores — decision logic whose outputs must be a
+# function of its inputs so tests/sim/replay stay exact.  Wall clocks are
+# injected (``clock=time.monotonic`` default parameter, called as
+# ``self._clock()``); RNG is seeded (``random.Random(seed)``).  Registered
+# by class name and by module path.
+DETERMINISTIC_CORE_CLASSES = {
+    "DecisionEngine",   # planner/policy.py — scaling decisions
+    "BrownoutLadder",   # llm/qos.py — degradation rungs
+    "WfqQueue",         # engine/scheduler.py — virtual-time fairness
+    "TimedWindow",      # llm/metrics.py — the PR 8 wall-clock bug class
+    "AdapterRegistry",  # llm/tenancy/lora.py — promotion deadlines
+    "DefaultWorkerSelector",  # llm/kv_router/scheduler.py — tie-breaks
+    "RetryPolicy",      # runtime/resilience.py — backoff jitter
+}
+DETERMINISTIC_CORE_PATHS = ("dynamo_tpu/planner/sim.py",)
+
+# Raw time sources forbidden inside deterministic cores (calls only —
+# referencing ``time.monotonic`` as an injectable default is the idiom).
+RAW_CLOCK_DOTTED = {
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.time_ns", "time.monotonic_ns", "time.perf_counter_ns",
+    "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+}
+# RNG namespaces forbidden unseeded; constructors that take an explicit
+# seed argument are the sanctioned form.
+RAW_RNG_PREFIXES = ("random.", "np.random.", "numpy.random.")
+SEEDED_RNG_TAILS = {"Random", "default_rng"}

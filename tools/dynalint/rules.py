@@ -23,9 +23,10 @@ from .core import (
 from .registry import HUB_KEY_BUILDER_TAILS, HUB_KEY_SINK_TAILS
 
 # DYN001-007 run in the per-file FileChecker below; DYN1xx/2xx/3xx are the
-# 2.0 corpus passes (rules_race / rules_taint / rules_schema) built on the
-# dataflow core — one ALL_RULES tuple so --rules and suppressions see one
-# namespace.
+# 2.0 corpus passes (rules_race / rules_taint / rules_schema) and
+# DYN5xx/6xx the 3.0 passes (rules_lifetime / rules_stability), all built
+# on the dataflow core — one ALL_RULES tuple so --rules and suppressions
+# see one namespace.
 ALL_RULES = (
     "DYN001",
     "DYN002",
@@ -47,6 +48,14 @@ ALL_RULES = (
     "DYN305",
     "DYN306",
     "DYN401",
+    "DYN501",
+    "DYN502",
+    "DYN503",
+    "DYN504",
+    "DYN601",
+    "DYN602",
+    "DYN603",
+    "DYN604",
 )
 
 RULE_TITLES = {
@@ -70,6 +79,14 @@ RULE_TITLES = {
     "DYN305": "setdefault on a nullable wire key (null skips the rewrite)",
     "DYN306": "pytree treedef stability: frozen prefix / trailing defaults",
     "DYN401": "ad-hoc hub key construction bypasses shard routing",
+    "DYN501": "acquired resource handle does not reach release/transfer on all paths",
+    "DYN502": "registered device dispatch runs outside _device_lock",
+    "DYN503": "blocking host I/O under _device_lock (lock-split class)",
+    "DYN504": "stale lifetime/device registry entry (symbol gone from corpus)",
+    "DYN601": "dtype-ambiguous array constructor on a registered hot path",
+    "DYN602": "raw len() flows into a traced dispatch argument (compile churn)",
+    "DYN603": "raw clock/RNG call inside a registered deterministic core",
+    "DYN604": "stale hot-path/deterministic-core registry entry",
 }
 
 # DYN001 — calls that park the whole event loop.  Dotted names only: a bare

@@ -1,0 +1,761 @@
+"""DYN5xx — resource-lifetime rules.
+
+PagedAttention makes block *ownership* the central serving invariant, and
+this repo's bug history shows the static classes that break it keep
+recurring: the ``transfer.py`` leak-on-scatter-failure (PR 4/5), the
+health-probe mux-slot leak (PR 9), the PR 11 device-lock split.  These
+rules check the registry-declared acquire/release model
+(``registry.LIFETIME_RESOURCES``) path-sensitively over each function:
+
+- **DYN501** — every acquired handle must reach a release, a registered
+  ownership TRANSFER (``seal_block``, ``os.replace``), or provably leave
+  the function's custody (returned, stored on an object, handed to a
+  callee) on ALL paths — including the exception edges: risky events
+  (awaits, ``raise``, declared-blocking I/O, further allocations) between
+  acquire and the nominal release must be covered by a ``finally`` or an
+  ``except`` handler that releases the handle.  ``if handle is None:
+  return`` guards are understood as the no-resource path; handle-less
+  protocols (admission slots, adapter refcounts) pair by receiver and are
+  only checked when acquire and release share a function (the DYN102
+  scoping rule — cross-function protocols stay out of scope).
+- **DYN502** — registered device-dispatch callees (``self._step_fn`` and
+  friends, directly or through ``asyncio.to_thread``) must run under
+  ``_device_lock``; concurrent dispatch over donated buffers is
+  use-after-free on device memory.  ``warmup`` runs before the serving
+  loop exists and is registry-exempt.
+- **DYN503** — blocking host I/O must NOT run under ``_device_lock``
+  (the PR 11 lock-split class): a disk write under the dispatch lock
+  queues every decode step behind the disk.
+- **DYN504** — registry staleness: a renamed acquire/release/dispatch
+  symbol fails the lint instead of silently un-covering a resource class.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .callgraph import CorpusGraph, FunctionUnit
+from .core import Finding, _walk_same_func, call_target, dotted_name, make_finding
+from .registry import (
+    CUSTODY_SINK_TAILS,
+    DEVICE_DISPATCH_TAILS,
+    DEVICE_LOCK_EXEMPT_FUNCS,
+    DEVICE_LOCK_NAME,
+    DEVICE_LOCK_REQUIRED_FUNCS,
+    HOST_BLOCKING_BARE,
+    HOST_BLOCKING_DOTTED,
+    HOST_BLOCKING_TAILS,
+    LIFETIME_RESOURCES,
+    PURE_BUILTIN_TAILS,
+)
+
+LIFETIME_RULES = ("DYN501", "DYN502", "DYN503", "DYN504")
+
+# Call tails that cannot meaningfully raise between acquire and release —
+# kept out of the risk model so pure staging (padding arithmetic) between
+# an allocation and its guarded dispatch does not demand a try block.
+_RISK_EXEMPT_TAILS = PURE_BUILTIN_TAILS | {"bit_length"}
+
+_ALL_ACQUIRE_TAILS = frozenset(
+    t for spec in LIFETIME_RESOURCES.values() for t in spec["acquire"]
+)
+
+
+def _finding(
+    rule: str, unit: FunctionUnit, node: ast.AST, message: str, lines: List[str]
+) -> Finding:
+    return make_finding(rule, unit.path, unit.qualname, node, message, lines)
+
+
+def _receiver(call: ast.Call) -> Optional[str]:
+    """'kv' for ``self.kv.allocate_sequence(...)``, 'conn' for
+    ``conn.open_stream(...)``, None for bare-name calls."""
+    d = dotted_name(call.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    return parts[-2] if len(parts) >= 2 else None
+
+
+def _names_in(node: Optional[ast.AST]) -> Set[str]:
+    if node is None:
+        return set()
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _arg_names(call: ast.Call) -> Set[str]:
+    out: Set[str] = set()
+    for a in call.args:
+        out |= _names_in(a)
+    for kw in call.keywords:
+        out |= _names_in(kw.value)
+    return out
+
+
+def _acquire_spec(call: ast.Call) -> Optional[Tuple[str, dict]]:
+    _, tail = call_target(call)
+    if tail is None or tail not in _ALL_ACQUIRE_TAILS:
+        return None
+    for key, spec in LIFETIME_RESOURCES.items():
+        if tail in spec["acquire"]:
+            recv = spec.get("receivers")
+            if recv is None or _receiver(call) in recv:
+                return key, spec
+    return None
+
+
+def _is_risky_call(call: ast.Call) -> bool:
+    """Can this call plausibly raise with a handle held?  Suspension points
+    are handled separately (awaits); here: declared-blocking I/O and
+    further registered allocations (which fail under pressure)."""
+    dotted, tail = call_target(call)
+    if tail is None:
+        return False
+    if dotted in HOST_BLOCKING_DOTTED or tail in HOST_BLOCKING_TAILS:
+        return True
+    if dotted == tail and tail in HOST_BLOCKING_BARE:
+        return True
+    return tail in _ALL_ACQUIRE_TAILS
+
+
+# ---------------------------------------------------------------------------
+# DYN501: statement records
+# ---------------------------------------------------------------------------
+
+
+class _Rec:
+    __slots__ = ("node", "kind", "calls", "has_await", "guards", "ctx", "lineno")
+
+    def __init__(self, node, kind, calls, has_await, guards, ctx):
+        self.node = node
+        self.kind = kind  # "stmt" | "return" | "raise" | "for"
+        self.calls = calls
+        self.has_await = has_await
+        self.guards = guards  # names read by enclosing if/while tests
+        self.ctx = ctx  # ((try_id, where, lineno, end_lineno), ...)
+        self.lineno = getattr(node, "lineno", 0)
+
+
+def _own_calls(node: ast.AST) -> List[ast.Call]:
+    return [n for n in _walk_same_func(node) if isinstance(n, ast.Call)]
+
+
+def _has_await(node: ast.AST) -> bool:
+    return any(
+        isinstance(n, (ast.Await, ast.AsyncFor, ast.AsyncWith))
+        for n in _walk_same_func(node)
+    )
+
+
+def _collect_records(fn: ast.AST) -> List[_Rec]:
+    recs: List[_Rec] = []
+
+    def header(node: ast.AST, expr: Optional[ast.AST], guards, ctx, kind="stmt"):
+        calls = _own_calls(expr) if expr is not None else []
+        has_aw = isinstance(node, (ast.AsyncFor, ast.AsyncWith))
+        recs.append(_Rec(node, kind, calls, has_aw, guards, ctx))
+
+    def walk(stmts: Iterable[ast.stmt], guards: frozenset, ctx: tuple) -> None:
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(s, (ast.If, ast.While)):
+                header(s, s.test, guards, ctx)
+                g = guards | _names_in(s.test)
+                walk(s.body, g, ctx)
+                walk(s.orelse, g, ctx)
+            elif isinstance(s, (ast.For, ast.AsyncFor)):
+                header(s, s.iter, guards, ctx, kind="for")
+                walk(s.body, guards, ctx)
+                walk(s.orelse, guards, ctx)
+            elif isinstance(s, (ast.With, ast.AsyncWith)):
+                for item in s.items:
+                    header(s, item.context_expr, guards, ctx)
+                walk(s.body, guards, ctx)
+            elif isinstance(s, ast.Try):
+                tid = id(s)
+                span = (s.lineno, getattr(s, "end_lineno", s.lineno) or s.lineno)
+                walk(s.body, guards, ctx + ((tid, "body") + span,))
+                for h in s.handlers:
+                    walk(h.body, guards, ctx + ((tid, "handler") + span,))
+                walk(s.orelse, guards, ctx + ((tid, "orelse") + span,))
+                walk(s.finalbody, guards, ctx + ((tid, "finally") + span,))
+            elif isinstance(s, ast.Return):
+                recs.append(
+                    _Rec(s, "return", _own_calls(s), _has_await(s), guards, ctx)
+                )
+            elif isinstance(s, ast.Raise):
+                recs.append(
+                    _Rec(s, "raise", _own_calls(s), _has_await(s), guards, ctx)
+                )
+            else:
+                recs.append(
+                    _Rec(s, "stmt", _own_calls(s), _has_await(s), guards, ctx)
+                )
+
+    walk(fn.body, frozenset(), ())
+    return recs
+
+
+# ---------------------------------------------------------------------------
+# DYN501: per-function lifetime analysis
+# ---------------------------------------------------------------------------
+
+
+class _Group:
+    """One tracked acquisition: the handle's aliases and lifetime events."""
+
+    __slots__ = ("key", "spec", "aliases", "recv", "acq_rec", "acq_call",
+                 "events")
+
+    def __init__(self, key, spec, aliases, recv, acq_rec, acq_call):
+        self.key = key
+        self.spec = spec
+        self.aliases: Set[str] = set(aliases)
+        self.recv = recv  # handleless pairing receiver, or None
+        self.acq_rec = acq_rec
+        self.acq_call = acq_call
+        # (kind, lineno, ctx_class, span, node) where kind in
+        # release/transfer/risky/return
+        self.events: List[tuple] = []
+
+
+def _ctx_class(rec: _Rec) -> Tuple[str, Tuple[int, int]]:
+    """('finally'|'handler'|'plain', covering try span)."""
+    for tid, where, ln, end in reversed(rec.ctx):
+        if where == "finally":
+            return "finally", (ln, end)
+        if where == "handler":
+            return "handler", (ln, end)
+    return "plain", (rec.lineno, rec.lineno)
+
+
+def _in_handler_of(rec: _Rec, body_tids: Set[int]) -> bool:
+    return any(
+        where == "handler" and tid in body_tids for tid, where, _l, _e in rec.ctx
+    )
+
+
+def _release_calls(rec: _Rec, g: _Group) -> List[Tuple[str, ast.Call]]:
+    out = []
+    for c in rec.calls:
+        _, tail = call_target(c)
+        if tail is None:
+            continue
+        kinds = []
+        if tail in g.spec["release"]:
+            kinds.append("release")
+        if tail in g.spec["transfer"]:
+            kinds.append("transfer")
+        if not kinds:
+            continue
+        if g.recv is not None:
+            if _receiver(c) == g.recv:
+                out.append((kinds[0], c))
+        elif g.aliases & _arg_names(c):
+            out.append((kinds[0], c))
+    return out
+
+
+def _escapes(rec: _Rec, g: _Group) -> bool:
+    if g.recv is not None:
+        return False  # handleless: nothing to escape
+    node = rec.node
+    if rec.kind == "return":
+        return bool(g.aliases & _names_in(node.value))
+    for sub in _walk_same_func(node):
+        if isinstance(sub, (ast.Yield, ast.YieldFrom)):
+            if g.aliases & _names_in(sub):
+                return True
+    if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        value = getattr(node, "value", None)
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        vnames = _names_in(value)
+        for tgt in targets:
+            elts = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+            for el in elts:
+                if isinstance(el, ast.Attribute) and g.aliases & vnames:
+                    return True
+                if isinstance(el, ast.Subscript):
+                    base = el.value
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    # storing into OBJECT state escapes; a scratch local
+                    # (numpy staging buffer) does not change custody
+                    if isinstance(base, ast.Attribute) and g.aliases & (
+                        vnames | _names_in(el.slice)
+                    ):
+                        return True
+    for c in rec.calls:
+        if c is g.acq_call:
+            continue
+        _, tail = call_target(c)
+        if tail is None:
+            continue
+        # Custody sinks and constructors (PascalCase: the object stores the
+        # handle and owns its cleanup, the _RemoteStreamIter idiom) take
+        # ownership; every other call BORROWS (scatter/ping/publish pass
+        # block ids around while the function keeps the release obligation).
+        ctor = tail.lstrip("_")[:1].isupper()
+        if tail not in CUSTODY_SINK_TAILS and not ctor:
+            continue
+        if g.aliases & _arg_names(c):
+            return True
+    return False
+
+
+def _extend_aliases(rec: _Rec, g: _Group) -> None:
+    if g.recv is not None:
+        return
+    node = rec.node
+    if rec.kind == "for":
+        it = node.iter
+        if g.aliases & _names_in(it) and all(
+            (call_target(c)[1] or "?") in PURE_BUILTIN_TAILS
+            for c in _own_calls(it)
+        ):
+            g.aliases |= _names_in_targets(node.target)
+        return
+    if isinstance(node, ast.Assign) and node.value is not None:
+        if not (g.aliases & _names_in(node.value)):
+            return
+        if any(
+            (call_target(c)[1] or "?") not in PURE_BUILTIN_TAILS
+            for c in _own_calls(node.value)
+        ):
+            return
+        for tgt in node.targets:
+            g.aliases |= _names_in_targets(tgt)
+
+
+def _names_in_targets(tgt: ast.AST) -> Set[str]:
+    elts = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+    return {el.id for el in elts if isinstance(el, ast.Name)}
+
+
+def _try_acquire(rec: _Rec, findings, unit, lines) -> List[_Group]:
+    groups: List[_Group] = []
+    node = rec.node
+    for c in rec.calls:
+        m = _acquire_spec(c)
+        if m is None:
+            continue
+        key, spec = m
+        _, tail = call_target(c)
+        if spec["handleless"]:
+            groups.append(_Group(key, spec, (), _receiver(c), rec, c))
+            continue
+        if rec.kind == "return":
+            continue  # ownership handed straight to the caller
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            tgt = targets[0] if len(targets) == 1 else None
+            if tgt is None:
+                continue
+            if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                continue  # stored straight into object/container state
+            names = _names_in_targets(tgt)
+            if isinstance(tgt, ast.Tuple) and len(names) != len(tgt.elts):
+                continue  # some element escapes into an attribute
+            if names:
+                groups.append(_Group(key, spec, names, None, rec, c))
+            continue
+        if isinstance(node, ast.Expr) and node.value is not None:
+            # bare-statement acquire: is the call the whole statement (its
+            # result discarded) or an argument to something else (handed
+            # off)?
+            top = node.value
+            if isinstance(top, ast.Await):
+                top = top.value
+            if top is c and spec["flag_dropped"]:
+                findings.append(
+                    _finding(
+                        "DYN501",
+                        unit,
+                        c,
+                        f"result of `{tail}()` is discarded: the {key} "
+                        "handle it returns is the only way to release the "
+                        "resource — bind it and pair it with "
+                        f"`{'`/`'.join(sorted(spec['release']))}`",
+                        lines,
+                    )
+                )
+    return groups
+
+
+def _check_dyn501(unit: FunctionUnit, lines: List[str]) -> List[Finding]:
+    recs = _collect_records(unit.node)
+    findings: List[Finding] = []
+    groups: List[_Group] = []
+
+    for rec in recs:
+        for g in groups:
+            body_tids = {tid for tid, where, _l, _e in g.acq_rec.ctx
+                         if where == "body"}
+            if _in_handler_of(rec, body_tids):
+                # handlers of the try the acquire sits in run on the
+                # acquire-FAILED path: no handle is held there
+                continue
+            rels = _release_calls(rec, g)
+            if rels:
+                cls, span = _ctx_class(rec)
+                for kind, c in rels:
+                    g.events.append((kind, rec.lineno, cls, span, c))
+                continue
+            if _escapes(rec, g):
+                # Custody moved out of the function (returned, stored on an
+                # object, handed to a container/task/constructor): counts
+                # exactly like a registered transfer — the nominal path is
+                # discharged here, but risky points BEFORE it still need
+                # exception-edge coverage.
+                cls, span = _ctx_class(rec)
+                g.events.append(("transfer", rec.lineno, cls, span, rec.node))
+                continue
+            _extend_aliases(rec, g)
+            if (
+                rec.has_await
+                or rec.kind == "raise"
+                or any(_is_risky_call(c) for c in rec.calls)
+            ):
+                cls, span = _ctx_class(rec)
+                g.events.append(("risky", rec.lineno, cls, span, rec.node))
+            if rec.kind == "return" and not (rec.guards & g.aliases):
+                cls, span = _ctx_class(rec)
+                g.events.append(("return", rec.lineno, cls, span, rec.node))
+        groups.extend(_try_acquire(rec, findings, unit, lines))
+
+    for g in groups:
+        rels = [e for e in g.events if e[0] in ("release", "transfer")]
+        what = f"{g.key} handle" if g.recv is None else f"{g.key} (via `{g.recv}`)"
+        release_hint = "`" + "`/`".join(sorted(g.spec["release"])) + "`"
+        if not rels:
+            if g.recv is not None:
+                continue  # handleless cross-function protocol: out of scope
+            findings.append(
+                _finding(
+                    "DYN501",
+                    unit,
+                    g.acq_call,
+                    f"{what} acquired here never reaches a release "
+                    f"({release_hint}), a registered ownership transfer, or "
+                    "a custody hand-off on any path — the resource leaks",
+                    lines,
+                )
+            )
+            continue
+        finally_rels = [e for e in rels if e[2] == "finally"]
+        handler_rels = [e for e in rels if e[2] == "handler"]
+        plain_rels = [e for e in rels if e[2] == "plain"]
+        if not plain_rels and not finally_rels:
+            findings.append(
+                _finding(
+                    "DYN501",
+                    unit,
+                    handler_rels[0][4],
+                    f"{what} is released only on the exception path — the "
+                    "nominal path leaks it; release in a `finally` or on "
+                    "the fall-through path too",
+                    lines,
+                )
+            )
+            continue
+        covered = [e[3] for e in finally_rels + handler_rels]
+        if plain_rels:
+            bound = min(e[1] for e in plain_rels)
+        else:
+            bound = max(e[3][1] for e in finally_rels)
+        bad_risky = [
+            e
+            for e in g.events
+            if e[0] == "risky"
+            and e[1] < bound
+            and not any(lo <= e[1] <= hi for lo, hi in covered)
+        ]
+        if bad_risky:
+            findings.append(
+                _finding(
+                    "DYN501",
+                    unit,
+                    bad_risky[0][4],
+                    f"an exception here leaks the {what}: this point sits "
+                    "between acquire and release with no `finally`/handler "
+                    f"releasing it ({release_hint}) — cover the span (the "
+                    "transfer.py idiom: `except BaseException: "
+                    "free(...); raise`)",
+                    lines,
+                )
+            )
+            continue
+        fin_spans = [e[3] for e in finally_rels]
+        bad_ret = [
+            e
+            for e in g.events
+            if e[0] == "return"
+            and e[1] < bound
+            and not any(lo <= e[1] <= hi for lo, hi in fin_spans)
+        ]
+        if bad_ret:
+            findings.append(
+                _finding(
+                    "DYN501",
+                    unit,
+                    bad_ret[0][4],
+                    f"early return between acquire and release leaks the "
+                    f"{what} — release before returning or move the "
+                    "release into a `finally`",
+                    lines,
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# DYN502 / DYN503: device-lock discipline
+# ---------------------------------------------------------------------------
+
+
+def _is_device_lock(expr: ast.AST) -> bool:
+    target = expr.func if isinstance(expr, ast.Call) else expr
+    return DEVICE_LOCK_NAME in (dotted_name(target) or "")
+
+
+def _dispatch_tail(call: ast.Call) -> Optional[str]:
+    """The device-dispatch tail a call invokes: ``self._step_fn(...)``,
+    ``asyncio.to_thread(self._step_fn, ...)``, or a registered
+    lock-required callee (whose contract is "caller holds the lock")."""
+    lockish = DEVICE_DISPATCH_TAILS | DEVICE_LOCK_REQUIRED_FUNCS
+    dotted, tail = call_target(call)
+    if tail in lockish:
+        return tail
+    if tail == "to_thread" and call.args:
+        d = dotted_name(call.args[0]) or ""
+        t = d.rsplit(".", 1)[-1]
+        if t in lockish:
+            return t
+    return None
+
+
+def _check_device(
+    unit: FunctionUnit, lines: List[str], rules: Set[str]
+) -> List[Finding]:
+    findings: List[Finding] = []
+    check_502 = "DYN502" in rules and unit.name not in DEVICE_LOCK_EXEMPT_FUNCS
+    check_503 = "DYN503" in rules
+
+    # Closures get the lock status of their USE sites, not their definition
+    # site: the mirror/offload idiom is `async with self._device_lock:
+    # await asyncio.to_thread(run_u)` with the dispatch inside `run_u`.
+    nested_defs: Dict[str, ast.AST] = {
+        n.name: n
+        for n in ast.walk(unit.node)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n is not unit.node
+    }
+    ref_locked: Dict[str, List[bool]] = {}
+
+    def walk(node: ast.AST, locked: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.Name) and node.id in nested_defs:
+            ref_locked.setdefault(node.id, []).append(locked)
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = locked or any(
+                _is_device_lock(i.context_expr) for i in node.items
+            )
+            for i in node.items:
+                walk(i.context_expr, locked)
+            for s in node.body:
+                walk(s, inner)
+            return
+        if isinstance(node, ast.Call):
+            tail = _dispatch_tail(node)
+            if check_502 and tail is not None and not locked:
+                findings.append(
+                    _finding(
+                        "DYN502",
+                        unit,
+                        node,
+                        f"device dispatch `{tail}` outside `async with "
+                        f"self.{DEVICE_LOCK_NAME}`: a concurrent dispatch "
+                        "can reuse donated buffers mid-flight — take the "
+                        "lock (or register the function as startup-exempt)",
+                        lines,
+                    )
+                )
+            if check_503 and locked:
+                dotted, t = call_target(node)
+                if (
+                    dotted in HOST_BLOCKING_DOTTED
+                    or t in HOST_BLOCKING_TAILS
+                    or (dotted == t and t in HOST_BLOCKING_BARE)
+                ):
+                    findings.append(
+                        _finding(
+                            "DYN503",
+                            unit,
+                            node,
+                            f"blocking host I/O `{dotted or t}` under "
+                            f"`{DEVICE_LOCK_NAME}`: every decode dispatch "
+                            "queues behind it (the PR 11 lock-split class) "
+                            "— do the I/O outside the lock",
+                            lines,
+                        )
+                    )
+        for child in ast.iter_child_nodes(node):
+            walk(child, locked)
+
+    for stmt in unit.node.body:
+        walk(stmt, unit.name in DEVICE_LOCK_REQUIRED_FUNCS)
+    # A closure every use of which is under the lock inherits it; one
+    # unlocked use (or no visible use) and its dispatches must self-lock.
+    done: Set[str] = set()
+    progressed = True
+    while progressed:
+        progressed = False
+        for name, dnode in nested_defs.items():
+            if name in done or name not in ref_locked:
+                continue
+            done.add(name)
+            progressed = True
+            eff = all(ref_locked[name])
+            for stmt in dnode.body:
+                walk(stmt, eff)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# DYN504: registry staleness
+# ---------------------------------------------------------------------------
+
+REGISTRY_PATH = "tools/dynalint/registry.py"
+
+
+def _registry_finding(rule: str, symbol: str, message: str) -> Finding:
+    return Finding(
+        rule=rule,
+        path=REGISTRY_PATH,
+        line=1,
+        col=0,
+        message=message,
+        symbol=symbol,
+        snippet="",
+    )
+
+
+def corpus_symbols(graph: CorpusGraph) -> Tuple[Set[str], Set[str], Set[str]]:
+    """(function names, attribute-store names, class names) across the
+    corpus — the symbol universe registry entries must resolve against."""
+    attrs: Set[str] = set()
+    classes: Set[str] = set()
+    for _path, _src, tree in graph.files:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                classes.add(node.name)
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    elts = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+                    for el in elts:
+                        if isinstance(el, ast.Attribute):
+                            attrs.add(el.attr)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                if isinstance(node.target, ast.Attribute):
+                    attrs.add(node.target.attr)
+    return set(graph.by_name), attrs, classes
+
+
+def _is_real_corpus(graph: CorpusGraph) -> bool:
+    """Staleness only makes sense against the real tree — a synthetic test
+    corpus defines almost none of the registered symbols by construction."""
+    return any(p.startswith("dynamo_tpu/") for p, _s, _t in graph.files)
+
+
+def _check_staleness(graph: CorpusGraph) -> List[Finding]:
+    if not _is_real_corpus(graph):
+        return []
+    findings: List[Finding] = []
+    funcs, attrs, _classes = corpus_symbols(graph)
+    known = funcs | attrs
+    for key, spec in LIFETIME_RESOURCES.items():
+        tails = set(spec["acquire"]) | set(spec["release"]) | set(spec["transfer"])
+        for tail in sorted(tails - set(spec.get("external", ()))):
+            if tail not in known:
+                findings.append(
+                    _registry_finding(
+                        "DYN504",
+                        f"LIFETIME_RESOURCES[{key}].{tail}",
+                        f"stale lifetime registry entry: `{tail}` (resource "
+                        f"`{key}`) is defined nowhere in the corpus — the "
+                        "resource class is silently un-covered; rename the "
+                        "entry or the symbol",
+                    )
+                )
+    for tail in sorted(DEVICE_DISPATCH_TAILS):
+        if tail not in known:
+            findings.append(
+                _registry_finding(
+                    "DYN504",
+                    f"DEVICE_DISPATCH_TAILS.{tail}",
+                    f"stale device-dispatch registry entry: `{tail}` is "
+                    "never assigned in the corpus — the lock discipline no "
+                    "longer covers it",
+                )
+            )
+    for name in sorted(DEVICE_LOCK_REQUIRED_FUNCS):
+        if name not in funcs:
+            findings.append(
+                _registry_finding(
+                    "DYN504",
+                    f"DEVICE_LOCK_REQUIRED_FUNCS.{name}",
+                    f"stale lock-required registry entry: `{name}` is "
+                    "defined nowhere in the corpus — its call sites are no "
+                    "longer held to the caller-holds-the-lock contract",
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+
+def check_lifetime(
+    graph: CorpusGraph,
+    rules: Set[str],
+    lines_of: Dict[str, List[str]],
+    scope: Optional[Set[str]] = None,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    per_fn = {"DYN501", "DYN502", "DYN503"} & rules
+    if per_fn:
+        # Nested closures are separate FunctionUnits, but the device-lock
+        # discipline resolves them from their ENCLOSING function (lock
+        # status flows from the use site into the closure body), so skip
+        # them here to avoid double-checking with a blank lock context.
+        nested_ids = {
+            id(n)
+            for u in graph.functions
+            for n in ast.walk(u.node)
+            if n is not u.node
+            and isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for unit in graph.functions:
+            if scope is not None and unit.path not in scope:
+                continue
+            lines = lines_of[unit.path]
+            if "DYN501" in rules:
+                findings.extend(_check_dyn501(unit, lines))
+            if ("DYN502" in rules or "DYN503" in rules) and (
+                id(unit.node) not in nested_ids
+            ):
+                findings.extend(_check_device(unit, lines, rules))
+    if "DYN504" in rules:
+        # Registry-anchored: reported on full runs; --changed-only scopes
+        # it out (CI always runs the full corpus, so staleness still gates).
+        findings.extend(_check_staleness(graph))
+    return findings

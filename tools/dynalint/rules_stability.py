@@ -1,0 +1,335 @@
+"""DYN6xx — compile-stability & determinism rules.
+
+DistServe's goodput math only holds while the decode hot path's latency
+distribution is stationary — and on TPU the two ways it silently stops
+being stationary are (a) a jit cache-key that varies per request (every
+novel key is a multi-second XLA compile in the serving path) and (b)
+decision logic that consults the wall clock or unseeded RNG (the PR 8
+``TimedWindow`` bug: brownout rungs wedged because the window compared
+``time.time()`` against a monotonic deadline).  Both are registry-scoped
+(``registry.HOT_PATH_*`` / ``DETERMINISTIC_CORE_*``) so the rules state
+project policy, not style:
+
+- **DYN601** — dtype-ambiguous array constructors in registered hot-path
+  functions: ``jnp.zeros(shape)`` picks its dtype from the x64 flag and
+  weak-type promotion, so the same call site can key *different*
+  executables across processes (and silently double the KV bytes).  Shape
+  constructors always need an explicit dtype; ``array``/``asarray`` only
+  when fed a Python literal — an ndarray argument carries its own dtype
+  (that is the pipeline.py cache-key idiom).
+- **DYN602** — raw per-request ``len(...)`` flowing into a registered
+  traced-dispatch argument: every distinct length keys a fresh compile.
+  Lengths must round through the power-of-two padding idiom
+  (``1 << (n - 1).bit_length()``) or a registered bucket helper.
+- **DYN603** — raw clock/RNG *calls* inside registered deterministic
+  cores.  Referencing ``time.monotonic`` as an injectable default is the
+  sanctioned idiom; *calling* it inside the core is the bug.  RNG must be
+  seeded at construction (``random.Random(seed)``); module-level
+  ``random.random()`` / bare ``Random()`` are findings.
+- **DYN604** — stability-registry staleness, same contract as DYN504: a
+  renamed hot-path function or deterministic-core class must fail the
+  lint, not silently drop out of coverage.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .callgraph import CorpusGraph, FunctionUnit
+from .core import Finding, _walk_same_func, call_target, dotted_name, make_finding
+from .registry import (
+    ARRAY_NAMESPACES,
+    BUCKET_HELPER_TAILS,
+    DETERMINISTIC_CORE_CLASSES,
+    DETERMINISTIC_CORE_PATHS,
+    DTYPE_NAME_TAILS,
+    HOT_PATH_FUNCTIONS,
+    HOT_PATH_PATHS,
+    LITERAL_CONSTRUCTOR_TAILS,
+    RAW_CLOCK_DOTTED,
+    RAW_RNG_PREFIXES,
+    SEEDED_RNG_TAILS,
+    SHAPE_CONSTRUCTOR_TAILS,
+    TRACED_DISPATCH_TAILS,
+)
+from .rules_lifetime import REGISTRY_PATH, _is_real_corpus, _registry_finding
+
+STABILITY_RULES = ("DYN601", "DYN602", "DYN603", "DYN604")
+
+
+def _finding(
+    rule: str, unit: FunctionUnit, node: ast.AST, message: str, lines: List[str]
+) -> Finding:
+    return make_finding(rule, unit.path, unit.qualname, node, message, lines)
+
+
+def _is_hot_path(unit: FunctionUnit) -> bool:
+    return unit.path.startswith(HOT_PATH_PATHS) or unit.name in HOT_PATH_FUNCTIONS
+
+
+def _is_deterministic_core(unit: FunctionUnit) -> bool:
+    return (
+        unit.class_name in DETERMINISTIC_CORE_CLASSES
+        or unit.path in DETERMINISTIC_CORE_PATHS
+    )
+
+
+# ---------------------------------------------------------------------------
+# DYN601
+# ---------------------------------------------------------------------------
+
+
+def _dtype_like(node: ast.AST) -> bool:
+    d = dotted_name(node)
+    if d is None:
+        return False
+    tail = d.rsplit(".", 1)[-1]
+    return tail in DTYPE_NAME_TAILS or "dtype" in tail.lower()
+
+
+def _has_explicit_dtype(call: ast.Call) -> bool:
+    if any(kw.arg == "dtype" for kw in call.keywords):
+        return True
+    return any(_dtype_like(a) for a in call.args)
+
+
+_LITERALISH = (ast.List, ast.Tuple, ast.Constant, ast.ListComp, ast.GeneratorExp)
+
+
+def _check_dyn601(unit: FunctionUnit, lines: List[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in _walk_same_func(unit.node):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted, tail = call_target(node)
+        if dotted is None or tail is None or "." not in dotted:
+            continue
+        ns = dotted.rsplit(".", 1)[0]
+        if ns not in ARRAY_NAMESPACES:
+            continue
+        if tail in SHAPE_CONSTRUCTOR_TAILS:
+            if not _has_explicit_dtype(node):
+                findings.append(
+                    _finding(
+                        "DYN601",
+                        unit,
+                        node,
+                        f"`{dotted}` without an explicit dtype on a "
+                        "registered hot-path function: the result dtype "
+                        "follows the x64 flag / weak-type promotion, so the "
+                        "jit cache key (and KV bytes) can differ across "
+                        "processes — pass dtype= explicitly",
+                        lines,
+                    )
+                )
+        elif tail in LITERAL_CONSTRUCTOR_TAILS:
+            if (
+                node.args
+                and isinstance(node.args[0], _LITERALISH)
+                and not _has_explicit_dtype(node)
+            ):
+                findings.append(
+                    _finding(
+                        "DYN601",
+                        unit,
+                        node,
+                        f"`{dotted}` over a Python literal without a dtype "
+                        "on a registered hot-path function: literal "
+                        "promotion is flag-dependent and destabilizes the "
+                        "jit cache key — pass dtype= (an ndarray argument "
+                        "would carry its own dtype and is fine)",
+                        lines,
+                    )
+                )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# DYN602
+# ---------------------------------------------------------------------------
+
+
+def _dispatch_args(call: ast.Call) -> Optional[List[ast.AST]]:
+    """Traced-call argument expressions, or None if not a dispatch site.
+    Handles both ``self._step_fn(...)`` and the engine's
+    ``asyncio.to_thread(self._step_fn, ...)`` indirection."""
+    _, tail = call_target(call)
+    if tail in TRACED_DISPATCH_TAILS:
+        return list(call.args) + [kw.value for kw in call.keywords]
+    if tail == "to_thread" and call.args:
+        d = dotted_name(call.args[0]) or ""
+        if d.rsplit(".", 1)[-1] in TRACED_DISPATCH_TAILS:
+            return list(call.args[1:]) + [kw.value for kw in call.keywords]
+    return None
+
+
+def _bucketed(expr: ast.AST) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.LShift):
+            return True
+        if isinstance(sub, ast.Call):
+            _, t = call_target(sub)
+            if t in BUCKET_HELPER_TAILS:
+                return True
+    return False
+
+
+def _check_dyn602(unit: FunctionUnit, lines: List[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in _walk_same_func(unit.node):
+        if not isinstance(node, ast.Call):
+            continue
+        args = _dispatch_args(node)
+        if args is None:
+            continue
+        for arg in args:
+            if _bucketed(arg):
+                continue
+            for sub in ast.walk(arg):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Name)
+                    and sub.func.id == "len"
+                ):
+                    findings.append(
+                        _finding(
+                            "DYN602",
+                            unit,
+                            sub,
+                            "raw `len(...)` flows into a traced dispatch "
+                            "argument: every distinct length keys a fresh "
+                            "XLA compile in the serving path — round "
+                            "through `1 << (n - 1).bit_length()` or a "
+                            "registered bucket helper first",
+                            lines,
+                        )
+                    )
+                    break
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# DYN603
+# ---------------------------------------------------------------------------
+
+
+def _check_dyn603(unit: FunctionUnit, lines: List[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in _walk_same_func(unit.node):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted, tail = call_target(node)
+        if dotted is None:
+            continue
+        if dotted in RAW_CLOCK_DOTTED:
+            findings.append(
+                _finding(
+                    "DYN603",
+                    unit,
+                    node,
+                    f"`{dotted}()` called inside a registered deterministic "
+                    "core: decisions stop being a function of their inputs "
+                    "(the PR 8 TimedWindow wall-clock class) — inject the "
+                    "clock (`clock=time.monotonic` default param, call "
+                    "`self._clock()`)",
+                    lines,
+                )
+            )
+            continue
+        if dotted.startswith(RAW_RNG_PREFIXES):
+            if tail in SEEDED_RNG_TAILS and node.args:
+                continue  # random.Random(seed) / default_rng(seed): sanctioned
+            findings.append(
+                _finding(
+                    "DYN603",
+                    unit,
+                    node,
+                    f"`{dotted}()` inside a registered deterministic core "
+                    "draws from process-global/unseeded RNG: replay and "
+                    "sim diverge run-to-run — construct a seeded generator "
+                    "(`random.Random(seed)` / `np.random.default_rng(seed)`)"
+                    " and draw from it",
+                    lines,
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# DYN604: stability-registry staleness
+# ---------------------------------------------------------------------------
+
+
+def _check_staleness(graph: CorpusGraph) -> List[Finding]:
+    if not _is_real_corpus(graph):
+        return []
+    findings: List[Finding] = []
+    corpus_paths = {p for p, _s, _t in graph.files}
+    classes: Set[str] = set()
+    for _p, _s, tree in graph.files:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                classes.add(node.name)
+    for name in sorted(HOT_PATH_FUNCTIONS):
+        if name not in graph.by_name:
+            findings.append(
+                _registry_finding(
+                    "DYN604",
+                    f"HOT_PATH_FUNCTIONS.{name}",
+                    f"stale hot-path registry entry: `{name}` is defined "
+                    "nowhere in the corpus — dtype/shape discipline "
+                    "silently stopped covering it",
+                )
+            )
+    for path in sorted(DETERMINISTIC_CORE_PATHS):
+        if path not in corpus_paths:
+            findings.append(
+                _registry_finding(
+                    "DYN604",
+                    f"DETERMINISTIC_CORE_PATHS.{path}",
+                    f"stale deterministic-core registry entry: `{path}` is "
+                    "not in the corpus — the module moved out of clock/RNG "
+                    "coverage",
+                )
+            )
+    for cls in sorted(DETERMINISTIC_CORE_CLASSES):
+        if cls not in classes:
+            findings.append(
+                _registry_finding(
+                    "DYN604",
+                    f"DETERMINISTIC_CORE_CLASSES.{cls}",
+                    f"stale deterministic-core registry entry: class "
+                    f"`{cls}` is defined nowhere in the corpus — rename "
+                    "the entry or the class",
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+
+def check_stability(
+    graph: CorpusGraph,
+    rules: Set[str],
+    lines_of: Dict[str, List[str]],
+    scope: Optional[Set[str]] = None,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for unit in graph.functions:
+        if scope is not None and unit.path not in scope:
+            continue
+        lines = lines_of[unit.path]
+        if _is_hot_path(unit):
+            if "DYN601" in rules:
+                findings.extend(_check_dyn601(unit, lines))
+        if "DYN602" in rules:
+            findings.extend(_check_dyn602(unit, lines))
+        if "DYN603" in rules and _is_deterministic_core(unit):
+            findings.extend(_check_dyn603(unit, lines))
+    if "DYN604" in rules:
+        findings.extend(_check_staleness(graph))
+    return findings
