@@ -37,6 +37,18 @@ L7    KV CORRUPTION STORM: ``kv_corrupt`` armed on every integrity plane
       (engine/integrity.py) must detect EVERY injected flip before any
       scatter, drop + negative-cache the poisoned chain, and recompute:
       0 dropped streams, 0 poisoned tokens, byte-identity vs L0
+L8    HUB SHARD KILL: one hub shard's primary dies mid-burst and its warm
+      standby promotes onto the same address; the sibling shard never
+      blips and goodput holds the L2 bar
+L9    BULK PEER KILL: a ``_drive_bulk`` driver runs continuous prefix
+      pulls over the peer-to-peer bulk plane (transports/bulk.py) while
+      ``bulk_conn_drop`` aborts connections mid-chunk (→ resume from the
+      last verified chunk), the victim's bulk SERVER is killed outright
+      for a window (→ hub-path fallback, then recovery once it
+      re-registers), and ``bulk_slow_peer`` stalls chunks late in the
+      trace.  Bars: >=1 bulk transfer, >=1 resume, >=1 fallback, a
+      post-revival recovery, every bulk stream byte-identical to the
+      hub-path oracle, and 0 dropped streams
 ====  =======================================================================
 
 Determinism: the trace, every request's sampling seed, and the fault
@@ -213,6 +225,16 @@ def ladder_rungs() -> List[Dict[str, Any]]:
     # keys throughout and the routed clients ride their local routing cache
     # through the failover window (docs/hub.md).
     shard_kill = FaultEvent("hub_shard_kill", at=0.40, until=0.52)
+    # L9: the bulk data plane under fire (docs/bulk_plane.md).  The armed
+    # count=2 drop forces mid-chunk aborts the client must RESUME through;
+    # the driver additionally kills the victim's bulk server outright over
+    # [0.45, 0.70] (a dead peer, not a dropped connection — resume cannot
+    # help, the fallback ladder must) and the late slow_peer window stalls
+    # chunks without breaking transfers.
+    bulk_faults = [
+        FaultEvent("bulk_conn_drop", at=0.15, count=2),
+        FaultEvent("bulk_slow_peer", at=0.80, until=0.90, level=0.05),
+    ]
     return [
         {"level": 0, "name": "L0-baseline", "events": []},
         {"level": 1, "name": "L1-worker-crash", "events": [crash1]},
@@ -230,6 +252,8 @@ def ladder_rungs() -> List[Dict[str, Any]]:
          "events": corrupt, "corrupt": True},
         {"level": 8, "name": "L8-hub-shard-kill",
          "events": [shard_kill], "shards": 2},
+        {"level": 9, "name": "L9-bulk-peer-kill",
+         "events": bulk_faults, "bulk": True},
     ]
 
 
@@ -915,6 +939,154 @@ async def _drive_corruption(
     return outcomes
 
 
+async def _drive_bulk(
+    fleet: ChaosFleet,
+    t_start: float,
+    *,
+    duration: float,
+    kill_at: float = 0.45,
+    kill_until: float = 0.70,
+) -> Dict[str, Any]:
+    """The bulk-plane driver (the L9 rung): run a bulk server per live
+    worker (the same wiring ``cli.py start_decode`` does under
+    ``DYN_BULK_PLANE``), then pull the prewarmed prefix peer-to-peer in a
+    continuous wave loop while the rung's faults land.
+
+    Each wave takes a hub-path ORACLE (a direct ``export_prompt_blocks``
+    on the donor — the exact computation the service-plane exporter would
+    run) and then fetches the same export over the bulk plane.  A bulk
+    miss — dead peer, exhausted resumes — serves the oracle instead (the
+    fallback ladder), so no wave ever drops its stream.  Over
+    [kill_at, kill_until] the victim worker's bulk server is CLOSED (a
+    dead peer, not a dropped connection): waves pinned to it must fall
+    back, and after the server re-registers a later wave must complete
+    over the bulk plane again (``recovered``).  Byte-identity compares
+    the fetched blob against the oracle encodes taken immediately before
+    AND after the fetch (the donor's tiers churn under the main trace, so
+    one snapshot could legitimately differ)."""
+    from dynamo_tpu.llm.kv_router.pull import (
+        KV_EXPORT_ENDPOINT,
+        make_bulk_export_source,
+    )
+    from dynamo_tpu.runtime.transports import codec
+    from dynamo_tpu.runtime.transports.bulk import (
+        BulkRendezvous,
+        BulkServer,
+        bulk_addr_key,
+        bulk_fetch,
+    )
+
+    hub = fleet.client_rt.hub
+
+    async def spawn_server(worker) -> BulkServer:
+        # Small chunks so the armed conn-drop lands MID-stream and resume
+        # has a verified prefix to keep.
+        srv = BulkServer(
+            worker_id=worker.runtime.worker_id, hub=hub, chunk_bytes=4096
+        )
+        srv.register_source(
+            KV_EXPORT_ENDPOINT, make_bulk_export_source(worker.engine)
+        )
+        await srv.start()
+        await hub.kv_put(
+            bulk_addr_key(worker.runtime.worker_id), {"address": srv.address}
+        )
+        return srv
+
+    workers = [w for w in fleet.workers if not w.closed]
+    servers: List[Any] = [await spawn_server(w) for w in workers]
+    # Short lookup cache so the revived victim's NEW address is seen
+    # within a wave or two of re-registration.
+    rdv = BulkRendezvous(hub, cache_ttl_s=0.2)
+    warm = _prompt_tokens(10_000, 16)  # the prefix prewarm sealed everywhere
+    stats = {
+        "pulls": 0, "bulk_ok": 0, "fallbacks": 0, "mismatches": 0,
+        "recovered": False,
+    }
+    victim = 0
+    killed = False
+    wave = 0
+    delay = 0.05 * duration - (time.monotonic() - t_start)
+    if delay > 0:
+        await asyncio.sleep(delay)
+    try:
+        while (elapsed := time.monotonic() - t_start) < 0.95 * duration:
+            in_kill = kill_at * duration <= elapsed < kill_until * duration
+            if in_kill and not killed:
+                logger.warning("[bulk] killing worker %d's bulk server", victim)
+                await servers[victim].close()
+                killed = True
+            elif killed and elapsed >= kill_until * duration:
+                servers[victim] = await spawn_server(workers[victim])
+                killed = False
+                logger.warning("[bulk] worker %d's bulk server revived", victim)
+            # Pin waves to the victim while it is dead — the fallback path
+            # is the thing under test in that window.
+            donor = workers[victim if killed else wave % len(workers)]
+            eng = donor.engine
+            try:
+                await eng.restore_prefix(warm)
+                oracle = await eng.export_prompt_blocks(warm)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — donor busy; skip the wave
+                logger.warning("[bulk] oracle export failed", exc_info=True)
+                oracle = None
+            if oracle is None:
+                wave += 1
+                await asyncio.sleep(0.1)
+                continue
+            oracle_blob = codec.encode(oracle)
+            blob = None
+            prep = await rdv.prepare(
+                donor.runtime.worker_id,
+                budget=2 * len(oracle_blob) + (1 << 20),
+            )
+            if prep is not None:
+                try:
+                    blob = await bulk_fetch(
+                        prep[0], KV_EXPORT_ENDPOINT, prep[1],
+                        meta={"token_ids": warm},
+                        timeout_s=2.0, max_resumes=2,
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — dead peer / resumes spent
+                    blob = None
+            stats["pulls"] += 1
+            if blob is None:
+                # Hub-path fallback: the oracle IS the stream — no drop.
+                stats["fallbacks"] += 1
+                from dynamo_tpu.llm.metrics import bulk_metrics
+
+                bulk_metrics.fallbacks_total += 1
+            else:
+                stats["bulk_ok"] += 1
+                if not killed and elapsed >= kill_until * duration:
+                    stats["recovered"] = True
+                if blob != oracle_blob:
+                    try:
+                        after_blob = codec.encode(
+                            await eng.export_prompt_blocks(warm)
+                        )
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:  # noqa: BLE001
+                        after_blob = None
+                    if blob != after_blob:
+                        stats["mismatches"] += 1
+            wave += 1
+            await asyncio.sleep(0.1)
+    finally:
+        for srv in servers:
+            try:
+                await srv.close()
+            except Exception:  # noqa: BLE001 — victim already closed
+                pass
+    logger.info("[bulk] %s over %d waves", stats, wave)
+    return stats
+
+
 async def _score_tracing(trace_agg, trace_exporter, trace_ctxs) -> Dict[str, Any]:
     """The L0 rung's ``tracing`` block: a stamped trace counts as ASSEMBLED
     once the aggregator holds its driver root span plus an ENGINE span —
@@ -1051,6 +1223,16 @@ async def run_rung(
                 seed=seed, duration=duration, isl=isl, osl=osl,
             )
         )
+    bulk_task = None
+    bulk_before = None
+    bulk_block = None
+    if rung.get("bulk"):
+        from dynamo_tpu.llm.metrics import bulk_metrics
+
+        bulk_before = bulk_metrics.snapshot()
+        bulk_task = asyncio.ensure_future(
+            _drive_bulk(fleet, t_start, duration=duration)
+        )
     try:
         for i, arrival in enumerate(trace):
             delay = arrival.t - (time.monotonic() - t_start)
@@ -1077,6 +1259,22 @@ async def run_rung(
             # Same contract for the corruption storm: every storm stream
             # must COMPLETE — detection degrades to recompute, never a drop.
             outcomes.extend(await storm_task)
+        if bulk_task is not None:
+            from dynamo_tpu.llm.metrics import bulk_metrics
+
+            stats = await bulk_task
+            snap = bulk_metrics.snapshot()
+            bulk_block = {
+                **stats,
+                "transfers": int(snap["transfers_total"]
+                                 - bulk_before["transfers_total"]),
+                "resumes": int(snap["resumes_total"]
+                               - bulk_before["resumes_total"]),
+                "bytes": int(snap["bytes_total"] - bulk_before["bytes_total"]),
+                "fault_fired": sum(
+                    f.fired for f in armed if f.point.startswith("bulk_")
+                ),
+            }
         await asyncio.gather(*fault_tasks)
     finally:
         for t in (*req_tasks, *fault_tasks):
@@ -1085,6 +1283,8 @@ async def run_rung(
             flood_task.cancel()
         if storm_task is not None:
             storm_task.cancel()
+        if bulk_task is not None:
+            bulk_task.cancel()
         if trace_exporter is not None:
             await trace_exporter.stop(final_flush=False)
         if trace_agg is not None:
@@ -1163,6 +1363,8 @@ async def run_rung(
     }
     if tracing_block is not None:
         report["tracing"] = tracing_block
+    if bulk_block is not None:
+        report["bulk"] = bulk_block
     if corrupt_events:
         # The L7 bars: every armed kv_corrupt firing is one injected flip,
         # and the integrity plane's corrupt counters advance exactly once
@@ -1291,6 +1493,34 @@ def check_report(
                     f"L8 goodput {rungs[8]['goodput']:.3f} is "
                     f"{ratio:.2f}x L0 ({l0['goodput']:.3f}); bar is {min_ratio}"
                 )
+    if 9 in rungs:
+        # Bulk-plane rung: transfers must actually have moved over the
+        # peer plane, the armed conn-drops must have forced resumes, the
+        # peer kill must have forced hub-path fallbacks AND a post-revival
+        # recovery, and every bulk stream must be byte-identical to the
+        # hub-path oracle.  (0 dropped is the generic bar above.)
+        b = rungs[9].get("bulk") or {}
+        if b.get("bulk_ok", 0) < 1:
+            problems.append(
+                "L9: no transfer completed over the bulk plane"
+            )
+        if b.get("resumes", 0) < 1:
+            problems.append(
+                "L9: conn drops forced no resume-from-verified-chunk"
+            )
+        if b.get("fallbacks", 0) < 1:
+            problems.append(
+                "L9: the bulk peer kill produced no hub-path fallback"
+            )
+        if not b.get("recovered"):
+            problems.append(
+                "L9: no bulk transfer completed after the peer revived"
+            )
+        if b.get("mismatches", 0):
+            problems.append(
+                f"L9: {b['mismatches']} bulk stream(s) diverged from the "
+                "hub-path oracle (bulk plane not byte-identical)"
+            )
     return problems
 
 

@@ -55,6 +55,20 @@ class RequestResult:
     trace_id: Optional[str] = None
 
 
+def _bulk_summary() -> Optional[dict]:
+    """Bulk data-plane counters for the run summary (docs/bulk_plane.md):
+    cumulative process-local ``dynamo_tpu_bulk_*`` — non-empty only when a
+    colocated engine actually moved bytes peer-to-peer (DYN_BULK_PLANE)."""
+    try:
+        from dynamo_tpu.llm.metrics import bulk_metrics
+    except ImportError:
+        return None
+    snap = bulk_metrics.snapshot()
+    if not any(snap.values()):
+        return None
+    return {k: int(v) for k, v in snap.items()}
+
+
 def _pct(xs: List[float], p: float) -> float:
     if not xs:
         return 0.0
@@ -618,6 +632,9 @@ async def main() -> None:
                 file=sys.stderr,
             )
             row = await _session_sweep(url, args.model, args, vocab)
+            bulk = _bulk_summary()
+            if bulk:
+                row["bulk"] = bulk
             print(json.dumps(row), flush=True)
             if args.out:
                 with open(args.out, "w") as f:
@@ -635,6 +652,9 @@ async def main() -> None:
             )
             row = await _run_trace(url, args.model, arrivals, vocab,
                                    trace_every=trace_every)
+            bulk = _bulk_summary()
+            if bulk:
+                row["bulk"] = bulk
             print(json.dumps(row), flush=True)
             if args.out:
                 with open(args.out, "w") as f:
@@ -681,6 +701,9 @@ async def main() -> None:
                 row["admission_wait_p99_ms"] = round(
                     _pct(aw, 0.99) * 1e3, 1
                 )
+            bulk = _bulk_summary()
+            if bulk:
+                row["bulk"] = bulk
             rows.append(row)
             print(json.dumps(row), flush=True)
             if engine is not None:

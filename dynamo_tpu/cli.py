@@ -175,6 +175,7 @@ async def _run_http_frontend(args) -> None:
         runtime.namespace(ns), service.metrics, qos=qos_ctl
     ).start()
     exporter = None
+    bulk_ingest = None
     if aggregator is not None:
         # Span plane (docs/tracing.md): workers publish span batches on the
         # namespace's ``traces`` subject — the aggregator subscribes and
@@ -183,6 +184,16 @@ async def _run_http_frontend(args) -> None:
         from .runtime.tracing import SpanExporter
 
         await aggregator.start(runtime.namespace(ns))
+        from .runtime.transports.bulk import bulk_enabled
+
+        if bulk_enabled():
+            # Bulk span ingest (docs/bulk_plane.md): worker exporters push
+            # batches straight here instead of fanning through the hub's
+            # pub/sub plane; the subscription above stays live as the
+            # fallback path (and the A/B oracle).
+            from .llm.trace_service import start_bulk_ingest
+
+            bulk_ingest = await start_bulk_ingest(aggregator, runtime)
         exporter = await SpanExporter(
             [aggregator],
             interval_s=tracing_cfg.export_interval_s,
@@ -194,6 +205,8 @@ async def _run_http_frontend(args) -> None:
     finally:
         if exporter is not None:
             await exporter.stop()
+        if bulk_ingest is not None:
+            await bulk_ingest.close()
         if aggregator is not None:
             await aggregator.stop()
         await slo_pub.stop()
@@ -426,8 +439,21 @@ async def _run(args) -> None:
             async def _publish_spans(payload):
                 await namespace.publish(TRACES_TOPIC, payload)
 
+            span_sink = _publish_spans
+            from .runtime.transports.bulk import BulkRendezvous, bulk_enabled
+
+            if bulk_enabled():
+                # Bulk span export (docs/bulk_plane.md): batches push
+                # directly to the edge aggregator's bulk sink; the hub
+                # publish above stays wired as the fallback rung.
+                from .llm.trace_service import make_bulk_span_sink
+
+                span_sink = make_bulk_span_sink(
+                    BulkRendezvous(runtime.hub, lease=runtime.primary_lease),
+                    _publish_spans,
+                )
             trace_exporter = await SpanExporter(
-                [_publish_spans],
+                [span_sink],
                 interval_s=tcfg.export_interval_s,
                 proc=f"worker-{runtime.worker_id}",
             ).start()
@@ -631,6 +657,70 @@ class WorkerRoles:
             from .llm.metrics import kv_tier_metrics
 
             kv_tier_metrics.set_source(engine.kv_tier_summary)
+        from .runtime.transports.bulk import bulk_enabled
+
+        if bulk_enabled() and hasattr(engine, "inject_blocks"):
+            # Bulk data plane (docs/bulk_plane.md, DYN_BULK_PLANE): run this
+            # worker's peer-to-peer stream server, register its address for
+            # hub rendezvous, and repoint the bulk producers (prefix pull
+            # exporter, migration copy stream) at it.  Every producer keeps
+            # its hub-path transport wired underneath as the fallback rung,
+            # so a dead bulk peer costs a fallback tick, never a stream.
+            from .llm.kv_router.pull import (
+                KV_EXPORT_ENDPOINT,
+                PrefixPuller,
+                make_bulk_export_source,
+                make_bulk_exporter,
+                make_client_exporter,
+            )
+            from .runtime.transports.bulk import (
+                BulkRendezvous,
+                BulkServer,
+                bulk_addr_key,
+            )
+
+            bulk_srv = BulkServer(
+                getattr(runtime, "_host", "127.0.0.1"),
+                worker_id=runtime.worker_id,
+                hub=runtime.hub,
+            )
+            bulk_srv.register_source(
+                KV_EXPORT_ENDPOINT, make_bulk_export_source(engine)
+            )
+            if h.get("mig") is not None:
+                from .llm.migration import MIGRATE_IN_ENDPOINT
+                from .llm.migration.worker import make_migrate_in_sink
+
+                bulk_srv.register_sink(
+                    MIGRATE_IN_ENDPOINT, make_migrate_in_sink(h["mig"])
+                )
+            await bulk_srv.start()
+            await runtime.register_key(
+                bulk_addr_key(runtime.worker_id),
+                {
+                    "address": bulk_srv.address,
+                    "worker_id": str(runtime.worker_id),
+                },
+            )
+            rendezvous = BulkRendezvous(
+                runtime.hub, lease=runtime.primary_lease
+            )
+            if h.get("mig") is not None:
+                h["mig"].bulk = rendezvous
+            if h.get("pull_client") is not None and hasattr(
+                engine, "set_prefix_puller"
+            ):
+                engine.set_prefix_puller(
+                    PrefixPuller(
+                        engine,
+                        make_bulk_exporter(
+                            rendezvous,
+                            make_client_exporter(h["pull_client"]),
+                            max_bytes=engine.cfg.kv_pull_max_bytes,
+                        ),
+                    )
+                )
+            h["bulk_srv"] = bulk_srv
         await register_model(
             runtime,
             args.model,
@@ -694,6 +784,16 @@ class WorkerRoles:
             await h["disagg"].drain(timeout=10.0)
         for served in reversed(h["serveds"]):
             await served.stop()
+        if h.get("bulk_srv") is not None:
+            # De-advertise BEFORE closing so a rendezvous racing the close
+            # resolves to nothing (a caller falls back) instead of dialing
+            # a dead address until its resume budget runs out.
+            from .runtime.transports.bulk import bulk_addr_key
+
+            await self.runtime.unregister_key(
+                bulk_addr_key(self.runtime.worker_id)
+            )
+            await h["bulk_srv"].close()
         if h.get("prefetch") is not None:
             await h["prefetch"].stop()
         if hasattr(self.engine, "set_prefix_puller"):

@@ -48,6 +48,7 @@ class KvTransferMixin:
         start_block: int = 0,
         max_blocks: int = 0,
         salt: Optional[str] = None,
+        blocks: Optional[List[Any]] = None,
     ) -> Optional[Dict[str, Any]]:
         """Gather cached KV for ``token_ids``'s complete blocks to host.
 
@@ -59,6 +60,13 @@ class KvTransferMixin:
         ``salt`` is the owning tenant's KV salt (llm/tenancy): tenant
         blocks seal under salted chained hashes, so an unsalted lookup
         cannot see them — and can never LEAK them to another tenant.
+
+        ``blocks`` lets a caller that already holds the sealed chained-hash
+        list (migration sends the same tokens chunk after chunk; the
+        indexer sealed the chain once) pass it through instead of paying
+        the O(len(tokens)) rehash per chunk.  Under ``__debug__`` the
+        passed chain is asserted equal to a fresh recompute — a stale or
+        wrongly-salted chain must fail loudly, not seal wrong bytes.
         """
         from ..tokens import hash_token_blocks
 
@@ -68,7 +76,13 @@ class KvTransferMixin:
             # time so the caller falls back to local prefill instead of
             # hanging on a non-addressable array (ADVICE r3).
             return None
-        blocks = hash_token_blocks(token_ids, self.cfg.block_size, salt)
+        if blocks is None:
+            blocks = hash_token_blocks(token_ids, self.cfg.block_size, salt)
+        elif __debug__:
+            fresh = hash_token_blocks(token_ids, self.cfg.block_size, salt)
+            assert [tb.sequence_hash for tb in blocks] == [
+                tb.sequence_hash for tb in fresh
+            ], "export_prompt_blocks: passed block chain != sealed recompute"
         ids: List[int] = []
         for tb in blocks[start_block:]:
             bid = self.kv._by_hash.get(tb.sequence_hash)

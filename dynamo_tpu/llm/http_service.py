@@ -283,6 +283,7 @@ class HttpService:
         from ..planner.pmetrics import metrics as planner_metrics
         from ..runtime.health import health_metrics
         from .metrics import (
+            bulk_metrics,
             engine_dispatch_metrics,
             kv_integrity_metrics,
             kv_tier_metrics,
@@ -307,6 +308,7 @@ class HttpService:
             + engine_dispatch_metrics.render(self._metrics_prefix).encode()
             + kv_tier_metrics.render(self._metrics_prefix).encode()
             + kv_integrity_metrics.render(self._metrics_prefix).encode()
+            + bulk_metrics.render(self._metrics_prefix).encode()
             + shard_metrics.render(self._metrics_prefix).encode()
         )
         return web.Response(body=body, content_type="text/plain")

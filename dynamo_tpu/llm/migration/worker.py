@@ -77,6 +77,10 @@ class MigratableWorker(AsyncEngine):
         # short-circuit the service plane (tests; single-process fleets).
         self.direct = direct or {}
         self._clients: Dict[str, Client] = {}
+        # Bulk data plane (transports/bulk.py, DYN_BULK_PLANE): when the
+        # CLI wires a BulkRendezvous here, phase-1 copy payloads move
+        # worker↔worker instead of through the hub; None = hub path only.
+        self.bulk = None
         # Accept-time capability gate: a draining worker flips this False
         # BEFORE starting its own migrate-out (cli WorkerRoles.stop_decode),
         # closing the de-advertise propagation race — a peer whose hub
@@ -332,12 +336,20 @@ class MigratableWorker(AsyncEngine):
         number of complete blocks shipped.  Raises on a target refusal.
         ``salt`` is the owning tenant's KV salt (llm/tenancy) — the export
         lookup and the target's sealing must both use it."""
+        from ...tokens import hash_token_blocks
+
         bs = self.engine.cfg.block_size
         sent = 0
+        # Seal the chained hashes ONCE per push round: every chunk export
+        # below walks the same token list, and recomputing the O(len(tokens))
+        # chain inside export_prompt_blocks per chunk made the copy phase
+        # quadratic in sequence length (the export asserts the passed chain
+        # against a fresh recompute under __debug__).
+        chain = hash_token_blocks(tokens, bs, salt)
         while True:
             payload = await self.engine.export_prompt_blocks(
                 tokens, start_block=cursor + sent, max_blocks=self.chunk_blocks,
-                salt=salt,
+                salt=salt, blocks=chain,
             )
             if payload is None:
                 return sent
@@ -380,6 +392,16 @@ class MigratableWorker(AsyncEngine):
     async def _send(
         self, target: Dict[str, Any], data: Dict[str, Any]
     ) -> Dict[str, Any]:
+        if self.bulk is not None and data.get("kind") == "blocks":
+            # Bulk plane (DYN_BULK_PLANE): the KV copy stream — the only
+            # bulk-sized migrate_in payload — moves worker↔worker; commits
+            # stay on the service plane (control-sized, ordering-critical).
+            resp = await self._send_bulk(target, data)
+            if resp is not None:
+                return resp
+            from ..metrics import bulk_metrics
+
+            bulk_metrics.fallbacks_total += 1
         peer = self.direct.get(target.get("address", ""))
         if peer is not None:
             return await peer._migrate_in(data)
@@ -390,8 +412,54 @@ class MigratableWorker(AsyncEngine):
             resp = item
         return resp
 
+    async def _send_bulk(
+        self, target: Dict[str, Any], data: Dict[str, Any]
+    ) -> Optional[Dict[str, Any]]:
+        """Push one copy-stream payload over the bulk plane; None means
+        'use the hub path' (peer without a bulk server, rendezvous outage,
+        transfer dead after resumes) — never an error, the stream survives
+        on the fallback."""
+        from ...runtime.transports import codec
+        from ...runtime.transports.bulk import bulk_push
+
+        wid = target.get("worker_id")
+        if wid is None:
+            return None
+        salt = data.get("salt")
+        blob = codec.encode(data)
+        try:
+            prep = await self.bulk.prepare(wid, salt=salt, budget=len(blob))
+            if prep is None:
+                return None
+            address, ticket = prep
+            reply = await bulk_push(
+                address, MIGRATE_IN_ENDPOINT, ticket, blob, salt=salt
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — fallback ladder: hub path next
+            logger.warning(
+                "bulk migrate push to worker %s failed; falling back to the "
+                "hub path", wid, exc_info=True,
+            )
+            return None
+        return reply if isinstance(reply, dict) else None
+
     def _client_for(self, address: str, path: str) -> Client:
         key = f"{address}/{path}"
         if key not in self._clients:
             self._clients[key] = Client.static(address, path)
         return self._clients[key]
+
+
+def make_migrate_in_sink(worker: MigratableWorker):
+    """Target-side bulk *sink* for ``MIGRATE_IN_ENDPOINT``: the blob is the
+    codec-encoded migrate_in data dict; the reply is ``_migrate_in``'s
+    verdict (ok / tokens_covered), which the source consumes exactly as it
+    would a service-plane response."""
+    from ...runtime.transports import codec
+
+    async def sink(blob: bytes, meta: Dict[str, Any]) -> Dict[str, Any]:
+        return await worker._migrate_in(codec.decode(blob))
+
+    return sink

@@ -44,6 +44,14 @@ point              hooked in                                  simulates
                    the window, then promotes its warm         mid-burst; the
                    ``HubStandby`` onto the same address)      standby takes
                                                               over the shard
+``bulk_conn_drop`` ``transports/bulk.BulkServer``             bulk peer dies
+                   (aborts the peer connection between        mid-transfer;
+                   chunks; cached transfer state survives     the client
+                   for resume)                                resumes, else
+                                                              falls back
+``bulk_slow_peer`` ``transports/bulk.BulkServer``             straggler bulk
+                   (``delay_s`` stall before each chunk)      peer stalls
+                                                              each chunk
 =================  =========================================  ==============
 
 ``tenant_flood`` is a *traffic* fault, not a transport one: the armed level
@@ -67,6 +75,18 @@ park/replay, lease-floor preservation across the handoff, and the routed
 clients' degraded-mode routing cache.  Armed per-shard *outage* (drop
 connections without failover) is already expressible as
 ``hub_outage:<shard address>``.
+
+``bulk_conn_drop`` / ``bulk_slow_peer`` are *bulk data-plane* faults
+(transports/bulk.py, docs/bulk_plane.md): hook keys are
+``<bulk address>/<source>``, so a fault can target one peer's KV export
+stream (``bulk_conn_drop:kv_export``) or every bulk transfer (``*``).
+``bulk_conn_drop`` aborts the TCP connection between chunks while the
+server's live transfer state survives — the system under test is
+resume-from-last-verified-chunk plus the fallback ladder (hub path, then
+local recompute): streams stay byte-identical and none drop (the L9 chaos
+rung).  ``bulk_slow_peer`` stalls ``delay_s`` before each chunk (a
+straggling peer NIC); the client's per-attempt timeout converts a
+hopeless straggler into a hub-path fallback instead of a hung pull.
 
 Arming: programmatic (``faults.arm("connect_error", match=addr, count=2)``)
 or env-driven for subprocess workers — ``DYN_FAULTS`` is a comma-separated

@@ -472,6 +472,20 @@ class Subscription(_QueueIter):
     """Async iterator of (subject, payload) with unsubscribe."""
 
 
+def _payload_nbytes(payload: Any) -> int:
+    """Approximate serialized size of a publish payload, for the per-shard
+    control-plane volume counters (shard.HubShardMetrics.note_publish) —
+    the series that proves bulk bytes left the hub under DYN_BULK_PLANE.
+    Best-effort: an unencodable payload counts 0 rather than failing the
+    publish."""
+    from . import codec
+
+    try:
+        return len(codec.encode(payload))
+    except Exception:  # noqa: BLE001 — metrics must never break a publish
+        return 0
+
+
 class InprocHub:
     """Direct in-process hub (single-process serving, tests, static mode).
 
@@ -542,6 +556,9 @@ class InprocHub:
 
     # pub/sub
     async def publish(self, subject, payload) -> None:
+        from .shard import shard_metrics
+
+        shard_metrics.note_publish("inproc", _payload_nbytes(payload))
         await self.state.publish(subject, payload)
 
     async def subscribe(self, pattern) -> Subscription:
@@ -1483,6 +1500,9 @@ class HubClient:
 
     # pub/sub
     async def publish(self, subject, payload) -> None:
+        from .shard import shard_metrics
+
+        shard_metrics.note_publish(self.address, _payload_nbytes(payload))
         await self._request("publish", subject=subject, payload=payload)
 
     async def subscribe(self, pattern) -> Subscription:

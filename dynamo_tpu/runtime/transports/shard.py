@@ -194,6 +194,11 @@ class HubShardMetrics:
         self.parked: Dict[str, int] = {}
         self.replayed: Dict[str, int] = {}
         self.parked_shed: Dict[str, int] = {}
+        # Control-plane publish volume per shard: the bulk plane's proof
+        # metric — with DYN_BULK_PLANE on, KV pulls / migration copies /
+        # span batches leave this series for dynamo_tpu_bulk_bytes_total.
+        self.publishes: Dict[str, int] = {}
+        self.publish_bytes: Dict[str, int] = {}
         self.routing_cache_hits_total = 0
         self.routing_cache_stale_hits_total = 0
         # owner id → monotonic stamp of when that routed client's watch
@@ -220,6 +225,10 @@ class HubShardMetrics:
 
     def note_shed(self, shard: str, n: int = 1) -> None:
         self._bump(self.parked_shed, shard, n)
+
+    def note_publish(self, shard: str, nbytes: int) -> None:
+        self._bump(self.publishes, shard)
+        self._bump(self.publish_bytes, shard, max(0, int(nbytes)))
 
     def note_cache_stale(self, owner: int, since: float) -> None:
         self._stale_since[owner] = since
@@ -268,6 +277,14 @@ class HubShardMetrics:
                   "Parked requests shed by the park-buffer cap "
                   "(oldest-idempotent-first).",
                   self.parked_shed)
+        per_shard("publishes_total",
+                  "Pub/sub publishes sent through this hub shard.",
+                  self.publishes)
+        per_shard("publish_bytes_total",
+                  "Approximate payload bytes published through this hub "
+                  "shard (bulk payloads leave this series under "
+                  "DYN_BULK_PLANE — docs/bulk_plane.md).",
+                  self.publish_bytes)
         lines.append(f"# HELP {ns}_routing_cache_hits_total Instance picks "
                      "served from the local routing cache (never blocks on "
                      "hub RTT).")

@@ -1,0 +1,396 @@
+"""Bulk data plane tests (runtime/transports/bulk.py; docs/bulk_plane.md).
+
+Covers the codec framing round-trip at chunk boundaries (empty payload,
+exactly one chunk, chunk ± 1, resume from chunk k), the one-shot ticket
+lifecycle (expiry, reuse, salt scope, byte budget, the hub as fleet-wide
+spend arbiter), and the producer adapters' A/B contract: the bulk path
+returns byte-identical results to the hub path, and any miss falls back
+to the hub path instead of dropping the stream.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.llm.metrics import bulk_metrics
+from dynamo_tpu.runtime.faultinject import faults
+from dynamo_tpu.runtime.transports import codec
+from dynamo_tpu.runtime.transports.bulk import (
+    BulkRendezvous,
+    BulkServer,
+    BulkTransferError,
+    bulk_addr_key,
+    bulk_fetch,
+    bulk_push,
+    bulk_sink_key,
+    mint_ticket,
+)
+from dynamo_tpu.runtime.transports.hub import InprocHub
+
+pytestmark = pytest.mark.bulk
+
+CHUNK = 16
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def blob_of(n: int) -> bytes:
+    return (bytes(range(256)) * (n // 256 + 1))[:n]
+
+
+async def start_source_server(payloads, **kw):
+    """BulkServer with a tiny chunk size and a 'kv_export' source that
+    serves ``payloads[meta['key']]``."""
+    srv = BulkServer(chunk_bytes=kw.pop("chunk_bytes", CHUNK), **kw)
+
+    async def source(meta):
+        return payloads[meta["key"]]
+
+    srv.register_source("kv_export", source)
+    await srv.start()
+    return srv
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_metrics():
+    faults.reset()
+    bulk_metrics.reset()
+    yield
+    faults.reset()
+    bulk_metrics.reset()
+
+
+# ---------------------------------------------------------------- framing
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize(
+    "size", [0, 1, CHUNK - 1, CHUNK, CHUNK + 1, 3 * CHUNK, 3 * CHUNK + 7]
+)
+async def test_fetch_roundtrip_chunk_boundaries(size):
+    blob = blob_of(size)
+    srv = await start_source_server({"b": blob})
+    try:
+        got = await bulk_fetch(srv.address, "kv_export", mint_ticket("p"),
+                               meta={"key": "b"})
+        assert got == blob
+        assert srv._live == {}  # completed transfer state is released
+    finally:
+        await srv.close()
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize(
+    "size", [0, 1, CHUNK - 1, CHUNK, CHUNK + 1, 3 * CHUNK, 3 * CHUNK + 7]
+)
+async def test_push_roundtrip_chunk_boundaries(size):
+    blob = blob_of(size)
+    landed = []
+    srv = BulkServer(chunk_bytes=CHUNK)
+
+    async def sink(data, meta):
+        landed.append(data)
+        return {"n": len(data)}
+
+    srv.register_sink("migrate_in", sink)
+    await srv.start()
+    try:
+        reply = await bulk_push(srv.address, "migrate_in", mint_ticket("p"),
+                                blob, chunk_bytes=CHUNK)
+        assert reply == {"n": size}
+        assert landed == [blob]
+        assert srv._live == {}
+    finally:
+        await srv.close()
+
+
+@pytest.mark.asyncio
+async def test_fetch_resume_from_chunk_k():
+    """A connection drop after chunk k resumes from k: the client keeps
+    its verified prefix, the server replays only the cached tail, and the
+    assembled stream is byte-identical."""
+    blob = blob_of(5 * CHUNK)
+    srv = await start_source_server({"b": blob})
+    try:
+        faults.arm("bulk_conn_drop", count=2)
+        got = await bulk_fetch(srv.address, "kv_export", mint_ticket("p"),
+                               meta={"key": "b"})
+        assert got == blob
+        snap = bulk_metrics.snapshot()
+        assert snap["resumes_total"] == 2
+        assert snap["transfers_total"] == 1
+        assert snap["bytes_total"] == len(blob)
+    finally:
+        await srv.close()
+
+
+@pytest.mark.asyncio
+async def test_push_resume_from_chunk_k():
+    blob = blob_of(5 * CHUNK)
+    landed = []
+    srv = BulkServer(chunk_bytes=CHUNK)
+
+    async def sink(data, meta):
+        landed.append(data)
+        return {"ok": True}
+
+    srv.register_sink("migrate_in", sink)
+    await srv.start()
+    try:
+        faults.arm("bulk_conn_drop", count=1)
+        reply = await bulk_push(srv.address, "migrate_in", mint_ticket("p"),
+                                blob, chunk_bytes=CHUNK)
+        assert reply == {"ok": True}
+        assert landed == [blob]
+        assert bulk_metrics.snapshot()["resumes_total"] >= 1
+    finally:
+        await srv.close()
+
+
+@pytest.mark.asyncio
+async def test_slow_peer_timeout_is_retryable():
+    """bulk_slow_peer stalls every chunk; the per-attempt timeout turns the
+    straggler into a retryable error — the producers' cue to fall back to
+    the hub path instead of hanging the pull."""
+    blob = blob_of(6 * CHUNK)
+    srv = await start_source_server({"b": blob})
+    try:
+        faults.arm("bulk_slow_peer", delay_s=0.2)
+        with pytest.raises(BulkTransferError) as ei:
+            await bulk_fetch(srv.address, "kv_export", mint_ticket("p"),
+                             meta={"key": "b"}, timeout_s=0.25, max_resumes=1)
+        assert ei.value.retryable
+    finally:
+        await srv.close()
+
+
+# ----------------------------------------------------------------- tickets
+
+
+@pytest.mark.asyncio
+async def test_ticket_expiry_rejected():
+    clock = FakeClock()
+    blob = blob_of(CHUNK)
+    srv = await start_source_server({"b": blob}, clock=clock)
+    try:
+        ticket = mint_ticket("p", ttl_s=5.0, clock=clock)
+        clock.advance(6.0)
+        with pytest.raises(BulkTransferError) as ei:
+            await bulk_fetch(srv.address, "kv_export", ticket,
+                             meta={"key": "b"})
+        assert ei.value.kind == "ticket"
+        assert not ei.value.retryable
+    finally:
+        await srv.close()
+
+
+@pytest.mark.asyncio
+async def test_ticket_reuse_rejected():
+    blob = blob_of(2 * CHUNK)
+    srv = await start_source_server({"b": blob})
+    try:
+        ticket = mint_ticket("p")
+        assert await bulk_fetch(srv.address, "kv_export", ticket,
+                                meta={"key": "b"}) == blob
+        with pytest.raises(BulkTransferError) as ei:
+            await bulk_fetch(srv.address, "kv_export", ticket,
+                             meta={"key": "b"})
+        assert ei.value.kind == "ticket"
+    finally:
+        await srv.close()
+
+
+@pytest.mark.asyncio
+async def test_ticket_salt_scope_rejected():
+    """A ticket minted for one tenant's salt cannot fetch under another."""
+    blob = blob_of(CHUNK)
+    srv = await start_source_server({"b": blob})
+    try:
+        ticket = mint_ticket("p", salt="tenant-a")
+        with pytest.raises(BulkTransferError) as ei:
+            await bulk_fetch(srv.address, "kv_export", ticket,
+                             meta={"key": "b"}, salt="tenant-b")
+        assert ei.value.kind == "ticket"
+        assert await bulk_fetch(srv.address, "kv_export",
+                                mint_ticket("p", salt="tenant-a"),
+                                meta={"key": "b"}, salt="tenant-a") == blob
+    finally:
+        await srv.close()
+
+
+@pytest.mark.asyncio
+async def test_ticket_wrong_peer_rejected():
+    blob = blob_of(CHUNK)
+    srv = await start_source_server({"b": blob}, worker_id=42)
+    try:
+        with pytest.raises(BulkTransferError) as ei:
+            await bulk_fetch(srv.address, "kv_export", mint_ticket(41),
+                             meta={"key": "b"})
+        assert ei.value.kind == "ticket"
+    finally:
+        await srv.close()
+
+
+@pytest.mark.asyncio
+async def test_byte_budget_refused():
+    blob = blob_of(4 * CHUNK)
+    srv = await start_source_server({"b": blob})
+    try:
+        with pytest.raises(BulkTransferError) as ei:
+            await bulk_fetch(srv.address, "kv_export",
+                             mint_ticket("p", budget=CHUNK),
+                             meta={"key": "b"})
+        assert ei.value.kind == "budget"
+        assert not ei.value.retryable
+    finally:
+        await srv.close()
+
+
+@pytest.mark.asyncio
+async def test_hub_is_fleet_wide_oneshot_arbiter():
+    """Ticket spend is arbitrated by the hub record (first delete wins): a
+    replayed ticket is refused even by a server that never saw it spent."""
+    hub = InprocHub()
+    blob = blob_of(2 * CHUNK)
+    srv1 = await start_source_server({"b": blob}, worker_id=7, hub=hub)
+    srv2 = await start_source_server({"b": blob}, worker_id=7, hub=hub)
+    try:
+        await hub.kv_put(bulk_addr_key(7), {"address": srv1.address})
+        rdv = BulkRendezvous(hub)
+        prep = await rdv.prepare(7)
+        assert prep is not None
+        address, ticket = prep
+        assert await bulk_fetch(address, "kv_export", ticket,
+                                meta={"key": "b"}) == blob
+        # srv2 has a fresh local used-set; only the hub knows this ticket
+        # was spent.
+        with pytest.raises(BulkTransferError) as ei:
+            await bulk_fetch(srv2.address, "kv_export", ticket,
+                             meta={"key": "b"})
+        assert ei.value.kind == "ticket"
+    finally:
+        await srv1.close()
+        await srv2.close()
+
+
+# -------------------------------------------------------------- rendezvous
+
+
+@pytest.mark.asyncio
+async def test_rendezvous_none_for_unregistered_peer():
+    hub = InprocHub()
+    rdv = BulkRendezvous(hub)
+    assert await rdv.prepare(999) is None
+    assert await rdv.prepare_sink("traces") is None
+
+
+@pytest.mark.asyncio
+async def test_bulk_exporter_ab_identity_and_fallback():
+    """The prefix-pull exporter over the bulk plane returns exactly what
+    the hub-path exporter returns, and any bulk miss delegates to it."""
+    from dynamo_tpu.llm.kv_router.pull import make_bulk_exporter
+
+    payload = {"n_blocks": 2, "k": b"\x01" * 64, "v": b"\x02" * 64,
+               "sequence_hashes": [11, 22]}
+    hub = InprocHub()
+    srv = BulkServer(chunk_bytes=CHUNK, worker_id=7, hub=hub)
+
+    async def source(meta):
+        assert meta["token_ids"] == [1, 2, 3]
+        return codec.encode(payload)
+
+    srv.register_source("kv_export", source)
+    await srv.start()
+    fallback_calls = []
+
+    async def hub_path(worker_id, data):
+        fallback_calls.append(worker_id)
+        return payload
+
+    try:
+        await hub.kv_put(bulk_addr_key(7), {"address": srv.address})
+        exporter = make_bulk_exporter(BulkRendezvous(hub), hub_path)
+        got = await exporter(7, {"token_ids": [1, 2, 3]})
+        assert got == payload  # byte-identical to the hub-path oracle
+        assert fallback_calls == []
+        assert bulk_metrics.snapshot()["fallbacks_total"] == 0
+
+        # Peer 8 runs no bulk server: the exporter falls back, the stream
+        # still completes, and the miss is counted.
+        got = await exporter(8, {"token_ids": [1, 2, 3]})
+        assert got == payload
+        assert fallback_calls == [8]
+        assert bulk_metrics.snapshot()["fallbacks_total"] == 1
+    finally:
+        await srv.close()
+
+
+@pytest.mark.asyncio
+async def test_bulk_span_sink_ab_identity_and_fallback():
+    """The span-batch exporter sink delivers the same payload the hub
+    publish would, and falls back to it when no bulk sink is registered."""
+    from dynamo_tpu.llm.trace_service import BULK_TRACES_SINK, make_bulk_span_sink
+
+    hub = InprocHub()
+    ingested = []
+    srv = BulkServer(chunk_bytes=CHUNK, worker_id=3, hub=hub)
+
+    async def traces_sink(data, meta):
+        ingested.append(codec.decode(data))
+        return {"ok": True}
+
+    srv.register_sink(BULK_TRACES_SINK, traces_sink)
+    await srv.start()
+    published = []
+
+    async def hub_path(payload):
+        published.append(payload)
+
+    batch = {"spans": [{"name": "decode.chunk", "dur_us": 12}]}
+    try:
+        await hub.kv_put(bulk_sink_key(BULK_TRACES_SINK, 3),
+                         {"address": srv.address, "worker_id": "3"})
+        sink = make_bulk_span_sink(BulkRendezvous(hub), hub_path)
+        await sink(batch)
+        assert ingested == [batch]
+        assert published == []
+
+        # De-register the sink: the exporter must not drop the batch.
+        await hub.kv_delete(bulk_sink_key(BULK_TRACES_SINK, 3))
+        await sink(batch)
+        assert published == [batch]
+        assert bulk_metrics.snapshot()["fallbacks_total"] == 1
+    finally:
+        await srv.close()
+
+
+# ----------------------------------------------------------------- metrics
+
+
+@pytest.mark.asyncio
+async def test_metrics_series_and_hub_publish_bytes():
+    """/metrics carries the four bulk counters, and the hub shard publish
+    byte counter (the bulk plane's proof metric) counts control-plane
+    publish volume."""
+    from dynamo_tpu.runtime.transports.shard import shard_metrics
+
+    rendered = bulk_metrics.render()
+    for series in ("bulk_bytes_total", "bulk_transfers_total",
+                   "bulk_fallbacks_total", "bulk_resumes_total"):
+        assert f"dynamo_tpu_{series}" in rendered
+
+    hub = InprocHub()
+    before = shard_metrics.publish_bytes.get("inproc", 0)
+    await hub.publish("spans.w1", {"spans": ["x" * 256]})
+    after = shard_metrics.publish_bytes.get("inproc", 0)
+    assert after - before > 256
+    assert "hub_shard_publish_bytes_total" in shard_metrics.render()

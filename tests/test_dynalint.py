@@ -596,6 +596,7 @@ FAMILY_RULES = {
     "1": {"DYN101", "DYN102"},
     "2": {"DYN201", "DYN202", "DYN203", "DYN204"},
     "3": {"DYN301", "DYN302", "DYN303", "DYN304", "DYN305", "DYN306"},
+    "4": {"DYN401", "DYN402"},
     "5": {"DYN501", "DYN502", "DYN503", "DYN504"},
     "6": {"DYN601", "DYN602", "DYN603", "DYN604"},
 }
@@ -628,7 +629,9 @@ def test_fixture_corpus():
             f"  {f.rule} {f.line}: {f.message}" for f in found
         )
     # every new family ships offending+clean+suppressed AND >=1 historical
-    for fam in ("1", "2", "3", "5", "6"):
+    # (family 4 has no hist_ fixture yet: DYN401 predates the corpus and
+    # DYN402 shipped with the bulk plane, not from a review finding)
+    for fam in ("1", "2", "3", "4", "5", "6"):
         assert any(n.startswith(f"dyn{fam}") and "offending" in n for n in names)
         assert any(n.startswith(f"dyn{fam}") and "clean" in n for n in names)
         assert any(n.startswith(f"dyn{fam}") and "suppressed" in n for n in names)

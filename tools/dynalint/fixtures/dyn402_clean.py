@@ -1,0 +1,11 @@
+# dynalint-fixture: expect=none
+"""Clean: the block payload rides the bulk data plane (transports/bulk.py);
+the hub carries only the rendezvous descriptor and a completion marker."""
+
+
+class Donor:
+    async def export(self, req):
+        blob = await self.engine.export_prompt_blocks(req.token_ids)
+        prep = await self.rendezvous.prepare(req.worker_id, budget=len(blob))
+        await bulk_push(prep[0], "kv_export", prep[1], blob)
+        await self.hub.publish(self.subj, {"done": req.request_id})

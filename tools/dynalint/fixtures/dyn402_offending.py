@@ -1,0 +1,20 @@
+# dynalint-fixture: expect=DYN402
+"""Bulk payload shipped over the control plane: the full KV block export
+is published through a hub subject, so every byte rides the shard's
+publish path and head-of-line-blocks lease renewals and watches on it."""
+
+
+class Donor:
+    async def export(self, req):
+        payload = await self.engine.export_prompt_blocks(
+            req.token_ids, salt=req.salt
+        )
+        await self.hub.publish(self.subj, payload)
+
+    async def export_inline(self, req):
+        await self.hub.publish(
+            self.subj, await self.engine.export_prompt_blocks(req.token_ids)
+        )
+
+    async def stash_block(self, key, block):
+        await self.hub.kv_put(key, {"k": block.k_bytes, "v": block.v_bytes})

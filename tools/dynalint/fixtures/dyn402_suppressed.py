@@ -1,0 +1,9 @@
+# dynalint-fixture: expect=none
+"""Suppressed: a one-block debug dump to a diagnostics subject — reviewed
+as sub-threshold (single block, test-only path, never on the hot path)."""
+
+
+class Donor:
+    async def debug_dump(self, req):
+        payload = await self.engine.export_prompt_blocks(req.token_ids, max_blocks=1)
+        await self.hub.publish(self.subj, payload)  # dynalint: disable=DYN402

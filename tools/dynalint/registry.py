@@ -167,7 +167,42 @@ HUB_KEY_BUILDER_TAILS = {
     # disaggregated serving (llm/disagg/)
     "disagg_config_key",
     "prefill_queue_name",
+    # bulk data plane rendezvous (runtime/transports/bulk.py)
+    "bulk_addr_key",
+    "bulk_ticket_key",
+    "bulk_sink_key",
+    "bulk_sink_prefix",
 }
+
+# ---------------------------------------------------------------------------
+# DYN402 bulk-payload model
+# ---------------------------------------------------------------------------
+
+# Hub sinks whose payload argument lands on the control plane (DYN402): a
+# bulk payload (KV block export, migration copy stream) published through
+# one of these rides every hub shard hop, head-of-line-blocks lease renewals
+# and watches, and counts against the shard's publish_bytes budget.  Bulk
+# bytes belong on the direct worker<->worker plane (runtime/transports/
+# bulk.py, docs/bulk_plane.md); the hub carries rendezvous + control only.
+BULK_SINK_TAILS = {
+    "publish",
+    "q_push",
+    "kv_put",
+}
+
+# Calls whose RESULT is a bulk payload by construction: publishing one
+# through a hub sink is a finding regardless of size (export_prompt_blocks
+# returns the full per-block KV byte planes).  Extend when a new producer
+# of multi-KiB block payloads appears.
+BULK_PAYLOAD_PRODUCER_TAILS = {
+    "export_prompt_blocks",
+}
+
+# Documented threshold (docs/bulk_plane.md): payloads at or above this are
+# bulk by definition.  The AST checker cannot size runtime values — it
+# flags the *shapes* above — but the threshold anchors the rule text and
+# the bulk plane's own routing decision.
+BULK_THRESHOLD_BYTES = 64 * 1024
 
 # Calls that are *safe enough* in a label position for DYN204 even though
 # they are not sanitizers (they render numbers).

@@ -20,7 +20,12 @@ from .core import (
     dotted_name,
     iter_names,
 )
-from .registry import HUB_KEY_BUILDER_TAILS, HUB_KEY_SINK_TAILS
+from .registry import (
+    BULK_PAYLOAD_PRODUCER_TAILS,
+    BULK_SINK_TAILS,
+    HUB_KEY_BUILDER_TAILS,
+    HUB_KEY_SINK_TAILS,
+)
 
 # DYN001-007 run in the per-file FileChecker below; DYN1xx/2xx/3xx are the
 # 2.0 corpus passes (rules_race / rules_taint / rules_schema) and
@@ -48,6 +53,7 @@ ALL_RULES = (
     "DYN305",
     "DYN306",
     "DYN401",
+    "DYN402",
     "DYN501",
     "DYN502",
     "DYN503",
@@ -79,6 +85,7 @@ RULE_TITLES = {
     "DYN305": "setdefault on a nullable wire key (null skips the rewrite)",
     "DYN306": "pytree treedef stability: frozen prefix / trailing defaults",
     "DYN401": "ad-hoc hub key construction bypasses shard routing",
+    "DYN402": "bulk payload published through a hub subject",
     "DYN501": "acquired resource handle does not reach release/transfer on all paths",
     "DYN502": "registered device dispatch runs outside _device_lock",
     "DYN503": "blocking host I/O under _device_lock (lock-split class)",
@@ -329,6 +336,8 @@ class FileChecker:
             self._check_call_dyn007(call, dotted, tail)
         if tail in HUB_KEY_SINK_TAILS:
             self._check_call_dyn401(call, tail)
+        if tail in BULK_SINK_TAILS:
+            self._check_call_dyn402(call, tail)
 
     def _check_call_dyn401(self, call: ast.Call, tail: str) -> None:
         """Hub key/subject arguments must route through a sanctioned builder
@@ -361,6 +370,84 @@ class FileChecker:
                 "hub_subject (or a helper registered in "
                 "HUB_KEY_BUILDER_TAILS)",
             )
+
+    _DYN402_PAYLOAD_KWARGS = ("payload", "value", "item")
+
+    def _check_call_dyn402(self, call: ast.Call, tail: str) -> None:
+        """Bulk payloads must not ride hub subjects (registry.BULK_SINK_TAILS):
+        a KV block export or migration copy stream published through the hub
+        head-of-line-blocks lease renewals and watches on that shard.  The
+        checker flags the shapes it can prove — the result of a registered
+        bulk producer (BULK_PAYLOAD_PRODUCER_TAILS) handed to a hub sink,
+        directly or through one local assignment, and KV-block dict literals
+        (both ``"k"`` and ``"v"`` keys) — and points at the bulk plane
+        (transports/bulk.py; >= BULK_THRESHOLD_BYTES is bulk by contract)."""
+        arg: Optional[ast.AST] = call.args[1] if len(call.args) > 1 else None
+        if arg is None:
+            for kw in call.keywords:
+                if kw.arg in self._DYN402_PAYLOAD_KWARGS:
+                    arg = kw.value
+                    break
+        if arg is None:
+            return
+        offender = self._dyn402_offender(arg)
+        if offender is None and isinstance(arg, ast.Name):
+            resolved = self._resolve_local(arg.id)
+            if resolved is not None:
+                offender = self._dyn402_offender(resolved)
+        if offender:
+            self._emit(
+                "DYN402",
+                call,
+                f"bulk payload ({offender}) published through hub "
+                f"`{tail}()` — the control plane carries rendezvous and "
+                "control only; move >=64KiB block/stream payloads to the "
+                "bulk data plane (transports/bulk.py, docs/bulk_plane.md)",
+            )
+
+    @staticmethod
+    def _dyn402_offender(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Await):
+            node = node.value
+        if isinstance(node, ast.Call):
+            _, tail = call_target(node)
+            if tail in BULK_PAYLOAD_PRODUCER_TAILS:
+                return f"result of `{tail}()`"
+        if isinstance(node, ast.Dict):
+            keys = {
+                k.value
+                for k in node.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            }
+            if {"k", "v"} <= keys:
+                return 'KV block dict (`"k"`/`"v"` byte planes)'
+        return None
+
+    def _resolve_local(self, name: str) -> Optional[ast.AST]:
+        """One level of local dataflow: the value last assigned to ``name``
+        in the enclosing function (module scope is not resolved — a module
+        constant is config, not a per-request payload)."""
+        func = None
+        for kind, _, node in reversed(self._stack):
+            if kind in ("async", "sync"):
+                func = node
+                break
+        if func is None:
+            return None
+        value: Optional[ast.AST] = None
+        for stmt in _walk_same_func(func):
+            if isinstance(stmt, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name for t in stmt.targets
+            ):
+                value = stmt.value
+            elif (
+                isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+                and stmt.target.id == name
+                and stmt.value is not None
+            ):
+                value = stmt.value
+        return value
 
     def _check_call_dyn007(
         self, call: ast.Call, dotted: Optional[str], tail: Optional[str]

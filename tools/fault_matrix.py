@@ -615,6 +615,73 @@ async def sweep_http() -> list:
     return rows
 
 
+async def sweep_bulk() -> list:
+    """``bulk_conn_drop`` / ``bulk_slow_peer`` against a real BulkServer +
+    ``bulk_fetch`` client pair (transports/bulk.py; docs/bulk_plane.md).
+    The system under test is resume-from-last-verified-chunk plus the
+    fallback ladder the goodput L9 chaos rung drives fleet-wide."""
+    from dynamo_tpu.llm.metrics import bulk_metrics
+    from dynamo_tpu.runtime.transports.bulk import (
+        BulkServer,
+        BulkTransferError,
+        bulk_fetch,
+        mint_ticket,
+    )
+
+    rows = []
+    blob = bytes(range(256)) * 24  # 6 KiB -> 6 chunks at chunk_bytes=1024
+    server = BulkServer(chunk_bytes=1024)
+
+    async def source(meta):
+        return blob
+
+    server.register_source("kv_export", source)
+    await server.start()
+    try:
+        bulk_metrics.reset()
+        faults.arm("bulk_conn_drop", count=2)
+        got = await bulk_fetch(server.address, "kv_export", mint_ticket("w1"))
+        faults.reset()
+        resumes = int(bulk_metrics.snapshot()["resumes_total"])
+        rows.append({
+            "fault": "bulk_conn_drop",
+            "injected_at": "BulkServer fetch loop (connection aborted after "
+                           "a chunk shipped; armed fault point, count=2)",
+            "observed": (
+                f"client resumed from the last verified chunk ({resumes} "
+                "resumes), stream byte-identical"
+                if got == blob and resumes >= 1
+                else "UNEXPECTED: resume did not reproduce the stream"
+            ),
+            "status": "resumed -> byte-identical",
+        })
+
+        faults.arm("bulk_slow_peer", delay_s=0.2)
+        fell_back = False
+        try:
+            await bulk_fetch(server.address, "kv_export", mint_ticket("w1"),
+                             timeout_s=0.3, max_resumes=1)
+        except BulkTransferError as exc:
+            fell_back = exc.retryable  # the producers' cue for the hub path
+        faults.reset()
+        rows.append({
+            "fault": "bulk_slow_peer",
+            "injected_at": "BulkServer chunk loop (0.2s stall before each "
+                           "chunk; armed fault point)",
+            "observed": (
+                "per-attempt timeout converted the straggler into a "
+                "retryable error; the caller falls back to the hub path "
+                "(then local recompute), stream stays byte-identical"
+                if fell_back
+                else "UNEXPECTED: straggler not converted to fallback"
+            ),
+            "status": "fallback -> hub path",
+        })
+    finally:
+        await server.close()
+    return rows
+
+
 def to_markdown(rows: list) -> str:
     lines = [
         "| fault point | injected at | observed behaviour | client status |",
@@ -637,7 +704,7 @@ async def main() -> int:
     args = ap.parse_args()
 
     rows = (await sweep_runtime() + await sweep_chaos() + await sweep_shards()
-            + await sweep_http() + await sweep_integrity())
+            + await sweep_http() + await sweep_integrity() + await sweep_bulk())
     if args.engine:
         rows += await sweep_engine()
     md = to_markdown(rows)
