@@ -790,16 +790,47 @@ def main() -> None:
             f"step, kernel={dispatch.get('decode_kernel')})",
             file=sys.stderr,
         )
+        # Prefill side of the byte model (ISSUE 19): a chunk streams the
+        # weights once and reads the PRIOR prefix KV from paged cache —
+        # mean prefix over a full prompt's chunk sequence is isl/2.  The
+        # share says when the paged-prefix read (what the Pallas prefill
+        # kernel fuses dequant into) starts to dominate the chunk, which
+        # happens at 128k-class context, not at bench-sized prompts.
+        pf_kv_bytes = (
+            wl["isl"] / 2.0 * 2 * c.kv_size * c.num_layers * kv_itemsize
+        )
+        pf_share = pf_kv_bytes / (pf_kv_bytes + w_bytes)
+        # Prefill MFU + per-chunk latency from the engine's chunk trace
+        # (engine.prefill_summary via dispatch_summary) — attributable to
+        # the prefill kernel the same way decode MFU is to the decode one.
+        pf = dispatch.get("prefill", {})
+        pf_wall = pf.get("wall_s", 0.0)
+        pf_tokens = pf.get("prompt_tokens", 0)
+        pf_mfu = (
+            2 * n_params * pf_tokens / (pf_wall * 197e12) if pf_wall else 0.0
+        )
+        print(
+            f"bench: prefill MFU {pf_mfu*100:.2f}% ({pf_tokens} prompt "
+            f"tokens over {pf.get('chunks', 0)} chunks in {pf_wall:.2f}s, "
+            f"chunk p50 {pf.get('p50_ms', 0.0)}ms p99 {pf.get('p99_ms', 0.0)}"
+            f"ms, kernel={dispatch.get('prefill_kernel')})",
+            file=sys.stderr,
+        )
         # Machine-readable trajectory (ISSUE 11): until now only tok/s was
         # parseable and the ROADMAP quoted MFU/host-gap by hand from stderr.
         extras.update(
             {
                 "decode_mfu": round(mfu, 4),
                 "decode_kernel": dispatch.get("decode_kernel"),
+                "prefill_mfu": round(pf_mfu, 4),
+                "prefill_kernel": dispatch.get("prefill_kernel"),
+                "prefill": pf,
                 "attention": {
                     "share_est": round(attn_share, 4),
                     "kv_bytes_per_step": int(kv_bytes),
                     "weight_bytes_per_step": int(w_bytes),
+                    "prefill_share_est": round(pf_share, 4),
+                    "prefill_kv_bytes_per_chunk": int(pf_kv_bytes),
                 },
                 "host_gap_frac": round(max(0.0, dt - device_s) / dt, 4),
                 "dispatch": {

@@ -69,6 +69,36 @@ def _bulk_summary() -> Optional[dict]:
     return {k: int(v) for k, v in snap.items()}
 
 
+async def _prefill_metrics(url: str, session: ClientSession) -> Optional[dict]:
+    """Scrape the server's prefill-chunk latency summary off ``/metrics``
+    (dynamo_tpu_prefill_chunk_seconds — engine.prefill_summary rendered by
+    llm/metrics.py): chunk p50/p99 + cumulative chunk/token counters, so
+    the per-chunk breakdown lands in the run report next to TTFT/ITL.
+    None when the edge has no colocated engine (remote-engine deploys)."""
+    try:
+        async with session.get(f"{url}/metrics") as resp:
+            if resp.status != 200:
+                return None
+            text = await resp.text()
+    except Exception:
+        return None
+    out: dict = {}
+    for line in text.splitlines():
+        if line.startswith("dynamo_tpu_prefill_chunk_seconds"):
+            name, _, val = line.rpartition(" ")
+            if 'quantile="0.5"' in name:
+                out["chunk_p50_ms"] = round(float(val) * 1e3, 2)
+            elif 'quantile="0.99"' in name:
+                out["chunk_p99_ms"] = round(float(val) * 1e3, 2)
+            elif name.endswith("_sum"):
+                out["wall_s"] = round(float(val), 4)
+            elif name.endswith("_count"):
+                out["chunks"] = int(float(val))
+        elif line.startswith("dynamo_tpu_prefill_tokens_total "):
+            out["prompt_tokens"] = int(float(line.rpartition(" ")[2]))
+    return out or None
+
+
 def _pct(xs: List[float], p: float) -> float:
     if not xs:
         return 0.0
@@ -258,6 +288,7 @@ async def _sweep_level(url: str, model: str, conc: int, n_requests: int,
             trace_rep = await _trace_report(
                 url, [r for _, r in indexed], session
             )
+        prefill = await _prefill_metrics(url, session)
 
     results = [r for _, r in sorted(indexed)]  # start order
     ok = [r for r in results if r.error is None]
@@ -286,6 +317,8 @@ async def _sweep_level(url: str, model: str, conc: int, n_requests: int,
         "ttfts_ms": [round(r.ttft_s * 1e3, 1) for r in results if r.error is None],
         # --trace-report: per-hop TTFT decomposition (docs/tracing.md).
         **({"trace_report": trace_rep} if trace_rep is not None else {}),
+        # Server-side prefill-chunk breakdown (colocated engines only).
+        **({"prefill": prefill} if prefill is not None else {}),
     }
 
 

@@ -21,6 +21,10 @@ from typing import Any, Dict, List, Optional
 # graph; ops/ and cli import it lazily.
 DECODE_KERNELS = ("pallas_fused", "stock", "xla")
 
+# Canonical prefill-kernel names (ops/ragged_attention.resolve_prefill_kernel
+# and the CLI share this the same way).
+PREFILL_KERNELS = ("pallas", "stock", "xla")
+
 
 def _pow2_buckets(lo: int, hi: int) -> List[int]:
     out, v = [], lo
@@ -255,6 +259,16 @@ class EngineConfig:
     #                  behaviour)
     #   xla          — force the XLA fallback (bit-exactness oracle)
     decode_kernel: str = "auto"
+    # Prefill-path attention kernel (ops/ragged_attention.py
+    # resolve_prefill_kernel; env override DYN_PREFILL_KERNEL):
+    #   auto   — pallas on TPU, stock elsewhere
+    #   pallas — our chunked paged Pallas prefill kernel with in-kernel
+    #            dequant + KV splits (ops/prefill_attention.py;
+    #            interpret-mode on CPU)
+    #   stock  — the jax pallas ragged kernel on TPU, XLA fallback
+    #            elsewhere (pre-kernel behaviour)
+    #   xla    — force the XLA fallback (byte-identity oracle)
+    prefill_kernel: str = "auto"
     # Decode-stall watchdog threshold in seconds (engine/pipeline.py
     # _await_device): a token fetch / device dispatch exceeding it logs the
     # dispatch trace loudly and bumps dynamo_tpu_engine_stall_total.
@@ -358,6 +372,11 @@ class EngineConfig:
             raise ValueError(
                 f"unknown decode_kernel {self.decode_kernel!r} "
                 f"(auto|{'|'.join(DECODE_KERNELS)})"
+            )
+        if self.prefill_kernel not in ("auto",) + PREFILL_KERNELS:
+            raise ValueError(
+                f"unknown prefill_kernel {self.prefill_kernel!r} "
+                f"(auto|{'|'.join(PREFILL_KERNELS)})"
             )
         if self.weight_quant not in (None, "int8"):
             # One check covering every load path (checkpoint / random-init /

@@ -194,6 +194,19 @@ class TpuEngine(
         # Mixed-phase cadence: prefill chunks run since the last decode
         # burst (see _run_loop).
         self._chunks_since_burst = 0
+        # Preemption/migration requeues of mid-prefill sequences observed
+        # via the scheduler counter; a requeue resets the cadence so the
+        # NEXT mixed phase does not inherit a stale chunk count and burst
+        # immediately (_note_prefill_requeues).
+        self._prefill_requeues_seen = 0
+        # Prefill-chunk accounting (pipeline._run_unified): cumulative
+        # chunk count / wall / prompt tokens plus a bounded per-chunk wall
+        # trace for the latency quantiles on /metrics
+        # (dynamo_tpu_prefill_chunk_seconds) and in the bench JSON.
+        self.prefill_chunks = 0
+        self.prefill_wall_s = 0.0
+        self.prefill_tokens = 0
+        self._prefill_chunk_trace: deque = deque(maxlen=4096)
         # Deferred token fetches (FIFO).  Prompt-completing unified steps
         # AND mixed-phase decode bursts start their token D2H
         # asynchronously, park their rows (awaiting_fetch), and keep the
@@ -364,15 +377,23 @@ class TpuEngine(
         # auto) + the tuned block-hint table for this engine's geometry
         # (tools/tune_decode.py; built-in defaults when no entry matches).
         from ..ops.decode_attention import install_tuned_hints
-        from ..ops.ragged_attention import resolve_decode_kernel
+        from ..ops.ragged_attention import (
+            resolve_decode_kernel,
+            resolve_prefill_kernel,
+        )
 
         decode_kernel = resolve_decode_kernel(
             cfg.decode_kernel, attn_impl=attn_impl
         )
         self.decode_kernel = decode_kernel
+        prefill_kernel = resolve_prefill_kernel(
+            cfg.prefill_kernel, attn_impl=attn_impl
+        )
+        self.prefill_kernel = prefill_kernel
         install_tuned_hints(cfg.model, cfg.max_batch, cfg.block_size)
         logger.info(
-            "decode kernel: %s (attn_impl=%s)", decode_kernel, attn_impl
+            "decode kernel: %s, prefill kernel: %s (attn_impl=%s)",
+            decode_kernel, prefill_kernel, attn_impl,
         )
         S = cfg.max_batch
         mesh = self.mesh
@@ -391,6 +412,7 @@ class TpuEngine(
             logits, cache = forward_ragged(
                 params, model_config, rb, cache, attn_impl=attn_impl,
                 mesh=mesh, kv_scale=kv_scale, lora_rank=lora_rank,
+                prefill_kernel=prefill_kernel,
             )
             out = sample_tokens(
                 logits,
@@ -1438,6 +1460,7 @@ class TpuEngine(
                 self._fail_all()
                 return
             plan = self.scheduler.schedule()
+            self._note_prefill_requeues()
             for seq in self.scheduler.take_rejected():
                 self._finish(seq, FinishReason.ERROR)
             if plan is None:
@@ -1620,6 +1643,45 @@ class TpuEngine(
 
 
 
+    def _note_prefill_requeues(self) -> None:
+        """Reset the mixed-phase chunk cadence when a mid-prefill sequence
+        was requeued since the last scheduling pass (preemption folds the
+        partial prompt back into waiting; migration retires it).  The
+        requeued sequence restarts its chunk sequence from zero, so a
+        chunk count carried over from BEFORE the requeue would trigger the
+        first decode burst of the next mixed phase too early and skew its
+        cadence (ISSUE 19 satellite)."""
+        reqs = getattr(self.scheduler, "prefill_requeues", 0)
+        if reqs != self._prefill_requeues_seen:
+            self._prefill_requeues_seen = reqs
+            self._chunks_since_burst = 0
+
+    def _note_prefill_chunk(self, wall_s: float, tokens: int) -> None:
+        """Account one prefill chunk (called by pipeline._run_unified for
+        every unified step that advanced prompt tokens): cumulative
+        counters feed the bench MFU math, the bounded trace feeds the
+        dynamo_tpu_prefill_chunk_seconds quantiles."""
+        self.prefill_chunks += 1
+        self.prefill_wall_s += wall_s
+        self.prefill_tokens += tokens
+        self._prefill_chunk_trace.append(wall_s)
+
+    def prefill_summary(self) -> Dict[str, Any]:
+        """Prefill-chunk latency breakdown: cumulative counters (unbounded,
+        safe for rate math) plus p50/p99 over the bounded per-chunk trace
+        window (gauges, like step_summary)."""
+        times = sorted(self._prefill_chunk_trace)
+        m = len(times)
+        return {
+            "chunks": self.prefill_chunks,
+            "wall_s": round(self.prefill_wall_s, 4),
+            "prompt_tokens": self.prefill_tokens,
+            "p50_ms": round(times[m // 2] * 1e3, 2) if m else 0.0,
+            "p99_ms": (
+                round(times[min(m - 1, int(m * 0.99))] * 1e3, 2) if m else 0.0
+            ),
+        }
+
     def step_summary(self) -> Dict[str, Any]:
         """Aggregate the dispatch trace: counts, wall time, and latency
         percentiles per step kind (the VERDICT r1 profiling ask)."""
@@ -1651,6 +1713,10 @@ class TpuEngine(
         self.decode_busy_s = 0.0
         self.decode_stalls = 0
         self.last_stall = None
+        self.prefill_chunks = 0
+        self.prefill_wall_s = 0.0
+        self.prefill_tokens = 0
+        self._prefill_chunk_trace.clear()
 
     def dispatch_summary(self) -> Dict[str, Any]:
         """Machine-readable decode-pipeline health: the per-kind dispatch
@@ -1673,6 +1739,8 @@ class TpuEngine(
         return {
             "kinds": self.step_summary(),
             "decode_kernel": self.decode_kernel,
+            "prefill_kernel": self.prefill_kernel,
+            "prefill": self.prefill_summary(),
             "pipeline": {
                 "sessions": self.pipeline_sessions,
                 "rebuilds": self.pipeline_rebuilds,

@@ -282,14 +282,27 @@ class DecodePipelineMixin:
             out = await self._await_device(
                 self._device_task(run), "unified_dispatch", len(plan.items)
             )
+        wall = time.perf_counter() - t0
         self.step_trace.append(
             (
                 "unified_fetch" if need_tokens else "unified",
-                time.perf_counter() - t0,
+                wall,
                 len(plan.items),
                 len(rb.token_ids),
             )
         )
+        # Prefill-chunk accounting: any step that advanced prompt tokens
+        # counts as one chunk (mixed plans attribute the whole dispatch
+        # wall — the prefill rows dominate it by construction of the
+        # chunked scheduler).  Feeds the per-chunk latency quantiles on
+        # /metrics and the prefill-MFU breakdown in bench.py.
+        prefill_tokens = sum(
+            min(n, len(seq.prompt) - start)
+            for seq, start, n in plan.items
+            if start < len(seq.prompt)
+        )
+        if prefill_tokens > 0:
+            self._note_prefill_chunk(wall, prefill_tokens)
 
         pending_rows: List[Tuple[SequenceState, int]] = []
         for i, (seq, start, n) in enumerate(plan.items):

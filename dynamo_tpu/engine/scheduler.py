@@ -507,6 +507,13 @@ class Scheduler:
         self.running: List[SequenceState] = []
         self.rejected: List[SequenceState] = []  # can never fit; engine fails them
         self.preempted = 0  # cumulative, for metrics
+        # Cumulative mid-prefill requeues (preemption of a sequence whose
+        # prompt was only partially computed).  The engine compares this
+        # against its last-seen value each scheduling pass and resets the
+        # mixed-phase chunk cadence (_chunks_since_burst): the requeued
+        # sequence restarts chunking from zero, so a stale count would
+        # skew the first decode burst after re-admission.
+        self.prefill_requeues = 0
         # Queue->admission latencies (s), bounded; loadgen reads per level.
         self.admission_waits: Deque[float] = deque(maxlen=16384)
 
@@ -833,6 +840,11 @@ class Scheduler:
         self.running.remove(seq)
         self.kv.free_sequence(seq.block_ids)
         seq.block_ids = []
+        # Mid-prefill must be detected BEFORE the fold below: folding sets
+        # num_computed = 0, after which EVERY preempted sequence looks
+        # mid-prefill.
+        if seq.in_prefill:
+            self.prefill_requeues += 1
         # Fold generated tokens into the prompt so recompute resumes exactly.
         seq.prompt = seq.prompt + seq.output
         seq.output = []

@@ -621,6 +621,38 @@ class EngineDispatchMetrics:
             lines.append(
                 f'{ns}_decode_kernel_info{{kernel="{escape_label(kern)}"}} 1'
             )
+        pkern = s.get("prefill_kernel", "")
+        if pkern:
+            lines.append(f"# HELP {ns}_prefill_kernel_info Active prefill "
+                         "attention kernel (DYN_PREFILL_KERNEL)")
+            lines.append(f"# TYPE {ns}_prefill_kernel_info gauge")
+            lines.append(
+                f'{ns}_prefill_kernel_info{{kernel="{escape_label(pkern)}"}} 1'
+            )
+        # Prefill-chunk latency summary (engine.prefill_summary): cumulative
+        # _sum/_count are true counters; the quantiles come from the
+        # bounded per-chunk trace window (gauges in counter clothing, same
+        # caveat as the per-kind stats above).  OUTSIDE the _dispatch ns —
+        # the CI gate and loadgen scrape key on this exact name.
+        pf = s.get("prefill", {})
+        if pf:
+            pn = f"{prefix}_prefill_chunk_seconds"
+            lines.append(f"# HELP {pn} Prefill chunk dispatch wall time")
+            lines.append(f"# TYPE {pn} summary")
+            for q, key in (("0.5", "p50_ms"), ("0.99", "p99_ms")):
+                lines.append(
+                    f'{pn}{{quantile="{escape_label(q)}"}} '
+                    f"{pf.get(key, 0.0) / 1e3}"
+                )
+            lines.append(f"{pn}_sum {pf.get('wall_s', 0.0)}")
+            lines.append(f"{pn}_count {pf.get('chunks', 0)}")
+            lines.append(
+                f"# HELP {prefix}_prefill_tokens_total Prompt tokens "
+                "computed by prefill chunks")
+            lines.append(f"# TYPE {prefix}_prefill_tokens_total counter")
+            lines.append(
+                f"{prefix}_prefill_tokens_total {pf.get('prompt_tokens', 0)}"
+            )
         return "\n".join(lines) + "\n"
 
 

@@ -229,6 +229,11 @@ def forward_ragged(
     # kv_scale IN-KERNEL — the algebraic q/out fold below is skipped for
     # it, so the quantized KV stream is dequantized exactly once, in VMEM.
     decode_kernel: str = "stock",
+    # Non-decode (prefill / mixed-chunk) attention kernel
+    # (resolve_prefill_kernel): "pallas" routes the chunked paged prefill
+    # kernel (ops/prefill_attention.py), which likewise takes kv_scale
+    # IN-KERNEL — the algebraic fold is skipped for it too.
+    prefill_kernel: str = "stock",
     # Static per-slot rank of the LoRA device bank (llm/tenancy/lora.py);
     # 0 = no LoRA.  Active only when BOTH the params tree carries bank
     # leaves and the batch carries adapter_slots.
@@ -260,10 +265,14 @@ def forward_ragged(
         else jnp.asarray(kv_scale, jnp.float32).reshape(-1)  # [1] or [L]
     )
 
-    # The fused decode kernel dequantizes in-kernel (the scale is an SMEM
-    # scalar operand, traced per-layer values included) — the algebraic
-    # fold would double-apply it.
-    fused_dequant = decode and decode_kernel == "pallas_fused"
+    # The fused decode AND prefill kernels dequantize in-kernel (the scale
+    # is an SMEM scalar operand, traced per-layer values included) — the
+    # algebraic fold would double-apply it.
+    fused_dequant = (
+        decode_kernel == "pallas_fused"
+        if decode
+        else prefill_kernel == "pallas"
+    )
 
     def attn_and_write(q, k, v, s_l, pages, slots, kv_lens, tables, cu, num):
         # s_l: this layer's scale ([] f32) or None.  q·(K·s) == (q·s)·K and
@@ -283,6 +292,7 @@ def forward_ragged(
             impl=attn_impl,
             decode=decode,
             decode_kernel=decode_kernel,
+            prefill_kernel=prefill_kernel,
             kv_scale=s_l if fused_dequant else None,
         )
         if s_l is not None and not fused_dequant:

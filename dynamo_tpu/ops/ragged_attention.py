@@ -89,6 +89,49 @@ def resolve_decode_kernel(value: str = "auto", attn_impl: str = "auto") -> str:
     return v
 
 
+def resolve_prefill_kernel(value: str = "auto", attn_impl: str = "auto") -> str:
+    """Resolve the prefill attention kernel selector.
+
+    Order: explicit config value > ``DYN_PREFILL_KERNEL`` env > auto.
+    - ``pallas``: our chunked paged prefill kernel with in-kernel dequant
+      and KV splits (ops/prefill_attention.py) — compiled on TPU,
+      interpret-mode on CPU (the tier-1 parity gates run exactly the
+      device kernel logic).
+    - ``stock``: the pre-existing path — the jax pallas
+      ragged_paged_attention kernel on TPU, XLA gather fallback elsewhere.
+    - ``xla``: force the XLA fallback everywhere (the byte-identity
+      oracle, even on TPU).
+    ``auto`` picks pallas on TPU and stock elsewhere, so default CPU
+    behaviour (and every pre-existing test stream) is unchanged.
+
+    ``attn_impl`` mirrors resolve_decode_kernel: an operator forcing
+    ``attn_impl="xla"`` must not have ``auto`` route prefill through the
+    compiled kernel — auto resolves to ``stock`` there, which honours
+    impl=xla end-to-end.  An EXPLICIT pallas (config or env) still wins.
+    """
+    import os
+
+    from ..engine.config import PREFILL_KERNELS
+
+    v = ((value or "auto").strip() or "auto").lower()
+    if v == "auto":
+        v = (
+            os.environ.get("DYN_PREFILL_KERNEL", "auto").strip() or "auto"
+        ).lower()
+    if v == "auto":
+        v = "stock" if attn_impl == "xla" else (
+            "pallas" if on_tpu() else "stock"
+        )
+    if v not in PREFILL_KERNELS:
+        # Report the RESOLVED value: with config "auto" the offender is
+        # usually a typo'd DYN_PREFILL_KERNEL env var, not the config.
+        raise ValueError(
+            f"unknown prefill kernel {v!r} (from config {value!r} / "
+            f"DYN_PREFILL_KERNEL; expected auto|{'|'.join(PREFILL_KERNELS)})"
+        )
+    return v
+
+
 def quantize_for_cache(x: jnp.ndarray, dtype) -> jnp.ndarray:
     """Make already-scaled values representable in a quantized page dtype.
 
@@ -315,6 +358,7 @@ def ragged_attention(
     kv_scale: float | None = None,  # quantized cache: value = stored * scale
     decode: bool = False,  # static hint: every row is a 1-token decode row
     decode_kernel: str = "stock",  # decode-path kernel (resolve_decode_kernel)
+    prefill_kernel: str = "stock",  # non-decode kernel (resolve_prefill_kernel)
 ) -> jnp.ndarray:
     """Causal attention of each token against its sequence's paged context.
 
@@ -331,6 +375,16 @@ def ragged_attention(
     multi-step decode program's shape (one query token per row) skips the
     cu_q_lens generality entirely and always gets the decode-tuned pallas
     block hints.
+
+    ``prefill_kernel`` selects the NON-decode implementation
+    (resolve_prefill_kernel / DYN_PREFILL_KERNEL):
+    - "pallas": our chunked paged prefill kernel
+      (ops/prefill_attention.py) — ``kv_scale`` (static OR traced) is
+      applied IN-KERNEL and the prior prefix streams straight from the
+      paged blocks.  Interpret-mode on CPU, compiled on TPU.
+    - "stock": the pre-existing routing below (jax pallas kernel on
+      ``impl == "tpu"``, XLA fallback otherwise).
+    - "xla": force the XLA fallback (the byte-identity oracle).
     """
     if decode:
         return ragged_decode_attention(
@@ -344,6 +398,41 @@ def ragged_attention(
             kv_scale=kv_scale,
             kernel=decode_kernel,
         )
+    if prefill_kernel == "pallas":
+        from .prefill_attention import fused_prefill_attention
+
+        try:
+            return fused_prefill_attention(
+                q,
+                pages,
+                kv_lens,
+                page_indices,
+                cu_q_lens,
+                num_seqs,
+                sm_scale=sm_scale,
+                kv_scale=kv_scale,
+            )
+        except Exception as e:  # trace-time rejection (see below)
+            # Same fallback policy as the fused decode kernel: only
+            # COMPILED toy shapes (sub-lane-width heads on a real TPU) may
+            # fall back.  Interpret mode has no legitimate rejection path —
+            # a silent fallback there would leave every prefill_kernel
+            # reporting surface (bench JSON, CI gate, /metrics info gauge)
+            # claiming pallas while stock served.
+            if pages.shape[3] >= 128 or not on_tpu():
+                raise
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "fused prefill kernel rejected toy shapes q=%s pages=%s "
+                "(%s); using the stock path",
+                q.shape, pages.shape, e,
+            )
+            prefill_kernel = "stock"
+    if prefill_kernel == "xla":
+        impl = "xla"
+    elif prefill_kernel != "stock":
+        raise ValueError(f"unknown prefill kernel {prefill_kernel!r}")
     if impl == "tpu":
         from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
             ragged_paged_attention,

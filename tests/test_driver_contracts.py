@@ -43,17 +43,27 @@ def test_bench_prints_one_json_line():
         "metric", "value", "unit", "vs_baseline",
         "decode_mfu", "decode_kernel", "attention", "host_gap_frac",
         "dispatch", "pipeline",
+        "prefill_mfu", "prefill_kernel", "prefill",
     }, sorted(out)
     assert out["value"] > 0
     assert 0.0 <= out["host_gap_frac"] <= 1.0
     assert isinstance(out["decode_mfu"], float)
     # ISSUE 13: which decode kernel served the run + the analytic
     # attention byte-share so BENCH_r06 can attribute MFU movement to the
-    # kernel vs the matmuls.
+    # kernel vs the matmuls.  ISSUE 19 rides the prefill half alongside:
+    # which prefill kernel served, its MFU, and the per-chunk summary.
     assert out["decode_kernel"] in ("pallas_fused", "stock", "xla")
+    assert out["prefill_kernel"] in ("pallas", "stock", "xla")
+    assert isinstance(out["prefill_mfu"], float)
+    assert {"chunks", "wall_s", "prompt_tokens",
+            "p50_ms", "p99_ms"} <= set(out["prefill"])
+    assert out["prefill"]["chunks"] >= 1
     assert {"share_est", "kv_bytes_per_step",
-            "weight_bytes_per_step"} <= set(out["attention"])
+            "weight_bytes_per_step",
+            "prefill_share_est",
+            "prefill_kv_bytes_per_chunk"} <= set(out["attention"])
     assert 0.0 <= out["attention"]["share_est"] <= 1.0
+    assert 0.0 <= out["attention"]["prefill_share_est"] <= 1.0
     for kind, v in out["dispatch"].items():
         assert {"dispatches", "p50_ms", "p99_ms"} <= set(v), (kind, v)
     assert {"sessions", "rebuilds", "continuous_admissions",

@@ -87,6 +87,14 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_decode_kernel.py -q \
   -k "parity or traced_scale or routed or resolve" \
   -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== chunked prefill kernel gate (interpret-mode pallas vs XLA oracle:"
+echo "   ragged parity + traced scale + routing/selector + chunk-boundary"
+echo "   byte identity, and the dynamo_tpu_prefill_chunk_seconds summary"
+echo "   asserted on the /metrics render; ops/prefill_attention.py) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_prefill_kernel.py -q \
+  -k "parity or traced_scale or routed or resolve or byte_identity or metric" \
+  -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== continuous-decode churn smoke (CPU bench: staggered finishes +"
 echo "   late arrivals, FUSED decode kernel; bars: fewer rebuilds than"
 echo "   forced-rebuild control, exact streams, zero new compiles,"

@@ -518,6 +518,8 @@ HOT_PATH_FUNCTIONS = {
     "ragged_decode_attention",
     "ragged_attention",
     "write_kv_ragged",
+    "fused_prefill_attention",
+    "resolve_prefill_kernel",
 }
 
 # Array constructors whose result dtype depends on jax's weak-type /

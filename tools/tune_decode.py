@@ -13,6 +13,12 @@ when no entry matches.  Two knob families:
 - **stock** (the jax pallas ragged kernel, TPU only): ``nq`` query block
   and ``nkv_mb`` KV VMEM budget — swept through the env vars the hint
   function reads at trace time.
+- **prefill** (``DYN_PREFILL_KERNEL=pallas``, ops/prefill_attention.py):
+  ``prefill_qb`` (query tokens per block), ``prefill_splits`` (KV-split
+  grid width) and ``prefill_ppcb`` (pages per compute block) — swept by
+  calling the kernel with explicit overrides at a chunked-prefill
+  geometry (every row one ``--prefill-chunk`` tail against a full-chain
+  paged prefix).
 
 On CPU the fused kernel runs in interpret mode, so absolute timings are
 meaningless — the sweep is a smoke (it still exercises every combo and
@@ -69,6 +75,81 @@ def _build_case(model: str, batch: int, page_size: int, pages_per_seq: int,
     )
     num = jnp.asarray([batch], jnp.int32)
     return q, pages, kv_lens, tables, num, D**-0.5, kv_scale
+
+
+def _build_prefill_case(model: str, batch: int, page_size: int,
+                        pages_per_seq: int, cache_dtype: str, chunk: int,
+                        seed: int):
+    """Chunked-prefill geometry: every row is computing its LAST ``chunk``
+    prompt tokens against a full paged chain (prefix + own chunk already
+    in cache) — the worst-case prefix read the kernel exists to speed."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.models.config import get_config
+
+    c = get_config(model)
+    H, KV, D = c.num_heads, c.num_kv_heads, c.head_dim
+    P = batch * pages_per_seq + 1
+    chunk = min(chunk, pages_per_seq * page_size)
+    keys = jax.random.split(jax.random.PRNGKey(seed), 2)
+    q = jax.random.normal(keys[0], (batch * chunk, H, D), jnp.bfloat16)
+    dt = jnp.dtype(cache_dtype)
+    vals = jax.random.normal(keys[1], (P, page_size, 2 * KV, D), jnp.float32)
+    if dt.itemsize == 1 and jnp.issubdtype(dt, jnp.integer):
+        pages = jnp.clip(jnp.round(vals * 40.0), -127, 127).astype(dt)
+        kv_scale = 1.0 / 40.0
+    else:
+        pages = vals.astype(dt)
+        kv_scale = None
+    rng = np.random.default_rng(seed)
+    kv_lens = jnp.full((batch,), pages_per_seq * page_size, jnp.int32)
+    tables = jnp.asarray(
+        rng.permutation(batch * pages_per_seq).reshape(batch, pages_per_seq),
+        jnp.int32,
+    )
+    cu = jnp.arange(batch + 1, dtype=jnp.int32) * chunk
+    num = jnp.asarray([batch], jnp.int32)
+    return q, pages, kv_lens, tables, cu, num, D**-0.5, kv_scale
+
+
+def sweep_prefill(case, qb_list: List[int], splits_list: List[int],
+                  ppcb_list: List[int],
+                  iters: int) -> Tuple[Optional[Dict[str, Any]], List[Dict]]:
+    from dynamo_tpu.ops.prefill_attention import fused_prefill_attention
+
+    q, pages, kv_lens, tables, cu, num, sm, kv_scale = case
+    results = []
+    for qb in qb_list:
+        for s in splits_list:
+            for p in ppcb_list:
+                if p > tables.shape[1]:
+                    continue
+                fn = jax.jit(
+                    lambda q, pages, kv_lens, tables, cu, num,
+                           _qb=qb, _s=s, _p=p:
+                    fused_prefill_attention(
+                        q, pages, kv_lens, tables, cu, num, sm_scale=sm,
+                        kv_scale=kv_scale, q_block=_qb, num_kv_splits=_s,
+                        pages_per_block=_p,
+                    )
+                )
+                try:
+                    us = _time_fn(
+                        fn, (q, pages, kv_lens, tables, cu, num), iters
+                    )
+                except Exception as e:
+                    print(f"tune: prefill qb={qb} splits={s} ppcb={p} "
+                          f"rejected: {e}", file=sys.stderr)
+                    continue
+                results.append(
+                    {"qb": qb, "splits": s, "ppcb": p, "us": round(us, 1)}
+                )
+                print(f"tune: prefill qb={qb} splits={s} ppcb={p}: "
+                      f"{us:.1f}us", file=sys.stderr)
+    best = min(results, key=lambda r: r["us"]) if results else None
+    return best, results
 
 
 def _time_fn(fn, args, iters: int) -> float:
@@ -188,6 +269,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="stock query-block candidates (TPU only)")
     ap.add_argument("--nkv-mb", default="2,4,8",
                     help="stock KV VMEM budget candidates in MB (TPU only)")
+    ap.add_argument("--prefill-chunk", type=int, default=512,
+                    help="prompt tokens per row in the prefill sweep case")
+    ap.add_argument("--prefill-qb", default="64,128,256",
+                    help="prefill query-block candidates (comma list)")
+    ap.add_argument("--prefill-splits", default="1,2,4",
+                    help="prefill KV-split candidates")
+    ap.add_argument("--prefill-ppcb", default="1,2,4,8",
+                    help="prefill pages-per-compute-block candidates")
     ap.add_argument("--out", default=None,
                     help="table path (default: DYN_DECODE_TUNE_TABLE or "
                          "~/.cache/dynamo_tpu/decode_tune.json)")
@@ -204,7 +293,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     stock_best, stock_all = sweep_stock(
         case, ints(args.nq), ints(args.nkv_mb), args.iters
     )
-    if fused_best is None and stock_best is None:
+    prefill_case = _build_prefill_case(
+        args.model, args.batch, args.page_size, args.pages_per_seq,
+        args.cache_dtype, args.prefill_chunk, args.seed,
+    )
+    prefill_best, prefill_all = sweep_prefill(
+        prefill_case, ints(args.prefill_qb), ints(args.prefill_splits),
+        ints(args.prefill_ppcb), args.iters,
+    )
+    if fused_best is None and stock_best is None and prefill_best is None:
         print("tune: no combo survived — nothing written", file=sys.stderr)
         return 1
 
@@ -223,11 +320,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     if stock_best:
         entry.update(nq=stock_best["nq"], nkv_mb=stock_best["nkv_mb"],
                      stock_us=stock_best["us"])
+    if prefill_best:
+        # Keys match resolve_hint's tuned_key names in
+        # ops/prefill_attention.py, so install_tuned_hints serves them
+        # with zero extra plumbing.
+        entry.update(prefill_qb=prefill_best["qb"],
+                     prefill_splits=prefill_best["splits"],
+                     prefill_ppcb=prefill_best["ppcb"],
+                     prefill_us=prefill_best["us"])
     path = args.out or default_table_path()
     key = hint_key(args.model, args.batch, args.page_size)
     write_entry(path, key, entry)
     print(json.dumps({"key": key, "path": path, "entry": entry,
-                      "fused_sweep": fused_all, "stock_sweep": stock_all}))
+                      "fused_sweep": fused_all, "stock_sweep": stock_all,
+                      "prefill_sweep": prefill_all}))
     return 0
 
 
