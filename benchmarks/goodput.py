@@ -49,6 +49,15 @@ L9    BULK PEER KILL: a ``_drive_bulk`` driver runs continuous prefix
       trace.  Bars: >=1 bulk transfer, >=1 resume, >=1 fallback, a
       post-revival recovery, every bulk stream byte-identical to the
       hub-path oracle, and 0 dropped streams
+L10   OBJSTORE SCALE-FROM-ZERO: the fleet runs with the durable object
+      tier armed (engine/object_store.py); a driver warms a prefix on
+      the crash victim and persists it to the object tier (the autopilot
+      ``kv_prefetch persist=True`` path), the victim is killed, and a
+      FRESH engine — empty HBM/host/disk, same object directory — is
+      spawned into the fleet as a scale-from-zero replacement.  Bars:
+      >=1 chain persisted before the crash, the warm start skips >=90%
+      of the second-occurrence prefill (restored, not recomputed), and
+      the warm stream is byte-identical to the pre-crash run
 ====  =======================================================================
 
 Determinism: the trace, every request's sampling seed, and the fault
@@ -71,8 +80,10 @@ Usage:
 ``--check`` exits nonzero unless: every rung has 0 dropped streams, L2
 goodput >= 0.85 x L0 goodput, all completed streams are token-identical to
 the L0 control, L5 respawned its crashed worker, L6's non-flooding
-tenants each retain >= 0.9x their L0 goodput, and L7 detected every
-injected corruption before scatter (``integrity.detected >= fired >= 1``).
+tenants each retain >= 0.9x their L0 goodput, L7 detected every
+injected corruption before scatter (``integrity.detected >= fired >= 1``),
+and L10's scale-from-zero replacement restored >=90% of its
+second-occurrence prefill from the object tier, byte-identically.
 tools/ci.sh runs exactly that as the standing gate.
 """
 
@@ -254,6 +265,10 @@ def ladder_rungs() -> List[Dict[str, Any]]:
          "events": [shard_kill], "shards": 2},
         {"level": 9, "name": "L9-bulk-peer-kill",
          "events": bulk_faults, "bulk": True},
+        # L10: the object tier's reason to exist — the crash victim's KV
+        # survives its death, and a from-zero replacement starts warm.
+        {"level": 10, "name": "L10-objstore-scale-from-zero",
+         "events": [crash1], "objstore": True},
     ]
 
 
@@ -1087,6 +1102,107 @@ async def _drive_bulk(
     return stats
 
 
+# L10 warm-prompt id band: past the storm band, never in the L0 control.
+OBJSTORE_BASE = 300_000
+
+
+async def _drive_objstore(
+    fleet: "ChaosFleet",
+    ev: FaultEvent,
+    t_start: float,
+    *,
+    duration: float,
+    seed: int,
+    extra_engines: List[Any],
+) -> Dict[str, Any]:
+    """The L10 driver: persist → crash → scale-from-zero warm start.
+
+    Before the armed ``worker_crash`` fires, a seeded warm request runs on
+    the victim's engine and its sealed chain is pushed to the durable
+    object tier via ``persist_hashes`` — exactly what the autopilot's
+    ``kv_prefetch persist=True`` directive does through the prefetch
+    consumer.  After the crash, a FRESH engine (empty HBM/host/disk, the
+    victim's ``object_store_dir``) is spawned into the fleet as the
+    scale-from-zero replacement; the same request replayed on it must
+    restore its prefill from objects (>=90% of blocks matched, not
+    recomputed) and stream byte-identically to the pre-crash run.  The
+    victim's engine object is NOT closed (only its runtime/lease died),
+    mirroring a real node loss where the store outlives the process; the
+    byte budget is far above rung traffic, so its idle offload loop can't
+    GC the persisted objects out from under the replacement."""
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.tokens import hash_token_blocks
+
+    victim = fleet.workers[ev.worker or 0]
+    engine = victim.engine
+    bs = engine.cfg.block_size
+    stats: Dict[str, Any] = {
+        "persisted": 0, "prompt_blocks": 0, "warm_matched_blocks": 0,
+        "skip_frac": 0.0, "byte_identical": False, "crashed": False,
+        "rejoined": False,
+    }
+    isl, osl = 40, 4  # 10 full blocks at the ladder's block_size=4
+    stats["prompt_blocks"] = isl // bs
+    req = _request_dict(OBJSTORE_BASE, isl, osl, seed)
+    prompt = list(req["token_ids"])
+    want = []
+    async for item in await engine.generate(Context(dict(req))):
+        want.extend(item.get("token_ids", []))
+
+    # Settle the offload ladder, then persist the sealed chain durably.
+    chain = [tb.sequence_hash for tb in hash_token_blocks(prompt, bs)]
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        await engine.drain_offload()
+        stats["persisted"] = await engine.persist_hashes(chain)
+        if stats["persisted"] >= stats["prompt_blocks"] - 1:
+            break
+        await asyncio.sleep(0.05)
+
+    # Wait for the armed crash to take the victim down.
+    while not victim.closed:
+        if time.monotonic() - t_start > duration + 5.0:
+            return stats  # crash never fired; check_report flags it
+        await asyncio.sleep(0.05)
+    stats["crashed"] = True
+
+    # Scale from zero: fresh tiers except the durable object directory.
+    # The empty disk dir lives beside the fleet's (same kv_root), so the
+    # ladder's teardown rmtree sweeps it too.
+    fresh_disk = tempfile.mkdtemp(
+        prefix="objstore-fresh-",
+        dir=str(Path(engine.cfg.disk_cache_dir).parent),
+    )
+    fresh = TpuEngine(
+        EngineConfig(
+            **ENGINE_CFG,
+            disk_cache_bytes=8 << 20,
+            disk_cache_dir=fresh_disk,
+            object_store_bytes=8 << 20,
+            object_store_dir=engine.cfg.object_store_dir,
+        )
+    )
+    extra_engines.append(fresh)  # run_rung closes it after the fleet
+    await prewarm_engine(fresh, seed)
+    matched0 = fresh.kv.matched_blocks
+    got = []
+    async for item in await fresh.generate(Context(dict(req))):
+        got.extend(item.get("token_ids", []))
+    stats["warm_matched_blocks"] = fresh.kv.matched_blocks - matched0
+    stats["skip_frac"] = round(
+        stats["warm_matched_blocks"] / max(stats["prompt_blocks"], 1), 3
+    )
+    stats["byte_identical"] = got == want
+    # Rejoin the fleet for the remainder of the trace: the replacement is
+    # a real serving worker, not a scoring fixture.
+    fleet.workers.append(await fleet._spawn_worker(fresh))
+    stats["rejoined"] = True
+    logger.info("[objstore] %s", stats)
+    return stats
+
+
 async def _score_tracing(trace_agg, trace_exporter, trace_ctxs) -> Dict[str, Any]:
     """The L0 rung's ``tracing`` block: a stamped trace counts as ASSEMBLED
     once the aggregator holds its driver root span plus an ENGINE span —
@@ -1233,6 +1349,16 @@ async def run_rung(
         bulk_task = asyncio.ensure_future(
             _drive_bulk(fleet, t_start, duration=duration)
         )
+    objstore_task = None
+    objstore_block = None
+    extra_engines: List[Any] = []  # the L10 scale-from-zero replacement
+    if rung.get("objstore"):
+        objstore_task = asyncio.ensure_future(
+            _drive_objstore(
+                fleet, rung["events"][0], t_start,
+                duration=duration, seed=seed, extra_engines=extra_engines,
+            )
+        )
     try:
         for i, arrival in enumerate(trace):
             delay = arrival.t - (time.monotonic() - t_start)
@@ -1275,6 +1401,8 @@ async def run_rung(
                     f.fired for f in armed if f.point.startswith("bulk_")
                 ),
             }
+        if objstore_task is not None:
+            objstore_block = await objstore_task
         await asyncio.gather(*fault_tasks)
     finally:
         for t in (*req_tasks, *fault_tasks):
@@ -1285,12 +1413,19 @@ async def run_rung(
             storm_task.cancel()
         if bulk_task is not None:
             bulk_task.cancel()
+        if objstore_task is not None:
+            objstore_task.cancel()
         if trace_exporter is not None:
             await trace_exporter.stop(final_flush=False)
         if trace_agg is not None:
             await trace_agg.stop()
         faults.reset()
         await fleet.close()
+        for eng in extra_engines:
+            try:
+                await eng.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
     # -- scoring ------------------------------------------------------------
     outcomes = sorted(outcomes, key=lambda o: o.i)
     completed = [o for o in outcomes if o.status == "ok"]
@@ -1365,6 +1500,8 @@ async def run_rung(
         report["tracing"] = tracing_block
     if bulk_block is not None:
         report["bulk"] = bulk_block
+    if objstore_block is not None:
+        report["objstore"] = objstore_block
     if corrupt_events:
         # The L7 bars: every armed kv_corrupt firing is one injected flip,
         # and the integrity plane's corrupt counters advance exactly once
@@ -1521,6 +1658,37 @@ def check_report(
                 f"L9: {b['mismatches']} bulk stream(s) diverged from the "
                 "hub-path oracle (bulk plane not byte-identical)"
             )
+    if 10 in rungs:
+        # Scale-from-zero rung: the chain must actually have been made
+        # durable BEFORE the crash, the replacement must restore (not
+        # recompute) >=90% of the second-occurrence prefill, and the warm
+        # stream must be byte-identical to the pre-crash run.  A rung
+        # where the crash never fired proves nothing and must fail.
+        o = rungs[10].get("objstore") or {}
+        if not o.get("crashed"):
+            problems.append(
+                "L10: the armed worker_crash never took the victim down"
+            )
+        if o.get("persisted", 0) < 1:
+            problems.append(
+                "L10: no chain persisted to the object tier before the "
+                "crash (warming path dead)"
+            )
+        if o.get("skip_frac", 0.0) < 0.9:
+            problems.append(
+                f"L10: scale-from-zero warm start skipped only "
+                f"{o.get('skip_frac', 0.0):.0%} of second-occurrence "
+                f"prefill ({o.get('warm_matched_blocks', 0)}/"
+                f"{o.get('prompt_blocks', 0)} blocks); bar is 90%"
+            )
+        if not o.get("byte_identical"):
+            problems.append(
+                "L10: warm-start stream diverged from the pre-crash run"
+            )
+        if not o.get("rejoined"):
+            problems.append(
+                "L10: the replacement worker never rejoined the fleet"
+            )
     return problems
 
 
@@ -1542,12 +1710,26 @@ async def run_ladder(args) -> Dict[str, Any]:
     kv_root = Path(
         tempfile.mkdtemp(prefix="goodput-kv-", dir=args.workdir)
     )
+    # The L10 rung arms the durable object tier on EVERY engine (same
+    # "exact engine shape for every rung" rule as the disk tiers above —
+    # restores are byte-identical, so lower rungs only gain demotion
+    # traffic); per-worker directories keep stores process-lifetime
+    # disjoint, and the L10 replacement deliberately reuses its victim's.
+    objstore = any(r.get("objstore") for r in rungs)
     engines = [
         TpuEngine(
             EngineConfig(
                 **ENGINE_CFG,
                 disk_cache_bytes=8 << 20,
                 disk_cache_dir=str(kv_root / f"w{i}"),
+                **(
+                    {
+                        "object_store_bytes": 8 << 20,
+                        "object_store_dir": str(kv_root / f"w{i}-objects"),
+                    }
+                    if objstore
+                    else {}
+                ),
             )
         )
         for i in range(n_workers)

@@ -201,9 +201,6 @@ async def _trace_report(url: str, results: List[RequestResult],
     carry-over runs need (edge-queue / preprocess / route / prefill-or-pull
     / first-decode, docs/tracing.md)."""
     ids = [r.trace_id for r in results if r.trace_id]
-    per_hop: dict = {}
-    ttfts: List[float] = []
-    unattributed: List[float] = []
     # Concurrent fetch under ONE shared deadline: fetches are independent,
     # and per-id sequential retries would stall a large sweep for minutes
     # when traces fail to assemble (errored requests, expired TTL).
@@ -228,6 +225,25 @@ async def _trace_report(url: str, results: List[RequestResult],
             await asyncio.sleep(0.25)
 
     rollups = await asyncio.gather(*[fetch(tid) for tid in ids])
+    return trace_report_from_rollups(len(ids), rollups)
+
+
+def trace_report_from_rollups(requested: int,
+                              rollups: List[Optional[dict]]) -> dict:
+    """Pure rollup→report aggregation (split from the /traces fetch so the
+    schema is testable without an HTTP service — the "trace_report" key is
+    a compared-across-runs artifact, so its SHAPE is a contract:
+
+      {"requested": int, "assembled": int,
+       "hops": {hop: {"n": int, "p50_ms": float, "p95_ms": float}}}
+      + ttft_p50_ms / ttft_p95_ms / unattributed_p95_ms — present only
+        when at least one rollup carried ttft_ms (omit-when-absent).
+
+    ``None`` entries are fetch failures: counted in ``requested`` (the
+    caller requested that many), excluded from ``assembled``."""
+    per_hop: dict = {}
+    ttfts: List[float] = []
+    unattributed: List[float] = []
     assembled = 0
     for rollup in rollups:
         if rollup is None:
@@ -239,7 +255,7 @@ async def _trace_report(url: str, results: List[RequestResult],
             ttfts.append(rollup["ttft_ms"] / 1e3)
             unattributed.append(rollup.get("unattributed_ms", 0.0) / 1e3)
     report = {
-        "requested": len(ids),
+        "requested": requested,
         "assembled": assembled,
         "hops": {
             hop: {

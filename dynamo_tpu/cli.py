@@ -1149,6 +1149,20 @@ def main(argv: Optional[list] = None) -> None:
         "(default: a per-process dir under the system temp root)",
     )
     p_run.add_argument(
+        "--object-store-mb", type=int, default=0, dest="object_store_mb",
+        help="durable object-store KV tier budget in MiB: disk-tier "
+        "eviction and explicit persists land in a fleet-shared object "
+        "layout that outlives the worker, so a scale-from-zero replica "
+        "boots warm (requires --disk-cache-mb and --object-store-dir; "
+        "docs/kv_tiering.md)",
+    )
+    p_run.add_argument(
+        "--object-store-dir", default=None, dest="object_store_dir",
+        help="object layout root for the durable KV tier (required with "
+        "--object-store-mb: the store outlives the process, so there is "
+        "no per-process default)",
+    )
+    p_run.add_argument(
         "--kv-pull-mb", type=int, default=None, dest="kv_pull_mb",
         help="cross-worker prefix pull byte budget in MiB (the router "
         "hints a peer holding a deeper prefix; the engine pulls the "

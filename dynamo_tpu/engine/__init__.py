@@ -83,6 +83,8 @@ def build_tpu_engine(args):
         host_cache_bytes=(getattr(args, "host_cache_mb", 0) or 0) << 20,
         disk_cache_bytes=(getattr(args, "disk_cache_mb", 0) or 0) << 20,
         disk_cache_dir=getattr(args, "disk_cache_dir", None),
+        object_store_bytes=(getattr(args, "object_store_mb", 0) or 0) << 20,
+        object_store_dir=getattr(args, "object_store_dir", None),
         spec_decode=_spec_decode_section(args),
         lora=lora_section,
         qos=_qos_sched_section(),

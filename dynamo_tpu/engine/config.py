@@ -309,6 +309,20 @@ class EngineConfig:
     # into a recompute, never a wrong scatter (docs/kv_tiering.md has the
     # durability-vs-latency tradeoff).
     disk_fsync: bool = False
+    # Object-store KV tier (engine/object_store.py): disk-tier LRU
+    # eviction DEMOTES blocks into a durable object layout instead of
+    # dropping them, and hot chains can be persisted there explicitly
+    # (persist_hashes / the autopilot warming policy), so a
+    # scale-from-zero worker pointed at the same ``object_store_dir``
+    # boots warm.  Requires disk_cache_bytes > 0 (the demotion ladder
+    # feeds it) and an EXPLICIT directory: the store outlives the
+    # process by design, so the operator owns params stability — there
+    # is deliberately no per-PID default to fall back to.  0 disables.
+    object_store_bytes: int = 0
+    object_store_dir: Optional[str] = None
+    # fsync each object part before the atomic publish (durability knob,
+    # same tradeoff as disk_fsync; DYN_OBJSTORE_FSYNC=1 also enables).
+    object_store_fsync: bool = False
     # KV integrity plane (engine/integrity.py): seconds a checksum-failed
     # block hash stays negative-cached.  While banned, restore/promotion
     # treat the hash as a miss and cross-worker pulls skip it, so a donor
@@ -368,6 +382,19 @@ class EngineConfig:
                 "disk_cache_bytes requires host_cache_bytes > 0 (the disk "
                 "tier is fed by host-tier demotion)"
             )
+        if self.object_store_bytes > 0:
+            if self.disk_cache_bytes <= 0:
+                raise ValueError(
+                    "object_store_bytes requires disk_cache_bytes > 0 (the "
+                    "object tier is fed by disk-tier demotion)"
+                )
+            if self.object_store_dir is None:
+                raise ValueError(
+                    "object_store_bytes requires an explicit "
+                    "object_store_dir: the store outlives the process, so "
+                    "the operator must own the directory (and the params "
+                    "stability its hashes assume)"
+                )
         if self.decode_kernel not in ("auto",) + DECODE_KERNELS:
             raise ValueError(
                 f"unknown decode_kernel {self.decode_kernel!r} "

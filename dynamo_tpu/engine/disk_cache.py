@@ -40,7 +40,7 @@ import os
 import struct
 import threading
 from collections import OrderedDict
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
@@ -71,6 +71,13 @@ class DiskKvStore:
     def __init__(self, capacity_bytes: int, directory: str, fsync: bool = False):
         self.capacity_bytes = capacity_bytes
         self.directory = directory
+        # Demotion hook (mirrors HostKvStore.on_evict): with an object
+        # store configured (engine/object_store.py) LRU eviction DEMOTES
+        # instead of dropping — ``on_evict(hash, path) -> bool`` receives
+        # the block's envelope PATH (not bytes: the next tier parses and
+        # re-verifies the file itself, so rot on this tier is refused at
+        # the handoff) and a True return means the object tier took it.
+        self.on_evict: Optional[Callable[[int, str], bool]] = None
         # Durability knob (DYN_DISK_FSYNC / EngineConfig.disk_fsync):
         # ``os.replace`` is rename-atomic but a power loss can persist the
         # renamed file with unflushed payload pages; fsync-before-rename
@@ -93,8 +100,10 @@ class DiskKvStore:
         self.evicted_blocks = 0
         self.rejected_blocks = 0
         self.corrupt_blocks = 0
-        # (kind, hash) records for the engine's event flush; "drop" only —
-        # promotion is driven (and recorded) by the engine side.
+        self.demoted_blocks = 0
+        # (kind, hash) records for the engine's event flush; "drop" and
+        # "demote" (object-tier handoff) — promotion is driven (and
+        # recorded) by the engine side.
         self._transitions: List[Tuple[str, int]] = []
         # Rebuild the index from an existing directory (a restarted worker
         # finds its demoted blocks again): coldest = oldest mtime.  Orphaned
@@ -212,8 +221,24 @@ class DiskKvStore:
                 old, old_bytes = self._index.popitem(last=False)  # LRU
                 self._bytes -= old_bytes
                 self.evicted_blocks += 1
+                demoted = False
+                if self.on_evict is not None:
+                    try:
+                        # The file still exists here: the hook parses and
+                        # re-verifies it before taking ownership of a copy.
+                        demoted = bool(self.on_evict(old, self._path(old)))
+                    except Exception:
+                        # Demotion is an optimization; a failing object
+                        # tier must never break the disk eviction path.
+                        logger.exception(
+                            "disk-tier demotion failed for %#x", old
+                        )
+                if demoted:
+                    self.demoted_blocks += 1
                 with self._tlock:
-                    self._transitions.append(("drop", old))
+                    self._transitions.append(
+                        ("demote" if demoted else "drop", old)
+                    )
                 try:
                     os.remove(self._path(old))
                 except OSError:

@@ -126,6 +126,9 @@ class TpuEngine(
         # Host KV offload tier (engine/host_cache.py).
         self.host_kv = None
         self.disk_kv = None
+        # Durable object-store tier (engine/object_store.py): the only
+        # tier that OUTLIVES this process — never removed at close().
+        self.object_kv = None
         self._offload_queue: List[Tuple[int, Any]] = []
         self._offload_task: Optional[asyncio.Task] = None
         # Cross-worker prefix pull hook (llm/kv_router/pull.py): the serving
@@ -181,6 +184,18 @@ class TpuEngine(
                         cfg.disk_cache_bytes, d, fsync=fsync
                     )
                     self.host_kv.on_evict = self._demote_to_disk
+                    if cfg.object_store_bytes > 0:
+                        from .object_store import ObjectKvStore
+
+                        ofsync = cfg.object_store_fsync or _os.environ.get(
+                            "DYN_OBJSTORE_FSYNC", ""
+                        ) not in ("", "0", "false")
+                        self.object_kv = ObjectKvStore(
+                            cfg.object_store_bytes,
+                            cfg.object_store_dir,
+                            fsync=ofsync,
+                        )
+                        self.disk_kv.on_evict = self._demote_to_objstore
             # HBM eviction of a block a lower tier retains emits a
             # tier-tagged event instead of Removed (kv_manager).
             self.kv.tier_lookup = self._tier_of
@@ -256,11 +271,15 @@ class TpuEngine(
         self.decode_stalls = 0  # fetches that exceeded the threshold
         self.last_stall: Optional[Dict[str, Any]] = None
         # Injectable pace hook: awaited before every device-op await
-        # (_await_device) when set.  None (the default) is a single attr
+        # (pipeline._pace) when set.  None (the default) is a single attr
         # check — zero hot-path cost.  Tests use it to throttle decode
         # deterministically (e.g. so a migration's copy loop provably
         # outpaces the sequence on slow containers) instead of racing
-        # wall-clock sleeps.
+        # wall-clock sleeps.  Contract: the hook is awaited OUTSIDE the
+        # device lock, so it may BLOCK indefinitely — barrier hooks (the
+        # migration copy-round gate in tests/test_migration.py) cannot
+        # deadlock the KV copy/export plane, which takes the lock only
+        # between paced ops.
         self.pace_hook: Optional[Callable[[], Any]] = None
         # Multi-tenancy (llm/tenancy): LoRA adapter registry (None = LoRA
         # disabled), optional served-model allowlist (unknown names →
@@ -1044,6 +1063,7 @@ class TpuEngine(
         if self.host_kv is not None and (
             len(self.host_kv)
             or (self.disk_kv is not None and len(self.disk_kv))
+            or (self.object_kv is not None and len(self.object_kv))
         ):
             # Pull any evicted prefix blocks back from the host/disk tiers
             # BEFORE admission, so the scheduler sees them as prefix-cache
@@ -1217,6 +1237,10 @@ class TpuEngine(
 
             shutil.rmtree(self.disk_kv.directory, ignore_errors=True)
             self.disk_kv = None
+        # The object-store tier is deliberately NOT removed: it is the
+        # durable rung — a respawned worker pointed at the same dir boots
+        # warm from it (scale-from-zero; docs/kv_tiering.md).
+        self.object_kv = None
         # Fail whatever is still in flight so no generate() stream hangs.
         self._fail_all()
 
@@ -1252,6 +1276,8 @@ class TpuEngine(
             return "host"
         if self.disk_kv is not None and self.disk_kv.contains(seq_hash):
             return "disk"
+        if self.object_kv is not None and self.object_kv.contains(seq_hash):
+            return "objstore"
         return None
 
     def _demote_to_disk(self, seq_hash: int, block) -> bool:
@@ -1266,6 +1292,17 @@ class TpuEngine(
         return self.disk_kv.put(
             seq_hash, block, checksum=self.host_kv.checksum(seq_hash)
         )
+
+    def _demote_to_objstore(self, seq_hash: int, path: str) -> bool:
+        """DiskKvStore.on_evict hook: re-wrap an evicted disk envelope as
+        a durable object.  Runs inside the disk store's eviction loop
+        (under its lock, often off the event loop) — record-only, events
+        flush later.  The envelope is parsed and its carried CRC
+        re-verified at ingest, so disk rot is refused here instead of
+        persisted for the whole fleet to trust."""
+        if self.object_kv is None:
+            return False
+        return self.object_kv.ingest_kvblk(seq_hash, path)
 
     def set_integrity_reporter(self, reporter) -> None:
         """Attach ``reporter(plane: str)`` called on every LOCAL-tier
@@ -1315,6 +1352,8 @@ class TpuEngine(
                     hit = True
                 if self.disk_kv is not None and self.disk_kv.drop(d):
                     hit = True
+                if self.object_kv is not None and self.object_kv.drop(d):
+                    hit = True
                 if hit and d != seq_hash:
                     dropped += 1
             kv_integrity_metrics.descendants_dropped_total += dropped
@@ -1336,23 +1375,35 @@ class TpuEngine(
         nothing — the router's view stays 'hbm' until HBM eviction."""
         if self.host_kv is None:
             return
-        trans = self.host_kv.drain_transitions()
+        # Each store's "demote" means "the NEXT tier down took it" — the
+        # tier tag depends on which store recorded the transition, so the
+        # drains stay separate.
+        tagged: List[Tuple[str, str, int]] = [
+            ("disk", kind, h) for kind, h in self.host_kv.drain_transitions()
+        ]
         if self.disk_kv is not None:
-            trans += self.disk_kv.drain_transitions()
-        demoted: List[int] = []
+            tagged += [
+                ("objstore", kind, h)
+                for kind, h in self.disk_kv.drain_transitions()
+            ]
+        if self.object_kv is not None:
+            tagged += [
+                ("", kind, h)
+                for kind, h in self.object_kv.drain_transitions()
+            ]
+        demoted: Dict[str, List[int]] = {}
         removed: List[int] = []
-        for kind, h in trans:
+        for next_tier, kind, h in tagged:
             if h in self.kv._by_hash:
                 continue  # HBM still holds it: best tier unchanged
             if kind == "demote":
-                demoted.append(h)
-            elif self.host_kv.contains(h) or (
-                self.disk_kv is not None and self.disk_kv.contains(h)
-            ):
+                demoted.setdefault(next_tier, []).append(h)
+            elif self._tier_of(h) is not None:
                 continue  # another tier still holds it
             else:
                 removed.append(h)
-        self.kv.emit_tiered("disk", demoted)
+        for tier, hashes in demoted.items():
+            self.kv.emit_tiered(tier, hashes)
         self.kv.emit_removed(removed)
 
     def local_prefix_blocks(
@@ -1406,6 +1457,11 @@ class TpuEngine(
             out["disk"] = {
                 "blocks": len(self.disk_kv),
                 "bytes": self.disk_kv.used_bytes,
+            }
+        if self.object_kv is not None:
+            out["objstore"] = {
+                "blocks": len(self.object_kv),
+                "bytes": self.object_kv.used_bytes,
             }
         return out
 

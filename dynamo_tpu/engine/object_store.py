@@ -1,0 +1,446 @@
+"""Object-store KV tier: the fourth, fleet-durable rung of the hierarchy
+(HBM → host → disk → object store).
+
+Reference direction: Mooncake's disaggregated KVCache pool and the
+CacheGen durable-prefix argument (PAPERS.md) — the first three tiers die
+with the worker process, so every scale-from-zero replica pays full
+prefill for prefixes the fleet computed thousands of times.  This tier
+decouples prefix lifetime from worker lifetime: hot chains are persisted
+into a shared object layout that a brand-new worker re-indexes at boot and
+restores from (object → host → HBM), turning cold-start prefill into a
+prefix-cache hit.
+
+Local-FS-backed object layout (an S3/GCS client would slot behind the
+same interface): objects live under two-level fan-out directories
+(``{hash>>56:02x}/{hash:016x}.obj``) so a fleet's worth of prefixes never
+piles a million files into one directory.  Writes are multipart-style and
+atomic: the payload streams into a ``*.tmp`` staging file in bounded
+parts (``part_bytes`` per write syscall — the shape an object store's
+multipart upload API takes), then one ``os.replace`` publishes the
+object; readers never observe a torn object and a crash mid-upload leaves
+only a staging file that re-index deletes.
+
+Integrity: the envelope carries the SAME CRC-32 stamp minted at host
+offload (engine/integrity.py) — demotion parses and RE-VERIFIES the disk
+envelope before re-wrapping it, so disk rot is refused at ingest instead
+of laundered into a durable object the whole fleet would trust; reads
+verify again before any promotion, and a corrupt object is deleted +
+quarantined (recompute, never a wrong scatter) per the PR 13 contract.
+
+GC is byte-budgeted and batched, not per-put: puts may transiently
+overshoot ``capacity_bytes``; ``gc()`` then evicts coldest-first down to
+the low watermark.  Batching matters here because this tier is SHARED
+ACROSS WORKER LIFETIMES — an eviction is fleet-visible, so the store
+prefers a few large GC sweeps (observable, countable) over a constant
+trickle interleaved with every demotion.
+
+Thread-safety mirrors DiskKvStore: one internal lock around mutation
+(callers run under ``asyncio.to_thread``), a tiny separate lock for the
+transition records the engine drains on the event loop, and lock-free
+GIL-atomic membership reads for hot-path callers.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import struct
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .disk_cache import _np_dtype
+
+logger = logging.getLogger(__name__)
+
+_MAGIC = b"DOBJ1\n"
+_HLEN = struct.Struct("<I")
+
+
+def parse_object_blob(
+    blob: bytes,
+    expected_shape=None,
+    expected_dtype=None,
+    magic: bytes = _MAGIC,
+) -> Optional[Tuple[np.ndarray, Optional[int]]]:
+    """Validate one self-describing KV envelope (magic + JSON header
+    {dtype, shape, checksum} + payload) byte-for-byte; None on ANY
+    structural or checksum failure — the inject_blocks contract: a bad
+    object is a miss, never a crash or a wrong scatter.  ``magic`` lets
+    the demotion path parse the disk tier's ``.kvblk`` envelope with the
+    same validator before re-wrapping it."""
+    from .integrity import bytes_checksum
+
+    if not blob.startswith(magic) or len(blob) < len(magic) + _HLEN.size:
+        return None
+    off = len(magic)
+    (hlen,) = _HLEN.unpack_from(blob, off)
+    off += _HLEN.size
+    if len(blob) < off + hlen:
+        return None
+    try:
+        header = json.loads(blob[off : off + hlen])
+        dt = _np_dtype(header["dtype"])
+        shape = tuple(int(s) for s in header["shape"])
+        checksum = header.get("checksum")
+        checksum = None if checksum is None else int(checksum)
+    except (ValueError, KeyError, TypeError):
+        return None
+    off += hlen
+    if len(blob) - off != int(np.prod(shape)) * dt.itemsize:
+        return None  # truncated/padded payload
+    if expected_shape is not None and shape != tuple(expected_shape):
+        return None
+    if expected_dtype is not None and dt != np.dtype(expected_dtype):
+        return None
+    if checksum is not None and bytes_checksum(blob[off:]) != checksum:
+        return None  # payload bit-rot: structural checks passed, CRC not
+    return np.frombuffer(blob, dtype=dt, offset=off).reshape(shape), checksum
+
+
+class ObjectKvStore:
+    """hash → one durable block object [L, page_size, 2*kv_heads, head_dim].
+
+    Duck-types ``DiskKvStore`` (contains/block_nbytes/put/get/read/drop/
+    drain_transitions/used_bytes) so the promotion and quarantine paths
+    treat it as one more rung; single-process writers, any-process readers
+    (the scale-from-zero consumer re-indexes the directory at boot)."""
+
+    def __init__(
+        self,
+        capacity_bytes: int,
+        directory: str,
+        fsync: bool = False,
+        part_bytes: int = 1 << 20,
+        gc_watermark: float = 0.9,
+    ):
+        self.capacity_bytes = capacity_bytes
+        self.directory = directory
+        self.fsync = fsync
+        self.part_bytes = max(1, part_bytes)
+        # GC target as a fraction of capacity: a sweep stops once
+        # used_bytes <= capacity * gc_watermark, leaving headroom so the
+        # next few puts don't immediately re-trigger it.
+        self.gc_watermark = gc_watermark
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._tlock = threading.Lock()
+        # hash → object bytes, access-ordered (coldest first).
+        self._index: "OrderedDict[int, int]" = OrderedDict()
+        self._bytes = 0
+        # counters (metrics / tests)
+        self.stored_blocks = 0
+        self.fetched_blocks = 0
+        self.evicted_blocks = 0
+        self.rejected_blocks = 0
+        self.corrupt_blocks = 0
+        self.gc_runs = 0
+        self._transitions: List[Tuple[str, int]] = []
+        # Re-index an existing object root (the scale-from-zero boot path:
+        # a fresh worker pointed at the fleet's object dir finds every
+        # persisted prefix).  Coldest = oldest mtime; orphaned staging
+        # files from a crashed multipart upload are deleted — they hold no
+        # indexable content but consume bytes outside the budget forever.
+        entries = []
+        for sub in sorted(os.listdir(directory)):
+            subdir = os.path.join(directory, sub)
+            if not os.path.isdir(subdir):
+                continue
+            for name in os.listdir(subdir):
+                if name.endswith(".obj.tmp"):
+                    try:
+                        os.remove(os.path.join(subdir, name))
+                    except OSError:
+                        pass
+                    continue
+                if not name.endswith(".obj"):
+                    continue
+                try:
+                    h = int(name[: -len(".obj")], 16)
+                except ValueError:
+                    continue
+                try:
+                    st = os.stat(os.path.join(subdir, name))
+                except OSError:
+                    continue
+                entries.append((st.st_mtime, h, st.st_size))
+        for _, h, size in sorted(entries):
+            self._index[h] = size
+            self._bytes += size
+
+    # ------------------------------------------------------------------ state
+    def _path(self, seq_hash: int) -> str:
+        return os.path.join(
+            self.directory, f"{(seq_hash >> 56) & 0xFF:02x}",
+            f"{seq_hash:016x}.obj",
+        )
+
+    def _tmp_path(self, final: str) -> str:
+        """Staging path for the multipart write protocol: parts land in
+        ``<final>.tmp`` and are ``os.replace``d into place on completion
+        or ``os.remove``d on failure (dynalint DYN501 tracks the pair)."""
+        return final + ".tmp"
+
+    # Membership reads are lock-free like the other tiers: the event loop
+    # consults them on hot paths and a stale answer degrades to one
+    # validated miss + recompute, never corruption.
+    def __len__(self) -> int:
+        return len(self._index)
+
+    @property
+    def used_bytes(self) -> int:
+        return self._bytes
+
+    def contains(self, seq_hash: int) -> bool:
+        return seq_hash in self._index
+
+    def block_nbytes(self, seq_hash: int) -> Optional[int]:
+        return self._index.get(seq_hash)
+
+    def drain_transitions(self) -> List[Tuple[str, int]]:
+        with self._tlock:
+            out, self._transitions = self._transitions, []
+            return out
+
+    # -------------------------------------------------------------------- put
+    def put(self, seq_hash: int, block, checksum: Optional[int] = None) -> bool:
+        """Persist one block as a durable object.  ``checksum`` is the
+        offload-time stamp; when provided it is VERIFIED against the
+        payload before anything touches the store — a mismatch means the
+        bytes rotted upstream, and persisting them would hand the poison
+        to every future scale-from-zero worker."""
+        from .integrity import bytes_checksum
+
+        from ..llm.metrics import objstore_metrics
+
+        if not isinstance(block, np.ndarray):
+            self.rejected_blocks += 1
+            return False
+        payload = np.ascontiguousarray(block).tobytes()
+        payload_crc = bytes_checksum(payload)
+        if checksum is not None and int(checksum) != payload_crc:
+            from ..llm.metrics import kv_integrity_metrics
+
+            kv_integrity_metrics.corrupt_total["host"] += 1
+            self.corrupt_blocks += 1
+            self.rejected_blocks += 1
+            logger.warning(
+                "refusing to persist block %#x: payload fails its offload "
+                "checksum (upstream corruption)", seq_hash,
+            )
+            return False
+        header = json.dumps(
+            {
+                "dtype": str(block.dtype),
+                "shape": list(block.shape),
+                "checksum": payload_crc,
+            }
+        ).encode()
+        return self._store_blob(
+            seq_hash, _MAGIC + _HLEN.pack(len(header)) + header + payload,
+            objstore_metrics,
+        )
+
+    def ingest_kvblk(self, seq_hash: int, path: str) -> bool:
+        """Demotion entry point (``DiskKvStore.on_evict``): parse + verify
+        the evicted ``.kvblk`` envelope and re-wrap it as a durable object.
+        Runs inside the disk store's eviction loop (under ITS lock, off the
+        event loop) — so this must never call back into the disk tier.  A
+        file that fails validation is refused (the disk tier's own read
+        path owns quarantining it); the carried CRC rides into the object
+        header unchanged."""
+        from .disk_cache import _MAGIC as _DISK_MAGIC
+
+        from ..llm.metrics import objstore_metrics
+
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            self.rejected_blocks += 1
+            return False
+        parsed = parse_object_blob(blob, magic=_DISK_MAGIC)
+        if parsed is None:
+            self.corrupt_blocks += 1
+            self.rejected_blocks += 1
+            logger.warning(
+                "refusing to persist demoted block %#x: disk envelope "
+                "fails validation", seq_hash,
+            )
+            return False
+        arr, checksum = parsed
+        # Same header, object magic: the payload bytes (and their CRC)
+        # are carried, not recomputed.
+        header = json.dumps(
+            {
+                "dtype": str(arr.dtype),
+                "shape": list(arr.shape),
+                "checksum": checksum,
+            }
+        ).encode()
+        (hlen,) = _HLEN.unpack_from(blob, len(_DISK_MAGIC))
+        payload = blob[len(_DISK_MAGIC) + _HLEN.size + hlen:]
+        return self._store_blob(
+            seq_hash, _MAGIC + _HLEN.pack(len(header)) + header + payload,
+            objstore_metrics,
+        )
+
+    def _store_blob(self, seq_hash: int, blob: bytes, metrics) -> bool:
+        nbytes = len(blob)
+        with self._lock:
+            if nbytes > self.capacity_bytes:
+                self.rejected_blocks += 1
+                return False
+            if seq_hash in self._index:
+                self._index.move_to_end(seq_hash)
+                return True
+            path = self._path(seq_hash)
+            tmp = self._tmp_path(path)
+            try:
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                with open(tmp, "wb") as f:
+                    # Multipart-style upload: bounded parts, one final
+                    # atomic publish.  A crash between parts leaves only
+                    # the staging file (re-index deletes it).
+                    for off in range(0, nbytes, self.part_bytes):
+                        f.write(blob[off : off + self.part_bytes])
+                    if self.fsync:
+                        f.flush()
+                        os.fsync(f.fileno())
+                os.replace(tmp, path)  # atomic: readers never see parts
+            except OSError:
+                logger.exception("object KV tier write failed for %#x", seq_hash)
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                self.rejected_blocks += 1
+                return False
+            self._index[seq_hash] = nbytes
+            self._bytes += nbytes
+            self.stored_blocks += 1
+            metrics.puts_total += 1
+            metrics.put_bytes_total += nbytes
+            if self._bytes > self.capacity_bytes:
+                self._gc_locked()
+            return True
+
+    # --------------------------------------------------------------------- gc
+    def _gc_locked(self) -> None:
+        """Byte-budgeted sweep: evict coldest objects until used bytes sit
+        at/below the low watermark.  Caller holds the main lock."""
+        from ..llm.metrics import objstore_metrics
+
+        target = int(self.capacity_bytes * self.gc_watermark)
+        swept = 0
+        while self._bytes > target and self._index:
+            old, old_bytes = self._index.popitem(last=False)  # coldest
+            self._bytes -= old_bytes
+            self.evicted_blocks += 1
+            swept += 1
+            objstore_metrics.gc_evictions_total += 1
+            with self._tlock:
+                self._transitions.append(("drop", old))
+            try:
+                os.remove(self._path(old))
+            except OSError:
+                pass
+        if swept:
+            self.gc_runs += 1
+            logger.info(
+                "object KV GC: evicted %d objects, %d bytes in use", swept,
+                self._bytes,
+            )
+
+    def gc(self) -> int:
+        """Run one sweep now (operator/test hook); returns evicted count."""
+        with self._lock:
+            before = self.evicted_blocks
+            self._gc_locked()
+            return self.evicted_blocks - before
+
+    # -------------------------------------------------------------------- get
+    def get(
+        self,
+        seq_hash: int,
+        expected_shape: Optional[Tuple[int, ...]] = None,
+        expected_dtype=None,
+    ) -> Optional[np.ndarray]:
+        return self.read(seq_hash, expected_shape, expected_dtype)[0]
+
+    def read(
+        self,
+        seq_hash: int,
+        expected_shape: Optional[Tuple[int, ...]] = None,
+        expected_dtype=None,
+    ) -> Tuple[Optional[np.ndarray], Optional[int], bool]:
+        """Read + VALIDATE one object; ``(array, carried_checksum,
+        corrupt)`` exactly like ``DiskKvStore.read`` — a corrupt object is
+        deleted (it cannot miss forever) and the loss RECORDED so the
+        router stops advertising the prefix."""
+        from ..llm.metrics import objstore_metrics
+        from ..runtime.faultinject import faults
+
+        with self._lock:
+            if seq_hash not in self._index:
+                return None, None, False
+            path = self._path(seq_hash)
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+            except OSError:
+                self._drop_locked(seq_hash)
+                with self._tlock:
+                    self._transitions.append(("drop", seq_hash))
+                return None, None, False
+            if (
+                faults.enabled
+                and len(blob) > len(_MAGIC) + _HLEN.size
+                and faults.should("kv_corrupt", "objstore")
+            ):
+                # Chaos hook: flip one payload byte AFTER the read —
+                # durable media rots too (the L10 rung's fault).
+                from .integrity import flip_blob_byte
+
+                (hlen,) = _HLEN.unpack_from(blob, len(_MAGIC))
+                blob = flip_blob_byte(blob, len(_MAGIC) + _HLEN.size + hlen)
+            parsed = parse_object_blob(blob, expected_shape, expected_dtype)
+            if parsed is None:
+                self.corrupt_blocks += 1
+                self._drop_locked(seq_hash)
+                with self._tlock:
+                    self._transitions.append(("drop", seq_hash))
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                return None, None, True
+            arr, checksum = parsed
+            self._index.move_to_end(seq_hash)  # touch
+            objstore_metrics.gets_total += 1
+            objstore_metrics.get_bytes_total += len(blob)
+            self.fetched_blocks += 1
+            return arr, checksum, False
+
+    def drop(self, seq_hash: int) -> bool:
+        """Remove one object (corruption quarantine of chained
+        descendants); records the loss for the engine's event flush."""
+        with self._lock:
+            if seq_hash not in self._index:
+                return False
+            self._drop_locked(seq_hash)
+            try:
+                os.remove(self._path(seq_hash))
+            except OSError:
+                pass
+        with self._tlock:
+            self._transitions.append(("drop", seq_hash))
+        return True
+
+    def _drop_locked(self, seq_hash: int) -> None:
+        nbytes = self._index.pop(seq_hash, None)
+        if nbytes is not None:
+            self._bytes -= nbytes

@@ -190,12 +190,15 @@ class HostOffloadMixin:
         self, seq_hashes: List[int], stop_on_miss: bool
     ) -> List[int]:
         """Disk→host promotion (thread context): read + validate each
-        block's file and insert it into the host tier.  Byte budget is
-        counted against the DESTINATION tier before any file is read —
-        an oversized batch rejects early instead of transiently blowing
-        the host budget (and evicting the working set for nothing).
-        ``stop_on_miss`` stops at the first unavailable hash (prefix
-        restores need a contiguous leading run); prefetch skips instead.
+        block's file and insert it into the host tier.  A hash the disk
+        tier no longer holds falls through to the object-store tier
+        (object → host directly — the scale-from-zero restore path, where
+        the disk tier starts empty).  Byte budget is counted against the
+        DESTINATION tier before any file is read — an oversized batch
+        rejects early instead of transiently blowing the host budget (and
+        evicting the working set for nothing).  ``stop_on_miss`` stops at
+        the first unavailable hash (prefix restores need a contiguous
+        leading run); prefetch skips instead.
 
         Integrity: the envelope checksum verifies inside ``read`` (a
         corrupt file is a quarantine event — the chain's deeper tier
@@ -218,20 +221,24 @@ class HostOffloadMixin:
                 continue
             if self.host_kv.contains(h):
                 continue
+            source, plane = self.disk_kv, "disk"
             nbytes = self.disk_kv.block_nbytes(h)
+            if nbytes is None and self.object_kv is not None:
+                source, plane = self.object_kv, "objstore"
+                nbytes = self.object_kv.block_nbytes(h)
             if nbytes is None:
                 if stop_on_miss:
                     break
                 continue
             if not self.host_kv.admit_bytes(staged + nbytes):
                 break  # destination budget exhausted: reject BEFORE copying
-            arr, checksum, corrupt = self.disk_kv.read(
+            arr, checksum, corrupt = source.read(
                 h, expected_shape=shape, expected_dtype=dtype
             )
             if corrupt:
                 # The file was already dropped by read(); quarantine the
                 # chain (descendants + negative cache) and recompute.
-                self._record_corruption("disk", h, chain=seq_hashes)
+                self._record_corruption(plane, h, chain=seq_hashes)
                 kv_integrity_metrics.recomputed_total += 1
                 if stop_on_miss:
                     break
@@ -241,7 +248,7 @@ class HostOffloadMixin:
                     break
                 continue
             if checksum is not None:
-                kv_integrity_metrics.verified_total["disk"] += 1
+                kv_integrity_metrics.verified_total[plane] += 1
             self.host_kv.put(h, arr, checksum=checksum)
             staged += nbytes
             promoted.append(h)
@@ -279,6 +286,45 @@ class HostOffloadMixin:
         self._emit_promotions(promoted)
         return len(promoted)
 
+    async def persist_hashes(self, seq_hashes: List[int]) -> int:
+        """Persist predicted-hot chains into the durable object tier (the
+        autopilot warming policy's durability half — llm/kv_router/pull.py
+        KvPrefetchConsumer ``persist`` flag): a chain persisted here
+        survives this worker's death and warm-starts its scale-from-zero
+        replacement.  Sources the host tier first (carried offload stamp),
+        then the disk tier (validated read); HBM-only blocks are skipped —
+        the write-behind offload pump lands them in host within a cycle.
+        Returns objects stored."""
+        if self.object_kv is None or not seq_hashes:
+            return 0
+        stored = await asyncio.to_thread(self._persist_blocks, seq_hashes)
+        self._flush_tier_events()
+        return stored
+
+    def _persist_blocks(self, seq_hashes: List[int]) -> int:
+        stored = 0
+        for h in seq_hashes:
+            if self.integrity.banned(h) or self.object_kv.contains(h):
+                continue
+            blk = self.host_kv.peek(h) if self.host_kv is not None else None
+            if isinstance(blk, np.ndarray):
+                if self.object_kv.put(
+                    h, blk, checksum=self.host_kv.checksum(h)
+                ):
+                    stored += 1
+                continue
+            if self.disk_kv is None or not self.disk_kv.contains(h):
+                continue
+            arr, checksum, corrupt = self.disk_kv.read(h)
+            if corrupt:
+                self._record_corruption("disk", h, chain=list(seq_hashes))
+                continue
+            if arr is not None and self.object_kv.put(
+                h, arr, checksum=checksum
+            ):
+                stored += 1
+        return stored
+
     async def restore_prefix(
         self, token_ids: List[int], salt: Optional[str] = None
     ) -> int:
@@ -291,6 +337,7 @@ class HostOffloadMixin:
         if self.host_kv is None or not (
             len(self.host_kv)
             or (self.disk_kv is not None and len(self.disk_kv))
+            or (self.object_kv is not None and len(self.object_kv))
         ):
             return 0
         return await self._restore_from_host(token_ids, salt)
@@ -327,10 +374,14 @@ class HostOffloadMixin:
 
         blocks = hash_token_blocks(token_ids, self.cfg.block_size, salt)
         resident = len(self.kv.match_prefix(blocks))
-        if self.disk_kv is not None and len(self.disk_kv):
-            # Promote the leading disk-resident run into the host tier
-            # first, so the host→HBM scatter below sees one contiguous
-            # restorable prefix (disk → host → HBM).
+        if self.disk_kv is not None and (
+            len(self.disk_kv)
+            or (self.object_kv is not None and len(self.object_kv))
+        ):
+            # Promote the leading disk/object-resident run into the host
+            # tier first, so the host→HBM scatter below sees one
+            # contiguous restorable prefix (objstore → host → HBM is the
+            # scale-from-zero boot path: disk starts empty).
             promoted = await asyncio.to_thread(
                 self._promote_blocks,
                 [tb.sequence_hash for tb in blocks[resident:]],

@@ -269,6 +269,7 @@ class DecodePipelineMixin:
                 self._start_d2h(out, need_lp)
             return out
 
+        await self._pace()
         t0 = time.perf_counter()
         async with self._device_lock:
             # Publish INSIDE the device lock: broadcast order must equal
@@ -323,6 +324,16 @@ class DecodePipelineMixin:
         if pending_rows:
             self._stash_fetch("first", out, need_lp, pending_rows)
 
+    async def _pace(self) -> None:
+        """Await the injectable test pace hook (engine.py pace_hook)
+        before a device op.  Always called OUTSIDE ``_device_lock``: the
+        hook is allowed to BLOCK (tests/test_migration.py gates decode on
+        a per-copy-round budget), and the KV copy/export plane needs the
+        device lock to make the progress that un-blocks it — pacing under
+        the lock would deadlock that interlock."""
+        if self.pace_hook is not None:
+            await self.pace_hook()
+
     async def _await_device(self, task, kind: str, rows: int):
         """Await a device-op task (token fetch OR dispatch) under the
         decode-stall watchdog.
@@ -340,10 +351,6 @@ class DecodePipelineMixin:
         dispatch-order invariants).  Dispatch awaits are covered too: a
         wedge can just as well surface one await earlier, blocking the
         ``to_thread(run)`` handoff with no fetch outstanding."""
-        if self.pace_hook is not None:
-            # Injectable test pace (engine.py): deterministic decode
-            # throttling without wall-clock sleeps in the tests themselves.
-            await self.pace_hook()
         thr = self._stall_threshold_s
         if thr <= 0:
             return await task
@@ -409,6 +416,7 @@ class DecodePipelineMixin:
             entry = self._pending_fetches.pop(0)
             kind, task = entry[0], entry[1]
 
+            await self._pace()
             t0 = time.perf_counter()
             sampled, logp, top_ids, top_lp = await self._await_device(
                 task, f"{kind}_fetch", len(entry[2])
@@ -810,6 +818,7 @@ class DecodePipelineMixin:
                 )
                 return outs, (last, steps_f, counts_f)
 
+            await self._pace()
             t0 = time.perf_counter()
             async with self._device_lock:
                 # Broadcast order must equal device enqueue order (see
@@ -897,6 +906,7 @@ class DecodePipelineMixin:
                 progressed = True
 
             if fetch_task is not None:
+                await self._pace()
                 sampled, logp, top_ids, top_lp = await self._await_device(
                     fetch_task, "decode_wait", slots.num_active
                 )
@@ -1034,6 +1044,7 @@ class DecodePipelineMixin:
             self._start_d2h(outs, need_lp)
             return outs, (last, steps_f, counts_f)
 
+        await self._pace()
         t0 = time.perf_counter()
         async with self._device_lock:
             if self._publisher is not None:
@@ -1067,6 +1078,7 @@ class DecodePipelineMixin:
             self._start_d2h(outs, need_lp)
             return outs
 
+        await self._pace()
         t0 = time.perf_counter()
         async with self._device_lock:
             if self._publisher is not None:

@@ -288,6 +288,7 @@ class HttpService:
             kv_integrity_metrics,
             kv_tier_metrics,
             migration_metrics,
+            objstore_metrics,
             spec_metrics,
             tenancy_metrics,
         )
@@ -308,6 +309,7 @@ class HttpService:
             + engine_dispatch_metrics.render(self._metrics_prefix).encode()
             + kv_tier_metrics.render(self._metrics_prefix).encode()
             + kv_integrity_metrics.render(self._metrics_prefix).encode()
+            + objstore_metrics.render(self._metrics_prefix).encode()
             + bulk_metrics.render(self._metrics_prefix).encode()
             + shard_metrics.render(self._metrics_prefix).encode()
         )

@@ -38,6 +38,7 @@ from .router import (  # noqa: F401
     KvPushRouter,
     KvRouter,
     KvRouterCore,
+    PlannerDirectiveWatcher,
     make_kv_router,
 )
 from .scheduler import (  # noqa: F401

@@ -43,12 +43,17 @@ WorkerId = int
 
 # Restore-cost discount per tier: one block's contribution to the
 # discounted overlap score.  HBM is free (the block is live), host costs
-# one scatter, disk costs a file read + promotion + scatter.  Unknown tier
-# names (forward compat) score like disk — matchable but expensive.
+# one scatter, disk costs a file read + promotion + scatter, the durable
+# object store costs a multipart object read on top of that.  Unknown
+# tier names (forward compat) score like disk — matchable but expensive.
+# These are the COLD-START weights: once the autopilot's measured-latency
+# policy has real per-hop restore percentiles it overrides them live via
+# ``RadixIndex.set_tier_weights`` (docs/autopilot.md).
 DEFAULT_TIER_WEIGHTS: Dict[str, float] = {
     "hbm": 1.0,
     "host": 0.75,
     "disk": 0.45,
+    "objstore": 0.25,
 }
 
 
@@ -105,6 +110,12 @@ class RadixIndex:
 
     def _weight(self, tier: str) -> float:
         return self.tier_weights.get(tier, self.tier_weights.get("disk", 0.45))
+
+    def set_tier_weights(self, weights: Mapping[str, float]) -> None:
+        """Live retune from the autopilot's measured-latency routing policy
+        (``set_tier_weights`` directives): replaces the static cold-start
+        table wholesale.  Takes effect on the next ``find_matches``."""
+        self.tier_weights = dict(weights)
 
     def add_block(
         self,
@@ -221,6 +232,9 @@ class KvIndexer:
     def find_matches_for_hashes(self, seq_hashes: Sequence[int]) -> OverlapScores:
         return self._index.find_matches(seq_hashes)
 
+    def set_tier_weights(self, weights: Mapping[str, float]) -> None:
+        self._index.set_tier_weights(weights)
+
     def __len__(self) -> int:
         return len(self._index)
 
@@ -266,6 +280,10 @@ class KvIndexerSharded:
     def remove_worker(self, worker: WorkerId) -> None:
         for shard in self._shards:
             shard.remove_worker(worker)
+
+    def set_tier_weights(self, weights: Mapping[str, float]) -> None:
+        for shard in self._shards:
+            shard.set_tier_weights(weights)
 
     def find_matches(
         self, token_ids: Sequence[int], salt: Optional[str] = None

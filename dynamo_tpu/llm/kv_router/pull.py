@@ -304,12 +304,19 @@ class KvPrefetchPublisher:
         self._task = asyncio.get_running_loop().create_task(self._run())
         return self
 
-    async def publish_once(self) -> None:
-        chains = self.core.hot_chains.top(self.top_n)
+    async def publish_once(
+        self, top_n: Optional[int] = None, persist: bool = False
+    ) -> None:
+        """One push; the autopilot's warming directive calls this with
+        ``persist=True`` so workers ALSO pin the chains into the durable
+        object-store tier (engine.persist_hashes) — the next
+        scale-from-zero worker restores them instead of recomputing."""
+        chains = self.core.hot_chains.top(self.top_n if top_n is None else top_n)
         if chains:
-            await self.core.component.publish(
-                KV_PREFETCH_TOPIC, {"chains": chains}
-            )
+            msg: dict = {"chains": chains}
+            if persist:
+                msg["persist"] = True
+            await self.core.component.publish(KV_PREFETCH_TOPIC, msg)
 
     async def _run(self) -> None:
         while True:
@@ -361,11 +368,13 @@ class KvPrefetchConsumer:
                 )
                 if not chains:
                     continue
+                persist = bool(payload.get("persist"))
                 for chain in chains:
+                    hashes = [int(h) for h in chain]
                     try:
-                        await self.engine.prefetch_hashes(
-                            [int(h) for h in chain]
-                        )
+                        await self.engine.prefetch_hashes(hashes)
+                        if persist and hasattr(self.engine, "persist_hashes"):
+                            await self.engine.persist_hashes(hashes)
                     except asyncio.CancelledError:
                         raise
                     except Exception:  # noqa: BLE001 — best-effort warmup

@@ -545,6 +545,19 @@ class EngineDispatchMetrics:
     def reset(self) -> None:
         self.__init__()
 
+    def host_gap_frac(self) -> Optional[float]:
+        """The colocated engine's fused-decode host-gap fraction, or None
+        without a wired source (remote-engine edge) — the planner-side
+        drift signal (EdgeSloPublisher ``host_gap``)."""
+        if self._source is None:
+            return None
+        try:
+            s = self._source()
+        except Exception:  # noqa: BLE001 — engine mid-teardown
+            return None
+        gap = (s.get("pipeline") or {}).get("host_gap_frac")
+        return float(gap) if isinstance(gap, (int, float)) else None
+
     def render(self, prefix: str = "dynamo_tpu") -> str:
         if self._source is None:
             return ""
@@ -725,7 +738,7 @@ class KvTierMetrics:
             lines.append(f"{ns}_{name} {value}")
 
         summary = self.tier_summary()
-        tiers = [t for t in ("hbm", "host", "disk") if t in summary]
+        tiers = [t for t in ("hbm", "host", "disk", "objstore") if t in summary]
         if tiers:
             lines.append(f"# HELP {ns}_blocks Sealed KV blocks per tier")
             lines.append(f"# TYPE {ns}_blocks gauge")
@@ -791,8 +804,9 @@ kv_tier_metrics = KvTierMetrics()
 # ``disk`` = .kvblk envelope reads, ``host`` = host-tier entries verified
 # before the HBM scatter (plus demotion-time re-verification), ``wire`` =
 # transfer-plane payloads (cross-worker pull, migration push, disagg
-# import) verified before sealing.
-INTEGRITY_PLANES = ("disk", "host", "wire")
+# import) verified before sealing, ``objstore`` = durable-object envelope
+# reads (engine/object_store.py).
+INTEGRITY_PLANES = ("disk", "host", "wire", "objstore")
 
 
 class KvIntegrityMetrics:
@@ -928,6 +942,62 @@ class BulkMetrics:
 
 
 bulk_metrics = BulkMetrics()
+
+
+class ObjstoreMetrics:
+    """Durable object-store tier counters (engine/object_store.py): put/get
+    traffic in blocks and bytes plus byte-budgeted GC evictions.  Module-level
+    singleton rendered as Prometheus text and appended to ``/metrics``."""
+
+    def __init__(self):
+        self.puts_total = 0
+        self.put_bytes_total = 0
+        self.gets_total = 0
+        self.get_bytes_total = 0
+        # objects evicted by the byte-budgeted GC (coldest-first); corrupt
+        # drops are counted on the integrity plane, not here
+        self.gc_evictions_total = 0
+
+    def reset(self) -> None:
+        self.__init__()
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "puts_total": float(self.puts_total),
+            "put_bytes_total": float(self.put_bytes_total),
+            "gets_total": float(self.gets_total),
+            "get_bytes_total": float(self.get_bytes_total),
+            "gc_evictions_total": float(self.gc_evictions_total),
+        }
+
+    def render(self, prefix: str = "dynamo_tpu") -> str:
+        ns = f"{prefix}_objstore"
+        lines = []
+
+        def emit(name: str, help_: str, value: int) -> None:
+            lines.append(f"# HELP {ns}_{name} {help_}")
+            lines.append(f"# TYPE {ns}_{name} counter")
+            lines.append(f"{ns}_{name} {value}")
+
+        emit("puts_total",
+             "Objects published to the durable store (demotions + explicit "
+             "persists)", self.puts_total)
+        emit("put_bytes_total",
+             "Envelope bytes published to the durable store",
+             self.put_bytes_total)
+        emit("gets_total",
+             "Objects read back from the durable store (restores + "
+             "promotions)", self.gets_total)
+        emit("get_bytes_total",
+             "Envelope bytes read back from the durable store",
+             self.get_bytes_total)
+        emit("gc_evictions_total",
+             "Objects evicted by the byte-budgeted GC (coldest-first)",
+             self.gc_evictions_total)
+        return "\n".join(lines) + "\n"
+
+
+objstore_metrics = ObjstoreMetrics()
 
 
 class InflightGuard:

@@ -32,7 +32,15 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from typing import Any, AsyncIterator, Dict, List, Optional
+from typing import (
+    Any,
+    AsyncIterator,
+    Awaitable,
+    Callable,
+    Dict,
+    List,
+    Optional,
+)
 
 from ...runtime.client import Client
 from ...runtime.engine import AsyncEngine, Context, ResponseStream
@@ -81,6 +89,17 @@ class MigratableWorker(AsyncEngine):
         # CLI wires a BulkRendezvous here, phase-1 copy payloads move
         # worker↔worker instead of through the hub; None = hub path only.
         self.bulk = None
+        # Injectable copy-round barrier: awaited once after every phase-1
+        # copy round as ``hook(cursor, final=False)`` and once more —
+        # ``hook(cursor, final=True)`` — after the loop breaks, immediately
+        # before the freeze (no suspension point between the final call
+        # returning and ``frozen`` being set, so a gate released on
+        # ``final`` cannot lose a plan-new-chunks race).  Tests pair it
+        # with engine.pace_hook to make the copy-vs-decode race
+        # count-bounded instead of wall-clock raced; None = zero cost.
+        self.copy_round_hook: Optional[
+            Callable[[int, bool], Awaitable[None]]
+        ] = None
         # Accept-time capability gate: a draining worker flips this False
         # BEFORE starting its own migrate-out (cli WorkerRoles.stop_decode),
         # closing the de-advertise propagation race — a peer whose hub
@@ -238,6 +257,12 @@ class MigratableWorker(AsyncEngine):
                 cspan.set(aborted=True).finish()
                 return False
             cursor += shipped
+            if self.copy_round_hook is not None:
+                # Copy-round barrier (tests): one refill of the gated
+                # decode budget per completed round — the race becomes
+                # count-bounded (decode advances at most N paced ops per
+                # shipped round) instead of wall-clock raced.
+                await self.copy_round_hook(cursor, False)
             remaining = len(tokens) // bs - cursor
             if remaining <= self.delta_blocks or shipped == 0:
                 # shipped == 0 with blocks still remaining means nothing is
@@ -247,6 +272,14 @@ class MigratableWorker(AsyncEngine):
                 # ordinary prefix miss.
                 break
             await asyncio.sleep(0)  # let decode advance between rounds
+        if self.copy_round_hook is not None:
+            # final=True: the copy race is decided; the gate must stop
+            # PARKING decode before the freeze below — quiescence needs
+            # the decode loop to harvest in-flight fetches and retire the
+            # row's fused-session membership.  No await sits between this
+            # call returning and freeze_sequence setting ``frozen``, so
+            # the un-parked loop cannot plan new chunks for the row first.
+            await self.copy_round_hook(cursor, True)
         cspan.set(blocks=cursor).finish()
         # -- phase 2: freeze + final delta + commit ----------------------
         fspan = trace_span(tc, "migrate.cutover", "migration")

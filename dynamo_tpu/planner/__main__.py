@@ -1,7 +1,7 @@
 """``python -m dynamo_tpu.planner`` — run the planner or its simulator.
 
   planner run --hub H:P [--namespace dynamo] [--component TpuWorker]
-              [--model NAME] [--interval 2.0] [--dry-run]
+              [--model NAME] [--interval 2.0] [--dry-run] [--autopilot]
               [--kube CR_NAME [--k8s-namespace default]] [--port 9092]
   planner sim [--trace poisson|burst|ramp | --trace-file F.jsonl]
               [--rate 2.0] [--duration 120] [--seed 7] [--dry-run]
@@ -24,7 +24,15 @@ import sys
 from typing import Optional
 
 from .policy import DecisionEngine, PolicyConfig, SloTargets
-from .sim import SimConfig, gen_trace, read_trace, run_sim, smoke, write_trace
+from .sim import (
+    SimConfig,
+    autopilot_smoke,
+    gen_trace,
+    read_trace,
+    run_sim,
+    smoke,
+    write_trace,
+)
 
 
 def _engine_from_config(args) -> DecisionEngine:
@@ -59,9 +67,14 @@ async def _run(args) -> None:
         )
     else:
         actuator = LocalActuator(runtime.hub)
+    engine = _engine_from_config(args)
+    if args.autopilot:
+        from .autopilot import Autopilot
+
+        engine = Autopilot(engine, worker_view=collector.worker_slo_view)
     planner = await Planner(
         collector,
-        _engine_from_config(args),
+        engine,
         actuator,
         interval_s=args.interval,
         dry_run=args.dry_run,
@@ -134,7 +147,9 @@ def _sim(args) -> int:
     if args.smoke:
         ok, summary = smoke(verbose=args.verbose)
         print(summary, flush=True)
-        return 0 if ok else 1
+        ap_ok, ap_summary = autopilot_smoke(verbose=args.verbose)
+        print(ap_summary, flush=True)
+        return 0 if ok and ap_ok else 1
     if args.trace_file:
         trace = read_trace(args.trace_file)
     else:
@@ -206,6 +221,10 @@ def main(argv: Optional[list] = None) -> int:
                        dest="k8s_namespace")
     p_run.add_argument("--host", default="0.0.0.0")
     p_run.add_argument("--port", type=int, default=9092)
+    p_run.add_argument("--autopilot", action="store_true",
+                       help="wrap the engine in the SLO autopilot "
+                       "(warming / measured routing / victim / retune "
+                       "policies; docs/autopilot.md)")
     _add_slo_flags(p_run)
 
     p_sim = sub.add_parser("sim", help="deterministic policy simulator")

@@ -35,6 +35,7 @@ logger = logging.getLogger(__name__)
 
 ROLE_PREFIX = hub_prefix("planner", "roles")
 TARGET_PREFIX = hub_prefix("planner", "targets")
+DIRECTIVE_PREFIX = hub_prefix("planner", "directives")
 CR_KIND = "DynamoTpuDeployment"
 
 
@@ -46,6 +47,14 @@ def target_key(pool: str) -> str:
 def role_key(worker_id: int) -> str:
     """Per-worker role-flip key (shard-map routed: DYN401)."""
     return hub_key("planner", "roles", worker_id)
+
+
+def directive_key(kind: str) -> str:
+    """Autopilot directive slot, one per directive kind — last-writer-wins
+    (the autopilot's per-policy cooldowns guarantee a consumer sees each
+    directive for many ticks before it can be overwritten).  Shard-map
+    routed: DYN401."""
+    return hub_key("planner", "directives", kind)
 
 
 class Actuator:
@@ -188,6 +197,26 @@ class LocalActuator(Actuator):
                         "reason": action.reason,
                     },
                 )
+            elif action.kind in (
+                "kv_prefetch",
+                "set_tier_weights",
+                "migrate_out",
+                "tune_decode",
+            ):
+                # Autopilot directives (planner/autopilot.py).  The
+                # router's PlannerDirectiveWatcher enacts kv_prefetch and
+                # set_tier_weights; migrate_out names a victim for the
+                # supervisor/operator; tune_decode is a sweep
+                # recommendation (also on the planner's /state surface).
+                body: Dict[str, Any] = {
+                    "kind": action.kind,
+                    "tick": decision.tick,
+                    "reason": action.reason,
+                    "params": dict(action.params or {}),
+                }
+                if action.worker_id is not None:
+                    body["worker_id"] = action.worker_id
+                await self.hub.kv_put(directive_key(action.kind), body)
 
 
 class RoleFlipWatcher:

@@ -84,3 +84,65 @@ class PlannerMetrics:
 
 
 metrics = PlannerMetrics()
+
+
+class AutopilotMetrics:
+    """Autopilot policy observability (planner/autopilot.py): per-policy
+    decision/suppression/cooldown-skip counters — same module-singleton
+    pattern as ``PlannerMetrics``, rendered as ``dynamo_tpu_autopilot_*``
+    and appended to the planner's ``/metrics``."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        # policy name → count; policies register lazily on first event so
+        # the label set stays exactly the autopilot's policy catalog.
+        self.decisions_total: Dict[str, int] = {}
+        self.suppressions_total: Dict[str, int] = {}
+        self.cooldown_skips_total: Dict[str, int] = {}
+
+    def record_decision(self, policy: str) -> None:
+        self.decisions_total[policy] = self.decisions_total.get(policy, 0) + 1
+
+    def record_suppression(self, policy: str) -> None:
+        self.suppressions_total[policy] = (
+            self.suppressions_total.get(policy, 0) + 1
+        )
+
+    def record_cooldown_skip(self, policy: str) -> None:
+        self.cooldown_skips_total[policy] = (
+            self.cooldown_skips_total.get(policy, 0) + 1
+        )
+
+    def render(self, prefix: str = "dynamo_tpu") -> str:
+        ns = f"{prefix}_autopilot"
+        lines = []
+
+        def emit(name: str, help_: str, values: Dict[str, int]) -> None:
+            lines.append(f"# HELP {ns}_{name} {help_}")
+            lines.append(f"# TYPE {ns}_{name} counter")
+            for policy, n in sorted(values.items()):
+                lines.append(
+                    f'{ns}_{name}{{policy="{escape_label(policy)}"}} {n}'
+                )
+
+        emit("decisions_total",
+             "Autopilot actions emitted, by policy", self.decisions_total)
+        emit("suppressions_total",
+             "Engine actions deferred/suppressed by a policy (e.g. decode "
+             "scale-up held during prefix warming)", self.suppressions_total)
+        emit("cooldown_skips_total",
+             "Confirmed policy triggers skipped because the policy was "
+             "cooling down", self.cooldown_skips_total)
+        return "\n".join(lines) + "\n"
+
+    def state(self) -> Dict[str, Any]:
+        return {
+            "decisions": dict(self.decisions_total),
+            "suppressions": dict(self.suppressions_total),
+            "cooldown_skips": dict(self.cooldown_skips_total),
+        }
+
+
+autopilot_metrics = AutopilotMetrics()

@@ -89,11 +89,17 @@ class PolicyConfig:
 @dataclass(frozen=True)
 class Action:
     kind: str  # scale_prefill | scale_decode | flip_role | noop
+    # ... plus the autopilot kinds (planner/autopilot.py): kv_prefetch |
+    # set_tier_weights | migrate_out | tune_decode
     pool: str = ""
     delta: int = 0
     target: int = 0
-    worker_id: Optional[int] = None  # flip_role only
+    worker_id: Optional[int] = None  # flip_role / migrate_out
     reason: str = ""
+    # Kind-specific payload for the autopilot kinds (warming top-N, the
+    # measured tier-weight table, the retune sweep recommendation) —
+    # omitted from the wire when absent, like every optional wire field.
+    params: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d = {"kind": self.kind, "reason": self.reason}
@@ -101,6 +107,10 @@ class Action:
             d.update(pool=self.pool, delta=self.delta, target=self.target)
         if self.kind == "flip_role":
             d.update(worker_id=self.worker_id, to_pool=self.pool)
+        if self.kind == "migrate_out":
+            d.update(worker_id=self.worker_id)
+        if self.params is not None:
+            d["params"] = dict(self.params)
         return d
 
 

@@ -20,6 +20,7 @@ from typing import List, Optional
 from aiohttp import web
 
 from .actuate import Actuator
+from .pmetrics import autopilot_metrics
 from .pmetrics import metrics as planner_metrics
 from .policy import Decision, DecisionEngine
 from .signals import SignalCollector
@@ -28,7 +29,11 @@ logger = logging.getLogger(__name__)
 
 
 class Planner:
-    """Tick loop: snapshot → decide → (maybe) actuate."""
+    """Tick loop: snapshot → decide → (maybe) actuate.
+
+    ``engine`` is anything with ``decide(snapshot) -> Decision`` and
+    ``state() -> dict`` — a bare ``DecisionEngine`` or an ``Autopilot``
+    (planner/autopilot.py) wrapping one."""
 
     def __init__(
         self,
@@ -130,7 +135,8 @@ class PlannerHttp:
 
     async def _metrics(self, request: web.Request) -> web.Response:
         return web.Response(
-            text=planner_metrics.render(), content_type="text/plain"
+            text=planner_metrics.render() + autopilot_metrics.render(),
+            content_type="text/plain",
         )
 
     async def _state(self, request: web.Request) -> web.Response:

@@ -165,9 +165,92 @@ class SignalSnapshot:
     # tier gauges.  A sagging fleet hit rate with tiered capacity free is
     # the planner's cue to warm prefixes (kv_prefetch) before scaling.
     fleet_prefix_hit_rate: Optional[float] = None
+    # Measured per-hop restore/pull percentiles (ms) from the colocated
+    # engine's kv_tier windows, worst-merged across live edges — keys like
+    # ``restore_p95_ms``/``pull_p95_ms``.  The autopilot's measured-latency
+    # routing EWMAs these into live tier weights (docs/autopilot.md);
+    # None until an edge has observed at least one restore.
+    restore_pct: Optional[Dict[str, float]] = None
+    # Fused-decode host-gap fraction (engine dispatch_summary
+    # ``host_gap_frac``), worst-merged across edges: sustained drift out
+    # of band is the autopilot's tune_decode trigger.  None when no edge
+    # colocates an engine.
+    host_gap: Optional[float] = None
 
     def pool(self, name: str) -> PoolStats:
         return self.pools.get(name) or PoolStats()
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Wire form (dry-run transcripts, /state, replay fixtures).
+        Optional signals are omitted when absent — consumers must d.get()
+        them (the established omit-when-absent idiom)."""
+        d: Dict[str, Any] = {
+            "t": self.t,
+            "pools": {
+                name: {
+                    "workers": list(p.workers),
+                    "queue_depth": p.queue_depth,
+                    "active_slots": p.active_slots,
+                    "total_slots": p.total_slots,
+                    "kv_usage": p.kv_usage,
+                    "per_worker_load": {
+                        str(w): v for w, v in p.per_worker_load.items()
+                    },
+                }
+                for name, p in self.pools.items()
+            },
+            "prefill_queue_depth": self.prefill_queue_depth,
+            "hit_isl_blocks": self.hit_isl_blocks,
+            "hit_overlap_blocks": self.hit_overlap_blocks,
+            "edge_brownout_rung": self.edge_brownout_rung,
+        }
+        if self.ttft_p95_ms is not None:
+            d["ttft_p95_ms"] = self.ttft_p95_ms
+        if self.itl_p95_ms is not None:
+            d["itl_p95_ms"] = self.itl_p95_ms
+        if self.ttft_p50_ms is not None:
+            d["ttft_p50_ms"] = self.ttft_p50_ms
+        if self.itl_p50_ms is not None:
+            d["itl_p50_ms"] = self.itl_p50_ms
+        if self.fleet_prefix_hit_rate is not None:
+            d["fleet_prefix_hit_rate"] = self.fleet_prefix_hit_rate
+        if self.restore_pct is not None:
+            d["restore_pct"] = dict(self.restore_pct)
+        if self.host_gap is not None:
+            d["host_gap"] = self.host_gap
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SignalSnapshot":
+        pools = {
+            name: PoolStats(
+                workers=tuple(p.get("workers", ())),
+                queue_depth=int(p.get("queue_depth", 0)),
+                active_slots=int(p.get("active_slots", 0)),
+                total_slots=int(p.get("total_slots", 0)),
+                kv_usage=float(p.get("kv_usage", 0.0)),
+                per_worker_load={
+                    int(w): float(v)
+                    for w, v in (p.get("per_worker_load") or {}).items()
+                },
+            )
+            for name, p in (d.get("pools") or {}).items()
+        }
+        return cls(
+            t=float(d.get("t", 0.0)),
+            pools=pools,
+            ttft_p95_ms=d.get("ttft_p95_ms"),
+            itl_p95_ms=d.get("itl_p95_ms"),
+            ttft_p50_ms=d.get("ttft_p50_ms"),
+            itl_p50_ms=d.get("itl_p50_ms"),
+            prefill_queue_depth=int(d.get("prefill_queue_depth", 0)),
+            hit_isl_blocks=int(d.get("hit_isl_blocks", 0)),
+            hit_overlap_blocks=int(d.get("hit_overlap_blocks", 0)),
+            edge_brownout_rung=int(d.get("edge_brownout_rung", 0)),
+            fleet_prefix_hit_rate=d.get("fleet_prefix_hit_rate"),
+            restore_pct=d.get("restore_pct"),
+            host_gap=d.get("host_gap"),
+        )
 
 
 def pool_stats(per_worker: Dict[int, ForwardPassMetrics]) -> PoolStats:
@@ -521,7 +604,23 @@ class SignalCollector:
                 self._edge_percentile("brownout_rung") or 0
             ),
             fleet_prefix_hit_rate=self._edge_mean("prefix_hit_rate"),
+            restore_pct=self._edge_restore_pct(),
+            host_gap=self._edge_percentile("host_gap"),
         )
+
+    def _edge_restore_pct(self) -> Optional[Dict[str, float]]:
+        """Worst-merge (per key) the edges' measured restore/pull
+        percentile dicts — the conservative read, matching the latency
+        percentile merge above.  None until some edge publishes one."""
+        merged: Dict[str, float] = {}
+        for e in self._edges.values():
+            pct = e.get("restore_pct")
+            if not isinstance(pct, dict):
+                continue
+            for k, v in pct.items():
+                if isinstance(v, (int, float)):
+                    merged[k] = max(merged.get(k, float("-inf")), float(v))
+        return merged or None
 
 
 class EdgeSloPublisher:
@@ -569,8 +668,29 @@ class EdgeSloPublisher:
         if tier:
             snap["prefix_hit_rate"] = float(tier.get("prefix_hit_rate", 0.0))
             snap["kv_tier"] = {
-                t: dict(tier[t]) for t in ("hbm", "host", "disk") if t in tier
+                t: dict(tier[t])
+                for t in ("hbm", "host", "disk", "objstore")
+                if t in tier
             }
+        # Measured restore/pull percentiles + fused-decode host gap ride
+        # the same publication (the autopilot's measured-latency routing
+        # and tune_decode inputs) — omitted when nothing was measured, per
+        # the wire idiom.
+        restore_pct: Dict[str, float] = {}
+        for name, window in (
+            ("restore", kv_tier_metrics.restore_latency_ms),
+            ("pull", kv_tier_metrics.pull_latency_ms),
+        ):
+            if len(window):
+                restore_pct[f"{name}_p50_ms"] = round(window.percentile(0.5), 3)
+                restore_pct[f"{name}_p95_ms"] = round(window.percentile(0.95), 3)
+        if restore_pct:
+            snap["restore_pct"] = restore_pct
+        from ..llm.metrics import engine_dispatch_metrics
+
+        gap = engine_dispatch_metrics.host_gap_frac()
+        if gap is not None:
+            snap["host_gap"] = gap
         # Per-worker TTFT/ITL p50s observed by this edge's routed clients
         # (runtime/health.py): the planner-side watchdog's straggler feed.
         workers = worker_latency.snapshot()

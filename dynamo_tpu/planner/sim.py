@@ -141,6 +141,23 @@ class SimConfig:
     window_ticks: int = 10
     n_prefill: int = 1
     n_decode: int = 1
+    # ---- optional prefix-population model (None disables — the default
+    # keeps every pre-autopilot scenario byte-identical).  When enabled,
+    # the fleet prefix-cache hit rate is a first-class state variable:
+    # prefill work per request is isl x (1 - hit at admission), and each
+    # decoding request's KV residency shrinks by its hit (the shared hot
+    # base is counted once as ``hot_prefix_tokens``).  A hot-prefix SURGE
+    # (a new population arriving at ``surge_start_s``) drops the hit rate
+    # to ``surge_hit_rate``; it then recovers by ``natural_ramp_per_tick``
+    # (caches refill from misses) — or by ``warm_ramp_per_tick`` once a
+    # ``kv_prefetch`` warming directive lands (after ``warm_lag_ticks``).
+    base_hit_rate: Optional[float] = None
+    surge_hit_rate: float = 0.1
+    surge_start_s: Optional[float] = None
+    natural_ramp_per_tick: float = 0.01
+    warm_ramp_per_tick: float = 0.15
+    warm_lag_ticks: int = 2
+    hot_prefix_tokens: int = 6000
 
 
 @dataclass
@@ -151,9 +168,12 @@ class _Req:
     prefill_left: float = 0.0
     decoded: int = 0
     ttft_s: Optional[float] = None
+    # Prefix-cache hit fraction at admission (prefix model only): scales
+    # both the prefill work and the request's private KV residency.
+    hit: float = 0.0
 
     def __post_init__(self):
-        self.prefill_left = float(self.isl)
+        self.prefill_left = float(self.isl) * (1.0 - self.hit)
 
 
 class SimCluster:
@@ -176,6 +196,10 @@ class SimCluster:
         self._ttft_samples: List[Tuple[int, float]] = []
         self._itl_samples: List[Tuple[int, float]] = []
         self._last_itl_ms = 0.0
+        # prefix model state (inert when base_hit_rate is None)
+        self.hit_rate: Optional[float] = cfg.base_hit_rate
+        self._surged = False
+        self._warm_at: Optional[int] = None  # tick a warming directive lands
 
     # -- capacity mutation (what actuation means in the sim) ---------------
 
@@ -194,6 +218,11 @@ class SimCluster:
         for action in decision.actions:
             if action.kind in ("scale_prefill", "scale_decode"):
                 self.schedule_scale(action.pool, action.target)
+            elif action.kind == "kv_prefetch" and self.hit_rate is not None:
+                # Warming directive: the promoted chains start landing
+                # after a short lag, then the hit rate ramps fast.
+                if self._warm_at is None:
+                    self._warm_at = self.tick + self.cfg.warm_lag_ticks
             elif action.kind == "flip_role":
                 donor = DECODE if action.pool == PREFILL else PREFILL
                 donor_n = self.n_prefill if donor == PREFILL else self.n_decode
@@ -217,13 +246,33 @@ class SimCluster:
         self.tick += 1
         self.now += cfg.tick_s
         self._apply_pending()
+        # prefix-population dynamics (inert without the model)
+        if self.hit_rate is not None:
+            if (
+                not self._surged
+                and cfg.surge_start_s is not None
+                and self.now >= cfg.surge_start_s
+            ):
+                # a NEW hot-prefix population arrives: caches run cold
+                self.hit_rate = cfg.surge_hit_rate
+                self._surged = True
+            elif self.hit_rate < (cfg.base_hit_rate or 0.0):
+                warmed = self._warm_at is not None and self.tick >= self._warm_at
+                ramp = (
+                    cfg.warm_ramp_per_tick
+                    if warmed
+                    else cfg.natural_ramp_per_tick
+                )
+                self.hit_rate = min(cfg.base_hit_rate, self.hit_rate + ramp)
         # arrivals up to now
         while (
             self._next_arrival < len(self.trace)
             and self.trace[self._next_arrival].t <= self.now
         ):
             a = self.trace[self._next_arrival]
-            self.prefill_q.append(_Req(a.t, a.isl, a.osl))
+            self.prefill_q.append(
+                _Req(a.t, a.isl, a.osl, hit=self.hit_rate or 0.0)
+            )
             self._next_arrival += 1
         # prefill: pooled token throughput, FIFO
         budget = self.n_prefill * cfg.prefill_tokens_per_s * cfg.tick_s
@@ -269,7 +318,14 @@ class SimCluster:
     def snapshot(self) -> SignalSnapshot:
         cfg = self.cfg
         kv_cap = max(1, self.n_decode * cfg.kv_tokens_per_worker)
-        kv_used = sum(r.isl + r.decoded for r in self.decoding)
+        if self.hit_rate is None:
+            kv_used = sum(r.isl + r.decoded for r in self.decoding)
+        else:
+            # Prefix model: each request's PRIVATE residency is the part
+            # it computed itself; the shared hot base is counted once.
+            kv_used = cfg.hot_prefix_tokens + sum(
+                r.isl * (1.0 - r.hit) + r.decoded for r in self.decoding
+            )
         slots = self.n_decode * cfg.decode_slots
         ttfts = [v for _, v in self._ttft_samples]
         itls = [v for _, v in self._itl_samples]
@@ -302,6 +358,9 @@ class SimCluster:
             itl_p95_ms=_pct(itls, 0.95) if itls else None,
             itl_p50_ms=_pct(itls, 0.5) if itls else None,
             prefill_queue_depth=len(self.prefill_q),
+            fleet_prefix_hit_rate=(
+                round(self.hit_rate, 4) if self.hit_rate is not None else None
+            ),
         )
 
 
@@ -468,6 +527,90 @@ def smoke(verbose: bool = False) -> Tuple[bool, str]:
     if failures:
         return False, summary + "; FAILED: " + "; ".join(failures)
     return True, summary
+
+
+def autopilot_smoke(verbose: bool = False) -> Tuple[bool, str]:
+    """The autopilot acceptance scenario (docs/autopilot.md): a seeded
+    hot-prefix SURGE (a new prefix population at t=40s runs the fleet's
+    caches cold) must trigger the warming policy — which restores TTFT p95
+    while spending at least one FEWER decode scale-up than the
+    pressure-only control engine — with zero flip-flops, and the decision
+    stream must be deterministic across replays and identical in dry-run."""
+    from .autopilot import Autopilot, AutopilotConfig
+    from .policy import PolicyConfig, SloTargets
+
+    # Steady 4 req/s x 2000-token prompts at 80% prefix hit = 1600 tok/s
+    # of real prefill (a quarter of one worker).  The surge quadruples the
+    # effective prefill AND inflates per-request decode KV residency 4.5x
+    # — the pressure-only control reads that as "decode pool too small"
+    # and buys replicas; the autopilot warms the prefixes instead.
+    trace = gen_trace(
+        "poisson", rate=4.0, duration_s=120.0, seed=11, isl=2000, osl=60
+    )
+    slo = SloTargets(ttft_p95_ms=2500.0, itl_p95_ms=200.0)
+    cfg = PolicyConfig(
+        max_prefill=6, max_decode=6, confirm_down_ticks=8,
+        queue_high_per_worker=8.0,
+    )
+    sim_cfg = SimConfig(
+        n_prefill=1, n_decode=2, kv_tokens_per_worker=12_000,
+        base_hit_rate=0.8, surge_start_s=40.0,
+    )
+
+    def pilot() -> Autopilot:
+        return Autopilot(DecisionEngine(slo, cfg), AutopilotConfig())
+
+    control = run_sim(trace, DecisionEngine(slo, cfg), sim_cfg)
+    live = run_sim(trace, pilot(), sim_cfg)
+    replay = run_sim(trace, pilot(), sim_cfg)
+    dry = run_sim(trace, pilot(), sim_cfg, dry_run=True)
+
+    control_ups = [a for a in control.scale_actions(DECODE) if a.delta > 0]
+    live_ups = [a for a in live.scale_actions(DECODE) if a.delta > 0]
+    warmed = any(
+        a.kind == "kv_prefetch" for d in live.decisions for a in d.actions
+    )
+    # Last windows with traffic still in them — the trailing drain ticks
+    # report no TTFT at all, so index by observation rather than by tick.
+    observed = [
+        r["ttft_p95_ms"] for r in live.ticks if r["ttft_p95_ms"] is not None
+    ]
+    tail = observed[-10:]
+    checks = [
+        (warmed, "autopilot never issued a warming directive"),
+        (
+            bool(control_ups),
+            "control never scaled decode (scenario exerts no pressure)",
+        ),
+        (
+            len(control_ups) >= len(live_ups) + 1,
+            f"warming saved no decode scale-up "
+            f"(control={len(control_ups)}, autopilot={len(live_ups)})",
+        ),
+        (live.flip_flops() == 0, "flip-flop decisions under the autopilot"),
+        (
+            bool(tail) and max(tail) < slo.ttft_p95_ms,
+            "TTFT p95 not restored under SLO after the surge",
+        ),
+        (
+            live.decision_dicts() == replay.decision_dicts(),
+            "decision stream diverged across seeded replays",
+        ),
+        (
+            live.decision_dicts() == dry.decision_dicts(),
+            "dry-run decisions diverged from live decisions",
+        ),
+        (dry.actuation_calls == 0, "dry-run issued actuation calls"),
+    ]
+    failures = [msg for ok, msg in checks if not ok]
+    summary = (
+        f"autopilot smoke: decode_ups control={len(control_ups)} "
+        f"autopilot={len(live_ups)}, warmed={warmed}, "
+        f"flip_flops={live.flip_flops()}, completed={live.completed}"
+    )
+    if failures:
+        return False, summary + "; FAILED: " + "; ".join(failures)
+    return True, summary if verbose else "autopilot smoke ok"
 
 
 def _recovered(report: SimReport, ttft_slo_ms: float) -> bool:

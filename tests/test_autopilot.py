@@ -1,0 +1,511 @@
+"""SLO autopilot (planner/autopilot.py): the four trace-informed policies
+— prefix warming before scaling, measured-latency routing, trace-identified
+migration victims, drift-triggered retune — their Llumnix damping
+(confirm streaks, cooldowns, grace windows), determinism under replay, the
+directive plane (LocalActuator → hub → PlannerDirectiveWatcher → router),
+and the SignalSnapshot wire extensions feeding them."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.planner import pmetrics
+from dynamo_tpu.planner.autopilot import (
+    DRIFT_RETUNE,
+    MEASURED_ROUTING,
+    PREFIX_WARMING,
+    VICTIM_MIGRATION,
+    Autopilot,
+    AutopilotConfig,
+)
+from dynamo_tpu.planner.policy import (
+    DECODE,
+    PREFILL,
+    DecisionEngine,
+    PolicyConfig,
+    SloTargets,
+)
+from dynamo_tpu.planner.signals import PoolStats, SignalSnapshot
+
+pytestmark = pytest.mark.planner
+
+
+@pytest.fixture(autouse=True)
+def _reset_autopilot_metrics():
+    pmetrics.autopilot_metrics.reset()
+    yield
+    pmetrics.autopilot_metrics.reset()
+
+
+def snap(
+    n_prefill=2,
+    n_decode=2,
+    itl=None,
+    ttft=None,
+    kv=0.0,
+    hit_rate=None,
+    restore_pct=None,
+    host_gap=None,
+):
+    prefill = PoolStats(
+        workers=tuple(range(n_prefill)), total_slots=n_prefill * 1000
+    )
+    decode_workers = tuple(range(100, 100 + n_decode))
+    decode = PoolStats(
+        workers=decode_workers, total_slots=n_decode * 8, kv_usage=kv
+    )
+    return SignalSnapshot(
+        pools={PREFILL: prefill, DECODE: decode},
+        ttft_p95_ms=ttft,
+        itl_p95_ms=itl,
+        fleet_prefix_hit_rate=hit_rate,
+        restore_pct=restore_pct,
+        host_gap=host_gap,
+    )
+
+
+def pilot(worker_view=None, **cfg):
+    eng = DecisionEngine(
+        SloTargets(),
+        PolicyConfig(
+            min_prefill=1, max_prefill=8, min_decode=1, max_decode=8,
+            confirm_up_ticks=2, confirm_down_ticks=3, cooldown_ticks=4,
+            queue_high_per_worker=4.0,
+        ),
+    )
+    return Autopilot(eng, AutopilotConfig(**cfg), worker_view=worker_view)
+
+
+def kinds(decision):
+    return [a.kind for a in decision.actions]
+
+
+# ------------------------------------------------------------ prefix warming
+
+
+def test_warming_confirms_then_fires_and_cools_down():
+    """A sagging fleet hit rate must persist warm_confirm_ticks before the
+    kv_prefetch directive fires; the cooldown then silences re-triggers."""
+    ap = pilot(warm_confirm_ticks=2, warm_cooldown_ticks=5)
+    cold = snap(hit_rate=0.2)
+    assert "kv_prefetch" not in kinds(ap.decide(cold)), "fired unconfirmed"
+    d = ap.decide(cold)
+    (warm,) = [a for a in d.actions if a.kind == "kv_prefetch"]
+    assert warm.params["persist"] is True
+    assert warm.params["top_n"] == AutopilotConfig().warm_top_chains
+    # still cold, but cooling down: no second directive
+    for _ in range(4):
+        assert "kv_prefetch" not in kinds(ap.decide(cold))
+    skips = pmetrics.autopilot_metrics.cooldown_skips_total
+    assert skips.get(PREFIX_WARMING, 0) > 0
+
+
+def test_warming_streak_resets_on_recovery():
+    ap = pilot(warm_confirm_ticks=2)
+    ap.decide(snap(hit_rate=0.2))
+    ap.decide(snap(hit_rate=0.9))  # recovered: streak resets
+    assert "kv_prefetch" not in kinds(ap.decide(snap(hit_rate=0.2)))
+
+
+def test_warming_grace_defers_decode_scale_up():
+    """While a warming directive is in flight, engine decode scale-UPS are
+    deferred (warming is the cheaper remedy); the deferral is counted."""
+    ap = pilot(warm_confirm_ticks=1, warm_grace_ticks=6)
+    # tick 1: warming fires (confirm=1), grace window opens
+    d1 = ap.decide(snap(itl=500.0, hit_rate=0.2))
+    assert "kv_prefetch" in kinds(d1)
+    # tick 2: the engine's own confirm streak would scale decode now
+    d2 = ap.decide(snap(itl=500.0, hit_rate=0.2))
+    assert "scale_decode" not in kinds(d2), "scale-up not deferred"
+    sup = pmetrics.autopilot_metrics.suppressions_total
+    assert sup.get(PREFIX_WARMING, 0) == 1
+    reasons = " ".join(a.reason for a in d2.actions)
+    assert "warming in flight" in reasons
+
+
+def test_warming_grace_passes_decode_scale_down_through():
+    ap = pilot(warm_confirm_ticks=1, warm_grace_ticks=10)
+    ap.decide(snap(hit_rate=0.2))  # open the grace window
+    # an idle decode pool above min scales DOWN even mid-grace
+    idle = snap(n_decode=4, hit_rate=0.2)
+    seen = set()
+    for _ in range(8):
+        seen.update(kinds(ap.decide(idle)))
+    assert "scale_decode" in seen, "scale-down was wrongly deferred"
+
+
+# ---------------------------------------------------- measured-latency routing
+
+
+def test_routing_stays_static_without_measurements():
+    ap = pilot()
+    for _ in range(5):
+        assert "set_tier_weights" not in kinds(ap.decide(snap()))
+    assert ap.state()["live_tier_weights"] is None
+
+
+def test_routing_emits_measured_weights_and_drift_gates():
+    """First measured restore p95 emits a table (host halves at
+    route_halving_ms); an unchanged latency re-emits nothing (drift gate),
+    a big move re-emits after the cooldown."""
+    ap = pilot(route_cooldown_ticks=2, route_retune_frac=0.25)
+    hot = snap(restore_pct={"restore_p95_ms": 50.0, "pull_p95_ms": 10.0})
+    d = ap.decide(hot)
+    (act,) = [a for a in d.actions if a.kind == "set_tier_weights"]
+    w = act.params["weights"]
+    assert w["hbm"] == 1.0
+    assert w["host"] == pytest.approx(0.375, abs=1e-3)  # 0.75 halved
+    # shape preserved: disk/host ratio matches the static table
+    assert w["disk"] / w["host"] == pytest.approx(0.45 / 0.75, rel=1e-3)
+    # steady latency: EWMA converges, drift stays inside the gate
+    for _ in range(6):
+        assert "set_tier_weights" not in kinds(ap.decide(hot))
+    # latency collapses: weights drift up beyond the gate and re-emit
+    cool = snap(restore_pct={"restore_p95_ms": 1.0})
+    emitted = [
+        a
+        for _ in range(12)
+        for a in ap.decide(cool).actions
+        if a.kind == "set_tier_weights"
+    ]
+    assert emitted, "large latency move never re-emitted weights"
+    assert emitted[-1].params["weights"]["host"] > 0.5
+
+
+# ------------------------------------------------------------ victim migration
+
+
+def test_victims_need_sustained_outlier_and_min_samples():
+    """migrate_out fires only for a worker whose itl p95 exceeds
+    ratio x fleet median for outlier_confirm_ticks, with enough samples."""
+    view = {
+        1: {"itl_p95_ms": 100.0, "n": 50},
+        2: {"itl_p95_ms": 110.0, "n": 50},
+        3: {"itl_p95_ms": 500.0, "n": 50},
+    }
+    ap = pilot(worker_view=lambda: view, outlier_confirm_ticks=3)
+    for _ in range(2):
+        assert "migrate_out" not in kinds(ap.decide(snap()))
+    d = ap.decide(snap())
+    (mig,) = [a for a in d.actions if a.kind == "migrate_out"]
+    assert mig.worker_id == 3
+    assert mig.params["fleet_median_ms"] == 110.0
+    # under-sampled outliers are ignored entirely
+    thin = {
+        1: {"itl_p95_ms": 100.0, "n": 50},
+        2: {"itl_p95_ms": 110.0, "n": 50},
+        3: {"itl_p95_ms": 900.0, "n": 2},
+    }
+    ap2 = pilot(worker_view=lambda: thin, outlier_confirm_ticks=1)
+    for _ in range(4):
+        assert "migrate_out" not in kinds(ap2.decide(snap()))
+
+
+def test_victims_transient_spike_never_accumulates():
+    seq = iter(
+        [
+            {1: {"itl_p95_ms": 100.0, "n": 50}, 2: {"itl_p95_ms": 500.0, "n": 50}},
+            {1: {"itl_p95_ms": 100.0, "n": 50}, 2: {"itl_p95_ms": 100.0, "n": 50}},
+        ]
+        * 4
+    )
+    ap = pilot(worker_view=lambda: next(seq), outlier_confirm_ticks=2)
+    for _ in range(8):
+        assert "migrate_out" not in kinds(ap.decide(snap()))
+
+
+def test_victims_worst_outlier_wins_ties_to_lowest_id():
+    view = {
+        1: {"itl_p95_ms": 800.0, "n": 50},
+        2: {"itl_p95_ms": 800.0, "n": 50},
+        3: {"itl_p95_ms": 100.0, "n": 50},
+        4: {"itl_p95_ms": 100.0, "n": 50},
+        5: {"itl_p95_ms": 100.0, "n": 50},
+    }
+    ap = pilot(worker_view=lambda: view, outlier_confirm_ticks=1)
+    d = ap.decide(snap())
+    (mig,) = [a for a in d.actions if a.kind == "migrate_out"]
+    assert mig.worker_id == 1
+
+
+# --------------------------------------------------------------- drift retune
+
+
+def test_retune_fires_on_sustained_out_of_band_gap():
+    ap = pilot(gap_confirm_ticks=3)
+    hot = snap(host_gap=0.9)
+    for _ in range(2):
+        assert "tune_decode" not in kinds(ap.decide(hot))
+    d = ap.decide(hot)
+    (act,) = [a for a in d.actions if a.kind == "tune_decode"]
+    assert act.params["sweep"]["knob"] == "decode_burst"
+    assert act.params["sweep"]["direction"] == "up"
+    assert act.params["sweep"]["host_gap"] > AutopilotConfig().gap_band_hi
+
+
+def test_retune_in_band_resets_streak_and_low_gap_sweeps_prefill_chunk():
+    ap = pilot(gap_confirm_ticks=2)
+    ap.decide(snap(host_gap=0.9))
+    ap.decide(snap(host_gap=0.3))  # back in band: streak resets
+    assert "tune_decode" not in kinds(ap.decide(snap(host_gap=0.9)))
+    # sustained LOW gap recommends the other knob.  The gap is EWMA'd, so
+    # hold it low until the smoothed value crosses the lower band edge.
+    ap2 = pilot(gap_confirm_ticks=2)
+    acts = [
+        a
+        for _ in range(10)
+        for a in ap2.decide(snap(host_gap=0.01)).actions
+        if a.kind == "tune_decode"
+    ]
+    assert acts and acts[0].params["sweep"]["knob"] == "prefill_chunk"
+    assert acts[0].params["sweep"]["direction"] == "down"
+
+
+# --------------------------------------------------- determinism + the surface
+
+
+def test_decide_is_deterministic_under_replay():
+    """Same snapshot sequence → byte-identical decision dicts (the sim's
+    replay property, unit-sized)."""
+    views = {
+        1: {"itl_p95_ms": 100.0, "n": 50},
+        2: {"itl_p95_ms": 600.0, "n": 50},
+    }
+    seq = [
+        snap(hit_rate=0.2, itl=500.0),
+        snap(hit_rate=0.2, itl=500.0,
+             restore_pct={"restore_p95_ms": 40.0}),
+        snap(hit_rate=0.9, host_gap=0.9,
+             restore_pct={"restore_p95_ms": 45.0}),
+        snap(host_gap=0.9),
+        snap(host_gap=0.9),
+        snap(host_gap=0.9),
+        snap(host_gap=0.9),
+    ]
+
+    def run():
+        ap = pilot(worker_view=lambda: views)
+        return [ap.decide(s).to_dict() for s in seq]
+
+    assert run() == run()
+
+
+def test_decision_signals_carry_hit_rate_and_gap():
+    ap = pilot()
+    d = ap.decide(snap(hit_rate=0.3456789, host_gap=0.123456))
+    assert d.signals["fleet_prefix_hit_rate"] == 0.3457
+    assert d.signals["host_gap"] == 0.1235
+
+
+def test_state_surface_and_metrics_render():
+    ap = pilot(warm_confirm_ticks=1)
+    ap.decide(snap(hit_rate=0.1))
+    state = ap.state()
+    assert state["warm_grace"] == AutopilotConfig().warm_grace_ticks
+    assert set(state["streaks"]) == {
+        PREFIX_WARMING, MEASURED_ROUTING, VICTIM_MIGRATION, DRIFT_RETUNE
+    }
+    assert state["engine"]["tick"] == 1
+    assert state["metrics"]["decisions"][PREFIX_WARMING] == 1
+    text = pmetrics.autopilot_metrics.render()
+    assert (
+        'dynamo_tpu_autopilot_decisions_total{policy="prefix_warming"} 1'
+        in text
+    )
+
+
+# ------------------------------------------------------- snapshot wire fields
+
+
+def test_signal_snapshot_serde_roundtrips_new_fields():
+    s = snap(
+        hit_rate=0.42,
+        restore_pct={"restore_p95_ms": 12.5, "pull_p50_ms": 3.0},
+        host_gap=0.25,
+    )
+    d = s.to_dict()
+    back = SignalSnapshot.from_dict(d)
+    assert back.fleet_prefix_hit_rate == 0.42
+    assert back.restore_pct == {"restore_p95_ms": 12.5, "pull_p50_ms": 3.0}
+    assert back.host_gap == 0.25
+
+
+def test_signal_snapshot_omits_absent_optionals():
+    d = snap().to_dict()
+    for key in ("restore_pct", "host_gap", "fleet_prefix_hit_rate"):
+        assert key not in d, f"{key} must be omitted when absent"
+    back = SignalSnapshot.from_dict(d)
+    assert back.restore_pct is None and back.host_gap is None
+
+
+# ------------------------------------------------------------ directive plane
+
+
+@pytest.mark.asyncio
+async def test_local_actuator_records_autopilot_directives():
+    from dynamo_tpu.planner.actuate import LocalActuator, directive_key
+    from dynamo_tpu.planner.autopilot import (
+        kv_prefetch,
+        migrate_out,
+        set_tier_weights,
+        tune_decode,
+    )
+    from dynamo_tpu.planner.policy import Decision
+    from dynamo_tpu.runtime.transports.hub import InprocHub
+
+    hub = await InprocHub().start()
+    try:
+        decision = Decision(
+            tick=9,
+            actions=[
+                kv_prefetch(8, persist=True, reason="warm"),
+                set_tier_weights({"hbm": 1.0, "host": 0.4}, reason="meas"),
+                migrate_out(7, p95_ms=800.0, reason="outlier"),
+                tune_decode({"knob": "decode_burst"}, reason="gap"),
+            ],
+            pressures={},
+        )
+        await LocalActuator(hub).apply(decision)
+        warm = await hub.kv_get(directive_key("kv_prefetch"))
+        assert warm["params"] == {"top_n": 8, "persist": True}
+        assert warm["tick"] == 9
+        weights = await hub.kv_get(directive_key("set_tier_weights"))
+        assert weights["params"]["weights"]["host"] == 0.4
+        mig = await hub.kv_get(directive_key("migrate_out"))
+        assert mig["worker_id"] == 7 and mig["params"]["p95_ms"] == 800.0
+        tune = await hub.kv_get(directive_key("tune_decode"))
+        assert tune["params"]["sweep"]["knob"] == "decode_burst"
+    finally:
+        await hub.close()
+
+
+@pytest.mark.asyncio
+async def test_directive_watcher_enacts_router_kinds():
+    """hub directive slots → PlannerDirectiveWatcher → router core:
+    kv_prefetch warms now (persist flag through), set_tier_weights retunes
+    the index; supervisor/operator kinds pass through untouched."""
+    from dynamo_tpu.llm.kv_router.router import PlannerDirectiveWatcher
+    from dynamo_tpu.planner.actuate import LocalActuator
+    from dynamo_tpu.planner.autopilot import (
+        kv_prefetch,
+        set_tier_weights,
+        tune_decode,
+    )
+    from dynamo_tpu.planner.policy import Decision
+    from dynamo_tpu.runtime.transports.hub import InprocHub
+
+    class StubCore:
+        def __init__(self):
+            self.warms = []
+            self.weights = None
+
+        async def warm_hot_chains(self, top_n=None, persist=False):
+            self.warms.append((top_n, persist))
+
+        def apply_tier_weights(self, weights):
+            self.weights = weights
+
+    hub = await InprocHub().start()
+    core = StubCore()
+    try:
+        watcher = await PlannerDirectiveWatcher(hub, core).start()
+        decision = Decision(
+            tick=4,
+            actions=[
+                kv_prefetch(5, persist=True, reason="warm"),
+                set_tier_weights(
+                    {"hbm": 1.0, "host": 0.3, "disk": 0.18, "objstore": 0.1},
+                    reason="meas",
+                ),
+                tune_decode({"knob": "decode_burst"}, reason="gap"),
+            ],
+            pressures={},
+        )
+        await LocalActuator(hub).apply(decision)
+        for _ in range(100):
+            if core.warms and core.weights is not None:
+                break
+            await asyncio.sleep(0.02)
+        assert core.warms == [(5, True)]
+        assert core.weights["host"] == 0.3
+        assert watcher.applied == 2  # tune_decode is not a router kind
+        await watcher.stop()
+    finally:
+        await hub.close()
+
+
+@pytest.mark.asyncio
+async def test_directive_watcher_replays_standing_weights_on_start():
+    """A freshly started router inherits the standing tier-weight slot
+    (watch sync replay) instead of routing cold until the next retune."""
+    from dynamo_tpu.llm.kv_router.router import PlannerDirectiveWatcher
+    from dynamo_tpu.planner.actuate import directive_key
+    from dynamo_tpu.runtime.transports.hub import InprocHub
+
+    class StubCore:
+        def __init__(self):
+            self.weights = None
+
+        async def warm_hot_chains(self, top_n=None, persist=False):
+            pass
+
+        def apply_tier_weights(self, weights):
+            self.weights = weights
+
+    hub = await InprocHub().start()
+    core = StubCore()
+    try:
+        await hub.kv_put(
+            directive_key("set_tier_weights"),
+            {
+                "kind": "set_tier_weights",
+                "tick": 1,
+                "reason": "standing",
+                "params": {"weights": {"hbm": 1.0, "host": 0.2}},
+            },
+        )
+        watcher = await PlannerDirectiveWatcher(hub, core).start()
+        for _ in range(100):
+            if core.weights is not None:
+                break
+            await asyncio.sleep(0.02)
+        assert core.weights == {"hbm": 1.0, "host": 0.2}
+        await watcher.stop()
+    finally:
+        await hub.close()
+
+
+def test_radix_index_live_tier_weight_retune_changes_routing():
+    """set_tier_weights on a live index flips the discounted winner: a
+    deep-but-cold prefix loses to a shallow-hot one once the measured
+    weights price the cold tier down."""
+    from dynamo_tpu.llm.kv_router.indexer import KvIndexer, RadixIndex
+
+    idx = RadixIndex()
+    # worker 1: 3 blocks on disk; worker 2: 2 blocks in HBM
+    parent = None
+    for h in (11, 12, 13):
+        idx.add_block(1, h, parent, tier="disk")
+        parent = h
+    parent = None
+    for h in (11, 12):
+        idx.add_block(2, h, parent, tier="hbm")
+        parent = h
+    before = idx.find_matches([11, 12, 13])
+    assert before.best() == 2  # 2.0 discounted beats 3 x 0.45
+    idx.set_tier_weights({"hbm": 1.0, "host": 0.9, "disk": 0.9, "objstore": 0.5})
+    after = idx.find_matches([11, 12, 13])
+    assert after.best() == 1, "retuned disk weight should flip the winner"
+    # and the sharded wrapper fans the table out to every shard
+    sharded = KvIndexer(16)
+    sharded.set_tier_weights({"hbm": 1.0, "host": 0.1, "disk": 0.1, "objstore": 0.1})
+    assert sharded._index.tier_weights["host"] == 0.1
+
+
+def test_autopilot_smoke_scenario_passes():
+    """The acceptance scenario: warming beats pressure-only scaling on the
+    seeded hot-prefix surge, deterministically (planner/sim.py)."""
+    from dynamo_tpu.planner.sim import autopilot_smoke
+
+    ok, summary = autopilot_smoke(verbose=True)
+    assert ok, summary

@@ -786,6 +786,73 @@ def test_dyn304_registry_consistency_against_real_tree():
     assert findings == [], "\n".join(f.message for f in findings)
 
 
+def test_dyn304_snapshot_producer_missing_field_is_found():
+    """Face (b): a registered producer that builds the snapshot without a
+    field (and no exemption) is a finding — the sim-silently-stops-
+    modelling-the-fleet bug class."""
+    src = (
+        "from dataclasses import dataclass\n"
+        "@dataclass\n"
+        "class SignalSnapshot:\n"
+        "    t: float = 0.0\n"
+        "    host_gap: float = None\n"
+        "class SimCluster:\n"
+        "    def snapshot(self):\n"
+        "        return SignalSnapshot(t=1.0)\n"
+        "class SignalCollector:\n"
+        "    def snapshot(self):\n"
+        "        return SignalSnapshot(t=1.0, host_gap=0.2)\n"
+    )
+    found = analyze_sources([("x.py", src)], rules={"DYN304"})
+    # host_gap is exempted for SimCluster.snapshot in the real registry, so
+    # only a field OUTSIDE the exemption set trips; use the collector,
+    # whose exemption set is empty.
+    src2 = src.replace(
+        "return SignalSnapshot(t=1.0, host_gap=0.2)",
+        "return SignalSnapshot(t=1.0)",
+    )
+    found2 = analyze_sources([("x.py", src2)], rules={"DYN304"})
+    assert not [f for f in found if "SignalCollector.snapshot" in f.symbol]
+    bad = [f for f in found2 if "SignalCollector.snapshot" in f.symbol]
+    assert bad and "host_gap" in bad[0].message
+
+
+def test_dyn304_snapshot_producer_dynamic_ctor_stands_down():
+    src = (
+        "from dataclasses import dataclass\n"
+        "@dataclass\n"
+        "class SignalSnapshot:\n"
+        "    t: float = 0.0\n"
+        "    host_gap: float = None\n"
+        "class SignalCollector:\n"
+        "    def snapshot(self):\n"
+        "        kw = {'t': 1.0}\n"
+        "        return SignalSnapshot(**kw)\n"
+        "class SimCluster:\n"
+        "    def snapshot(self):\n"
+        "        return SignalSnapshot(t=1.0)\n"
+    )
+    found = analyze_sources([("x.py", src)], rules={"DYN304"})
+    assert not [f for f in found if "SignalCollector.snapshot" in f.symbol]
+
+
+def test_dyn304_snapshot_producer_missing_site_is_found():
+    src = (
+        "from dataclasses import dataclass\n"
+        "@dataclass\n"
+        "class SignalSnapshot:\n"
+        "    t: float = 0.0\n"
+        "class SignalCollector:\n"
+        "    def snapshot(self):\n"
+        "        return SignalSnapshot(t=1.0)\n"
+    )
+    found = analyze_sources([("x.py", src)], rules={"DYN304"})
+    assert any(
+        "SimCluster.snapshot" in f.message and "no such constructor" in f.message
+        for f in found
+    )
+
+
 def test_dyn306_against_real_pytree_classes():
     findings = analyze_paths(
         ["dynamo_tpu/ops/sampling.py", "dynamo_tpu/models/llama.py"],

@@ -181,6 +181,69 @@ class _Pace:
             e.pace_hook = None
 
 
+class _CopyGate:
+    """Count-bounded decode-vs-copy interlock: the deflaked successor to
+    ``_Pace`` for the two acceptance races below (a wall-clock delay only
+    SHRINKS the losing window; a budget closes it).
+
+    Phase 1 (copy rounds): decode consumes one budget unit per paced
+    device op (engine.pace_hook — awaited OUTSIDE the device lock, see
+    pipeline._pace) and PARKS when the budget is dry; every completed
+    copy round (worker.copy_round_hook) refills ``steps_per_round`` more.
+    Decode therefore advances a bounded number of ops per shipped round
+    no matter how slow the container is — the historical flake (decode
+    finishing the sequence before the copy loop landed, aborting the
+    migration) is structurally impossible — and the parked loop holds no
+    lock, so the copy plane is never starved.
+
+    Final phase (the worker fires ``final=True`` right before the
+    freeze): the gate stops parking and degrades to a small per-op delay.
+    Freeze quiescence NEEDS the decode loop running (in-flight harvests +
+    fused-session retirement), while the delay keeps any co-resident
+    control sequence, which needs hundreds of paced ops, provably slower
+    than the O(transfer) cutover, which needs a handful.
+
+    ``release()`` uninstalls both hooks and restores full speed."""
+
+    def __init__(self, worker, steps_per_round=2, final_delay_s=0.02):
+        self._worker = worker
+        self._engine = worker.engine
+        self._per_round = steps_per_round
+        self._final_delay = final_delay_s
+        self._budget = steps_per_round
+        self._refill = asyncio.Event()
+        self._final = False
+        self._released = False
+        self.rounds = 0  # phase-1 copy rounds observed
+        worker.engine.pace_hook = self._pace
+        worker.copy_round_hook = self._round
+
+    async def _pace(self):
+        if self._released:
+            return
+        if self._final:
+            await asyncio.sleep(self._final_delay)
+            return
+        while self._budget <= 0 and not self._final and not self._released:
+            self._refill.clear()
+            await self._refill.wait()
+        self._budget -= 1
+
+    async def _round(self, cursor, final):
+        if final:
+            self._final = True
+        else:
+            self.rounds += 1
+            self._budget += self._per_round
+        self._refill.set()
+
+    def release(self):
+        self._released = True
+        self._refill.set()
+        self._engine.pace_hook = None
+        self._worker.copy_round_hook = None
+
+
 # ---------------------------------------------------------------- snapshot
 
 
@@ -260,13 +323,15 @@ async def test_migrate_once_and_twice_exact_stream():
         task = _consume(stream, items)
         await _wait_for(lambda: len(_tokens(items)) >= 5)
         before = len(_tokens(items))
-        # Deterministic race: throttle the source's decode so the copy
-        # loop provably completes before the sequence can finish (decode
-        # outruns the copy loop on slow containers otherwise — the
-        # migration then aborts on a finished sequence).
-        pace = _Pace(a.engine)
+        # Deterministic race: gate the source's decode on the copy-round
+        # budget so the copy loop provably completes before the sequence
+        # can finish (decode outran the copy loop on slow containers under
+        # the old time-based throttle — the migration then aborted on a
+        # finished sequence).
+        gate = _CopyGate(a.mig)
         assert await a.mig.migrate_out(rid, b.target)
-        pace.release()
+        assert gate.rounds >= 1  # the budget interlock actually engaged
+        gate.release()
         await task
         assert _tokens(items) == control
         assert items[-1]["finish_reason"] is not None
@@ -287,17 +352,17 @@ async def test_migrate_once_and_twice_exact_stream():
         items2 = []
         task2 = _consume(stream2, items2)
         await _wait_for(lambda: len(_tokens(items2)) >= 4)
-        pace = _Pace(a.engine)
+        gate = _CopyGate(a.mig)
         assert await a.mig.migrate_out(ctx2.id, b.target)
-        pace.release()
+        gate.release()
         # Wait until B owns the resumed sequence and has advanced it.
         await _wait_for(
             lambda: (s := b.engine.find_sequence(ctx2.id)) is not None
             and s.num_output_tokens >= len(_tokens(items2)) + 2
         )
-        pace = _Pace(b.engine)
+        gate = _CopyGate(b.mig)
         assert await b.mig.migrate_out(ctx2.id, c.target)
-        pace.release()
+        gate.release()
         await task2
         assert _tokens(items2) == control2
         assert b.engine.find_sequence(ctx2.id) is None
@@ -378,7 +443,7 @@ async def test_commit_failure_rolls_back_source_authoritative():
 
 @pytest.mark.slow  # heavy 2-worker fleet: ci.sh's migration step runs it
 # (no `slow` filter there); tier-1 keeps the cheap gates.  The drain-vs-
-# control race itself is DETERMINISTIC via the injectable pace hook.
+# control race itself is DETERMINISTIC via the copy-round budget gate.
 async def test_remote_drain_via_migrate_is_transfer_bound():
     """Planner scale-down/flip acceptance: draining a worker via its
     REMOTE migrate_out control endpoint (llm.migration.request_migrate_out
@@ -409,13 +474,15 @@ async def test_remote_drain_via_migrate_is_transfer_bound():
         task = _consume(stream, items)
         await _wait_for(lambda: len(_tokens(items)) >= 5)
 
-        # Deterministic race: throttle the SOURCE engine's decode so the
-        # copy loop (unthrottled — it runs under the device lock, not
-        # through the paced ``_await_device``) provably outpaces both the
-        # migrating sequence and the control.  Without this, a slow
-        # container could decode 320 tokens before 16 copy rounds landed
-        # and the drain aborted on a finished sequence.
-        pace = _Pace(a.engine)
+        # Deterministic race: gate the SOURCE engine's decode on the
+        # copy-round budget (the copy loop itself is unthrottled — it
+        # runs under the device lock, not through the paced device-op
+        # path) so it provably outpaces both the migrating sequence and
+        # the control.  Under the old time-based throttle a slow
+        # container could still decode 320 tokens before 16 copy rounds
+        # landed and the drain aborted on a finished sequence; the budget
+        # bounds decode by OP COUNT per shipped round instead.
+        gate = _CopyGate(a.mig)
         # Control clock starts at the drain decision: the same seeded
         # sequence, decoded from scratch to completion on the SOURCE engine
         # (seeded streams are engine-agnostic; running it there keeps the
@@ -434,9 +501,10 @@ async def test_remote_drain_via_migrate_is_transfer_bound():
             "drain-via-migrate was not faster than sequence completion"
         )
         assert ctx.id not in a.engine.live_request_ids()
+        assert gate.rounds >= 1  # the budget interlock actually engaged
         # Race decided: restore full speed so the control (and the spliced
         # stream's tail on the target) finish promptly.
-        pace.release()
+        gate.release()
 
         await task
         control = _tokens(await control_task)

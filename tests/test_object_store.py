@@ -1,0 +1,329 @@
+"""Durable object-store KV tier (docs/kv_tiering.md fourth tier).
+
+The tier below disk: a local-FS-backed object layout with atomic
+multipart-style writes, carried CRC-32 stamps (engine/integrity.py), and
+byte-budgeted GC.  Unlike the engine-owned tiers it SURVIVES ``close()``
+— a scale-from-zero worker pointed at the same directory starts warm and
+must stream byte-identically to recompute (the PR 13 integrity contract
+extends to the new plane: corrupt objects are quarantined, never
+scattered).
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig
+from dynamo_tpu.engine.disk_cache import DiskKvStore
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.engine.integrity import block_checksum
+from dynamo_tpu.engine.object_store import ObjectKvStore
+from dynamo_tpu.llm.kv_router.protocols import KvCacheTierData
+from dynamo_tpu.llm.protocols import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context, collect
+from dynamo_tpu.tokens import hash_token_blocks
+
+pytestmark = pytest.mark.tiering
+
+BS = 4
+
+
+def _cfg(tmp_path, **over):
+    cfg = dict(
+        model="debug-tiny",
+        block_size=BS,
+        num_blocks=16,
+        max_batch=2,
+        max_model_len=64,
+        prefill_chunk=32,
+        dtype="float32",
+        host_cache_bytes=64 << 20,
+        disk_cache_bytes=64 << 20,
+        disk_cache_dir=str(tmp_path / "kv"),
+        object_store_bytes=64 << 20,
+        object_store_dir=str(tmp_path / "objects"),
+    )
+    cfg.update(over)
+    return EngineConfig(**cfg)
+
+
+async def _generate(
+    engine, tokens, max_tokens=4, seed=None, temperature=0.0, annotations=None
+):
+    req = PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=temperature, seed=seed),
+        annotations=dict(annotations or {}),
+    ).to_dict()
+    stream = await engine.generate(Context(req))
+    out = await collect(stream)
+    return [t for item in out for t in item["token_ids"]]
+
+
+async def _settle_offload(engine, want_blocks):
+    for _ in range(100):
+        await engine.drain_offload()
+        if len(engine.host_kv) >= want_blocks:
+            return
+        await asyncio.sleep(0.01)
+
+
+# ---------------------------------------------------------------- store unit
+
+
+def test_object_store_roundtrip_gc_and_reindex(tmp_path):
+    blk = np.zeros((2, 4, 4, 8), np.float32)  # 1 KiB payload
+    store = ObjectKvStore(capacity_bytes=1 << 20, directory=str(tmp_path))
+    ck = block_checksum(blk)
+    assert store.put(7, blk, checksum=ck)
+    arr, got_ck, corrupt = store.read(
+        7, expected_shape=blk.shape, expected_dtype=blk.dtype
+    )
+    assert not corrupt and got_ck == ck and np.array_equal(arr, blk)
+
+    # a carried-stamp mismatch is REFUSED before anything touches the
+    # store — persisting rotted bytes would poison every future warm start
+    assert store.put(8, blk, checksum=ck + 1) is False
+    assert not store.contains(8) and store.rejected_blocks >= 1
+
+    # byte-budgeted GC: a small budget evicts coldest-first down to the
+    # watermark, and every eviction is a recorded transition
+    one = store.block_nbytes(7)
+    small = ObjectKvStore(
+        capacity_bytes=4 * one, directory=str(tmp_path / "small")
+    )
+    for h in range(6):
+        assert small.put(h + 1, blk.copy())
+    assert small.used_bytes <= 4 * one
+    assert small.evicted_blocks > 0 and small.gc_runs >= 1
+    assert not small.contains(1) and small.contains(6)
+    assert all(k == "drop" for k, _ in small.drain_transitions())
+
+    # a fresh store over the same directory re-indexes the survivors —
+    # THE property the scale-from-zero warm start rides on
+    again = ObjectKvStore(capacity_bytes=1 << 20, directory=str(tmp_path))
+    assert again.contains(7)
+    arr2, _, c2 = again.read(7)
+    assert not c2 and np.array_equal(arr2, blk)
+
+
+def test_object_store_quarantines_corrupt_objects(tmp_path):
+    blk = np.arange(2 * 4 * 4 * 8, dtype=np.float32).reshape(2, 4, 4, 8)
+    store = ObjectKvStore(capacity_bytes=1 << 20, directory=str(tmp_path))
+    assert store.put(9, blk, checksum=block_checksum(blk))
+    path = store._path(9)
+    with open(path, "r+b") as f:
+        f.truncate(64)
+    arr, _, corrupt = store.read(9)
+    assert arr is None and corrupt
+    assert store.corrupt_blocks == 1
+    assert not store.contains(9) and not os.path.exists(path)
+
+    # oversized vs the whole budget: rejected, never written
+    tiny = ObjectKvStore(capacity_bytes=128, directory=str(tmp_path / "t"))
+    assert tiny.put(1, blk) is False
+    assert tiny.rejected_blocks == 1 and len(tiny) == 0
+
+    # an orphaned staging file (crash mid-publish) is swept at re-index
+    orphan = store._tmp_path(store._path(0xDEAD))
+    os.makedirs(os.path.dirname(orphan), exist_ok=True)
+    with open(orphan, "wb") as f:
+        f.write(b"partial")
+    swept = ObjectKvStore(capacity_bytes=1 << 20, directory=str(tmp_path))
+    assert not os.path.exists(orphan)
+    assert not swept.contains(0xDEAD)
+
+
+def test_object_store_ingests_disk_envelopes_with_carried_stamp(tmp_path):
+    """The demotion handoff: disk hands the object tier its ``.kvblk``
+    PATH, and ingest re-verifies the envelope before re-wrapping — disk
+    rot is refused at the boundary, not laundered into a durable object."""
+    blk = np.arange(2 * 4 * 4 * 8, dtype=np.float32).reshape(2, 4, 4, 8)
+    disk = DiskKvStore(capacity_bytes=1 << 20, directory=str(tmp_path / "d"))
+    store = ObjectKvStore(capacity_bytes=1 << 20, directory=str(tmp_path / "o"))
+    assert disk.put(11, blk)
+    assert store.ingest_kvblk(11, disk._path(11))
+    arr, ck, corrupt = store.read(
+        11, expected_shape=blk.shape, expected_dtype=blk.dtype
+    )
+    assert not corrupt and np.array_equal(arr, blk)
+    assert ck == block_checksum(blk)  # the offload stamp rode through
+
+    # a rotted .kvblk is refused at ingest
+    assert disk.put(12, blk)
+    path = disk._path(12)
+    with open(path, "r+b") as f:
+        f.seek(200)
+        f.write(b"\xff")
+    assert store.ingest_kvblk(12, path) is False
+    assert not store.contains(12)
+
+
+# ------------------------------------------------------- engine tier chain
+
+
+def test_disk_eviction_demotes_to_objstore_with_tier_events(tmp_path):
+    async def main():
+        events = []
+        engine = TpuEngine(_cfg(tmp_path), event_callback=events.append)
+        prompt = list(range(1, 13))
+        await _generate(engine, prompt)
+        await _settle_offload(engine, 3)
+        blocks = {tb.sequence_hash for tb in hash_token_blocks(prompt, BS)}
+
+        # squeeze host, then disk: the chain cascades host→disk→objstore
+        # (the disk budget holds ~2 envelopes: payload + small JSON header)
+        engine.host_kv.capacity_bytes = 2 * engine.block_nbytes()
+        engine.disk_kv.capacity_bytes = 2 * engine.block_nbytes() + 1024
+        for base in (20, 40, 60, 80, 100, 120):
+            await _generate(engine, [base + i for i in range(12)])
+            await engine.drain_offload()
+
+        demoted = [h for h in blocks if engine.object_kv.contains(h)]
+        assert demoted, "test needs disk→objstore demotion"
+        assert engine.disk_kv.demoted_blocks > 0
+        objstore_tagged = {
+            h
+            for e in events
+            if isinstance(e.data, KvCacheTierData) and e.data.tier == "objstore"
+            for h in e.data.block_hashes
+        }
+        assert set(demoted) <= objstore_tagged
+        assert engine._tier_of(demoted[0]) == "objstore"
+        summary = engine.kv_tier_summary()
+        assert summary["objstore"]["blocks"] == len(engine.object_kv)
+        await engine.close()
+
+    asyncio.run(main())
+
+
+def test_persist_hashes_sources_host_then_disk(tmp_path):
+    async def main():
+        engine = TpuEngine(_cfg(tmp_path))
+        prompt = list(range(1, 13))
+        await _generate(engine, prompt)
+        await _settle_offload(engine, 3)
+        chain = [tb.sequence_hash for tb in hash_token_blocks(prompt, BS)]
+        resident = [h for h in chain if engine.host_kv.contains(h)]
+        assert resident, "test needs host-resident blocks"
+        n = await engine.persist_hashes(chain)
+        assert n == len(resident)
+        assert all(engine.object_kv.contains(h) for h in resident)
+        # idempotent: already-present objects are skipped, not rewritten
+        assert await engine.persist_hashes(chain) == 0
+        await engine.close()
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------- scale-from-zero warm start
+
+
+def test_scale_from_zero_worker_starts_warm_and_byte_identical(tmp_path):
+    """THE acceptance bar: a worker restored from the object tier skips
+    >=90% of second-occurrence prefill and streams byte-identically."""
+
+    async def main():
+        prompt = list(range(1, 41))  # 10 full blocks
+        cfg = dict(max_model_len=128, num_blocks=64)
+
+        first = TpuEngine(_cfg(tmp_path, **cfg))
+        a = await _generate(first, prompt, seed=13, temperature=0.9)
+        await _settle_offload(first, 10)
+        chain = [tb.sequence_hash for tb in hash_token_blocks(prompt, BS)]
+        assert await first.persist_hashes(chain) >= 9
+        await first.close()  # the worker dies; objects survive
+
+        # control: recompute from nothing (no tiers at all)
+        control = TpuEngine(
+            EngineConfig(
+                model="debug-tiny", block_size=BS, num_blocks=64,
+                max_batch=2, max_model_len=128, prefill_chunk=32,
+                dtype="float32", host_cache_bytes=0,
+            )
+        )
+        want = await _generate(control, prompt, seed=13, temperature=0.9)
+        assert a == want
+
+        # scale-from-zero: FRESH engine, EMPTY disk dir, same object dir
+        fresh = TpuEngine(
+            _cfg(tmp_path, disk_cache_dir=str(tmp_path / "kv2"), **cfg)
+        )
+        assert len(fresh.disk_kv) == 0 and len(fresh.object_kv) >= 9
+        got = await _generate(fresh, prompt, seed=13, temperature=0.9)
+        assert got == want  # byte-identity vs recompute
+        # prefill skip: >=90% of the prompt's blocks restored, not computed
+        assert fresh.kv.matched_blocks >= 9
+        await fresh.close()
+        await control.close()
+
+    asyncio.run(main())
+
+
+def test_objstore_corruption_recomputes_exactly(tmp_path):
+    """PR 13 integrity contract on the new plane: an armed corruption on
+    the object read is detected, quarantined, and degraded to recompute —
+    no wrong token, no crash."""
+
+    async def main():
+        from dynamo_tpu.llm.metrics import kv_integrity_metrics
+        from dynamo_tpu.runtime.faultinject import faults
+
+        prompt = list(range(1, 13))
+        first = TpuEngine(_cfg(tmp_path))
+        control = await _generate(first, prompt, seed=9, temperature=0.9)
+        await _settle_offload(first, 3)
+        chain = [tb.sequence_hash for tb in hash_token_blocks(prompt, BS)]
+        assert await first.persist_hashes(chain) >= 2
+        await first.close()
+
+        fresh = TpuEngine(_cfg(tmp_path, disk_cache_dir=str(tmp_path / "kv2")))
+        persisted = [h for h in chain if fresh.object_kv.contains(h)]
+        c0 = kv_integrity_metrics.corrupt_total["objstore"]
+        faults.arm("kv_corrupt", match="objstore", count=1)
+        try:
+            again = await _generate(fresh, prompt, seed=9, temperature=0.9)
+            assert again == control  # degraded to recompute, exact stream
+            assert kv_integrity_metrics.corrupt_total["objstore"] == c0 + 1
+        finally:
+            faults.reset()
+        # the corrupt object (and its chained descendants) left the store
+        assert any(not fresh.object_kv.contains(h) for h in persisted)
+        await fresh.close()
+
+    asyncio.run(main())
+
+
+def test_config_requires_disk_tier_and_explicit_dir(tmp_path):
+    with pytest.raises(Exception):
+        EngineConfig(
+            model="debug-tiny", block_size=BS, num_blocks=16, max_batch=2,
+            max_model_len=64, host_cache_bytes=64 << 20,
+            object_store_bytes=64 << 20,
+            object_store_dir=str(tmp_path / "o"),
+        )
+    with pytest.raises(Exception):
+        EngineConfig(
+            model="debug-tiny", block_size=BS, num_blocks=16, max_batch=2,
+            max_model_len=64, host_cache_bytes=64 << 20,
+            disk_cache_bytes=64 << 20, disk_cache_dir=str(tmp_path / "kv"),
+            object_store_bytes=64 << 20,
+        )
+
+
+def test_objstore_metrics_render(tmp_path):
+    from dynamo_tpu.llm.metrics import objstore_metrics
+
+    text = objstore_metrics.render()
+    for name in (
+        "puts_total", "put_bytes_total", "gets_total", "get_bytes_total",
+        "gc_evictions_total",
+    ):
+        assert f"dynamo_tpu_objstore_{name}" in text

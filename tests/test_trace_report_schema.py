@@ -1,0 +1,100 @@
+"""Golden-schema contract for loadgen's ``--trace-report`` artifact.
+
+The "trace_report" key in loadgen results JSON is compared ACROSS runs
+(the v5e carry-over sweeps diff it against stored baselines), so its shape
+is a contract, not an implementation detail.  These tests pin it
+field-by-field against ``trace_report_from_rollups`` — the pure
+aggregation split out of the /traces fetch — using synthetic rollups
+shaped exactly like ``trace_service.ttft_decomposition`` output.
+"""
+
+import pytest
+
+from benchmarks.loadgen import trace_report_from_rollups
+from dynamo_tpu.llm.trace_service import TTFT_HOPS
+
+pytestmark = pytest.mark.tracing
+
+HOPS = [h for h, _ in TTFT_HOPS]
+
+
+def _rollup(hops, ttft_ms=None, unattributed_ms=None):
+    r = {"hops": dict(hops)}
+    if ttft_ms is not None:
+        r["ttft_ms"] = ttft_ms
+    if unattributed_ms is not None:
+        r["unattributed_ms"] = unattributed_ms
+    return r
+
+
+def test_trace_report_golden_schema_field_by_field():
+    assert HOPS == [
+        "edge_queue", "preprocess", "route", "engine_queue",
+        "prefill_or_pull", "first_decode",
+    ]  # the docs/tracing.md decomposition order — report hops come from it
+    rollups = [
+        _rollup(
+            {h: d for h, d in zip(
+                HOPS, (1.0, 2.0, 3.0, 4.0, 100.0, 10.0))},
+            ttft_ms=120.0, unattributed_ms=0.5,
+        ),
+        _rollup(
+            {h: d for h, d in zip(
+                HOPS, (2.0, 4.0, 5.0, 8.0, 200.0, 20.0))},
+            ttft_ms=80.0,
+        ),
+        # Assembled but never reached first token: hops only, no ttft.
+        _rollup({"edge_queue": 3.0, "route": 7.0}),
+        # Assembled with an empty hop map (trace TTL ate the spans).
+        _rollup({}, ttft_ms=100.0, unattributed_ms=2.5),
+        None,  # fetch failure: requested but not assembled
+    ]
+    report = trace_report_from_rollups(5, rollups)
+
+    # Top level: EXACTLY these keys, no extras sneaking into the artifact.
+    assert set(report) == {
+        "requested", "assembled", "hops",
+        "ttft_p50_ms", "ttft_p95_ms", "unattributed_p95_ms",
+    }
+    assert report["requested"] == 5
+    assert report["assembled"] == 4
+
+    # Hops: only hops that appeared, sorted, each EXACTLY {n, p50, p95}.
+    assert list(report["hops"]) == sorted(
+        {"edge_queue", "preprocess", "route", "engine_queue",
+         "prefill_or_pull", "first_decode"}
+    )
+    for hop, stats in report["hops"].items():
+        assert set(stats) == {"n", "p50_ms", "p95_ms"}, hop
+        assert isinstance(stats["n"], int)
+    # route saw [3.0, 5.0, 7.0] ms across three rollups.
+    assert report["hops"]["route"] == {"n": 3, "p50_ms": 5.0, "p95_ms": 7.0}
+    # prefill_or_pull saw [100.0, 200.0].
+    assert report["hops"]["prefill_or_pull"] == {
+        "n": 2, "p50_ms": 200.0, "p95_ms": 200.0,
+    }
+
+    # TTFT percentiles over [120, 80, 100]; unattributed defaults 0.0 for
+    # rollups that carried ttft_ms without it.
+    assert report["ttft_p50_ms"] == 100.0
+    assert report["ttft_p95_ms"] == 120.0
+    assert report["unattributed_p95_ms"] == 2.5
+
+
+def test_trace_report_omits_ttft_keys_when_never_measured():
+    report = trace_report_from_rollups(
+        2, [_rollup({"route": 3.0}), _rollup({"route": 5.0})]
+    )
+    assert set(report) == {"requested", "assembled", "hops"}
+    assert report["assembled"] == 2
+
+
+def test_trace_report_all_fetches_failed():
+    report = trace_report_from_rollups(3, [None, None, None])
+    assert report == {"requested": 3, "assembled": 0, "hops": {}}
+
+
+def test_trace_report_empty_run():
+    assert trace_report_from_rollups(0, []) == {
+        "requested": 0, "assembled": 0, "hops": {},
+    }

@@ -138,13 +138,16 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_bulk.py -q -m bulk \
   -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== chaos ladder L0-L2 + L5 respawn + L6 overload + L7 corruption"
-echo "   storm + L8 shard kill + L9 bulk peer kill (seeded goodput smoke;"
-echo "   bars: 0 dropped, byte-identity incl. unseeded streams, respawn on"
-echo "   L5, non-flooding tenants >= 0.9x isolated on L6, every injected"
-echo "   kv_corrupt flip detected before scatter on L7, standby promoted +"
-echo "   >=0.85x goodput on L8, bulk resume + hub-path fallback + recovery"
-echo "   with byte-identical streams on L9) =="
-env JAX_PLATFORMS=cpu python benchmarks/goodput.py --levels 0,1,2,5,6,7,8,9 \
+echo "   storm + L8 shard kill + L9 bulk peer kill + L10 objstore"
+echo "   scale-from-zero (seeded goodput smoke; bars: 0 dropped,"
+echo "   byte-identity incl. unseeded streams, respawn on L5, non-flooding"
+echo "   tenants >= 0.9x isolated on L6, every injected kv_corrupt flip"
+echo "   detected before scatter on L7, standby promoted + >=0.85x goodput"
+echo "   on L8, bulk resume + hub-path fallback + recovery with"
+echo "   byte-identical streams on L9, >=90% warm prefill skip +"
+echo "   byte-identity from the durable object tier on L10) =="
+env JAX_PLATFORMS=cpu python benchmarks/goodput.py \
+  --levels 0,1,2,5,6,7,8,9,10 \
   --seed 7 --duration 5 --rate 2.5 --check --json /tmp/_goodput_smoke.json
 
 echo "== tier-1 tests =="

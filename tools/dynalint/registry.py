@@ -164,6 +164,7 @@ HUB_KEY_BUILDER_TAILS = {
     # planner actuation (planner/actuate.py)
     "target_key",
     "role_key",
+    "directive_key",
     # disaggregated serving (llm/disagg/)
     "disagg_config_key",
     "prefill_queue_name",
@@ -241,6 +242,11 @@ WIRE_FIELD_EXEMPT = {
 OMIT_WHEN_ABSENT_CLASSES = {
     "PreprocessedRequest",
     "SequenceSnapshot",
+    # Planner signal plane (planner/signals.py): the SLO percentiles and
+    # the autopilot inputs (fleet_prefix_hit_rate, restore_pct, host_gap)
+    # ship only when an edge measured them — pre-autopilot planners (and
+    # replay fixtures) keep the original wire shape.
+    "SignalSnapshot",
     # Distributed tracing (runtime/tracing.py): ``sampled`` ships only when
     # False — pre-tracing consumers (and the common sampled case) keep the
     # minimal {trace_id, span_id} wire shape.  The trace context itself
@@ -360,6 +366,31 @@ SNAPSHOT_EXEMPT = {
     "adapter_slot": "target resolves its own resident slot",
     "adapter_released": "source-side release idempotency flag",
     "grammar_state": "re-derived by advancing through resumed output",
+}
+
+# DYN304's second face (the generalization the SignalSnapshot autopilot
+# fields forced): wire SNAPSHOT classes with more than one PRODUCER.  Each
+# registered producer ("Class.method") must pass every field of the
+# snapshot class explicitly at its construction site, or carry a
+# per-producer exemption naming why the default is correct THERE.  The bug
+# class: a field added to the snapshot and populated by the production
+# collector but not the sim's — seeded replays then exercise a policy
+# against permanently-absent signals and the sim silently stops being a
+# model of the fleet.
+WIRE_SNAPSHOT_PRODUCERS = {
+    "SignalSnapshot": {
+        "SignalCollector.snapshot": set(),
+        "SimCluster.snapshot": {
+            # the sim models one fleet without a real edge/engine plane;
+            # these edge-derived signals stay at their absent defaults
+            # (policies reading them must already tolerate None edges)
+            "hit_isl_blocks",
+            "hit_overlap_blocks",
+            "edge_brownout_rung",
+            "restore_pct",
+            "host_gap",
+        },
+    },
 }
 
 # ---------------------------------------------------------------------------

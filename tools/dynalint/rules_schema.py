@@ -18,10 +18,15 @@ round-trip needed:
 - **DYN303** — parse stability: ``from_dict`` must read DEFAULTED fields
   with ``d.get(...)``, never ``d["k"]`` — an old-wire dict without the key
   is valid input by construction.
-- **DYN304** — snapshot threading completeness: every ``SequenceState``
-  field is either mapped into ``SequenceSnapshot`` or explicitly exempted
-  (registry ``SNAPSHOT_COVERED`` / ``SNAPSHOT_EXEMPT``); stale registry
-  entries are findings too, so the map cannot rot.
+- **DYN304** — snapshot threading completeness, two faces: (a) every
+  ``SequenceState`` field is either mapped into ``SequenceSnapshot`` or
+  explicitly exempted (registry ``SNAPSHOT_COVERED`` / ``SNAPSHOT_EXEMPT``);
+  (b) every registered producer of a multi-producer wire snapshot
+  (``WIRE_SNAPSHOT_PRODUCERS`` — e.g. ``SignalSnapshot`` built by both the
+  production ``SignalCollector`` and the sim's ``SimCluster``) passes each
+  snapshot field at its construction site or carries a per-producer
+  exemption.  Stale registry entries are findings too, so the maps cannot
+  rot.
 - **DYN305** — ``setdefault`` on a nullable wire key: a client-sent
   ``"nvext": null`` satisfies ``setdefault`` and silently skips the
   rewrite (the PR 8 bug) — test ``isinstance(..., dict)`` instead.
@@ -51,6 +56,7 @@ from .registry import (
     WIRE_CLASS_EXEMPT,
     WIRE_CLASS_EXTRA,
     WIRE_FIELD_EXEMPT,
+    WIRE_SNAPSHOT_PRODUCERS,
 )
 
 SCHEMA_RULES = ("DYN301", "DYN302", "DYN303", "DYN304", "DYN305", "DYN306")
@@ -262,6 +268,34 @@ def _finding(
     return make_finding(rule, path, symbol, node, message, lines_of.get(path, []))
 
 
+def _producer_ctor_sites(
+    graph: CorpusGraph, snap_name: str, producers: Dict[str, Set[str]]
+) -> Dict[str, Tuple[str, ast.Call]]:
+    """``"Class.method" -> (path, ctor Call)`` for each registered producer
+    of ``snap_name`` found in the corpus: the first ``SnapClass(...)`` call
+    inside that method body."""
+    sites: Dict[str, Tuple[str, ast.Call]] = {}
+    for path, _source, tree in graph.files:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for stmt in node.body:
+                if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                qual = f"{node.name}.{stmt.name}"
+                if qual not in producers or qual in sites:
+                    continue
+                for call in ast.walk(stmt):
+                    if (
+                        isinstance(call, ast.Call)
+                        and (dotted_name(call.func) or "").split(".")[-1]
+                        == snap_name
+                    ):
+                        sites[qual] = (path, call)
+                        break
+    return sites
+
+
 def check_schema(
     graph: CorpusGraph,
     rules: Set[str],
@@ -459,6 +493,78 @@ def check_schema(
                         lines_of,
                     )
                 )
+        # Face (b): multi-producer wire snapshots — each registered
+        # producer must pass every snapshot field at its ctor site.
+        for snap_name, producers in sorted(WIRE_SNAPSHOT_PRODUCERS.items()):
+            cls = classes.get(snap_name)
+            if cls is None:
+                continue
+            field_names = {f.name for f in cls.fields}
+            sites = _producer_ctor_sites(graph, snap_name, producers)
+            for qual, exempt in sorted(producers.items()):
+                for name in sorted(exempt - field_names):
+                    findings.append(
+                        _finding(
+                            "DYN304",
+                            cls.path,
+                            cls.node,
+                            snap_name,
+                            f"WIRE_SNAPSHOT_PRODUCERS exempts `{name}` for "
+                            f"`{qual}` but `{snap_name}` has no such field "
+                            "— delete the stale entry so the map stays "
+                            "trustworthy",
+                            lines_of,
+                        )
+                    )
+                site = sites.get(qual)
+                if site is None:
+                    findings.append(
+                        _finding(
+                            "DYN304",
+                            cls.path,
+                            cls.node,
+                            snap_name,
+                            f"WIRE_SNAPSHOT_PRODUCERS registers `{qual}` "
+                            f"as a producer of `{snap_name}` but no such "
+                            "constructor site exists — fix the registry "
+                            "or the producer",
+                            lines_of,
+                        )
+                    )
+                    continue
+                site_path, call = site
+                if any(kw.arg is None for kw in call.keywords):
+                    continue  # **dynamic construction: stand down
+                passed = {kw.arg for kw in call.keywords}
+                for name in sorted(field_names - passed - exempt):
+                    findings.append(
+                        _finding(
+                            "DYN304",
+                            site_path,
+                            call,
+                            qual,
+                            f"`{qual}` builds `{snap_name}` without "
+                            f"`{name}` and carries no exemption — this "
+                            "producer would silently publish the default "
+                            "while its peers publish the measured signal "
+                            "(seeded replays stop modelling the fleet); "
+                            "pass the field or exempt it with the reason",
+                            lines_of,
+                        )
+                    )
+                for name in sorted(exempt & passed):
+                    findings.append(
+                        _finding(
+                            "DYN304",
+                            site_path,
+                            call,
+                            qual,
+                            f"`{qual}` now passes `{name}` but the "
+                            "registry still exempts it — delete the stale "
+                            "exemption so the map stays trustworthy",
+                            lines_of,
+                        )
+                    )
 
     # ----------------------------------------------------------- DYN305
     if "DYN305" in rules:
